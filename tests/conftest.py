@@ -1,0 +1,22 @@
+"""Test harness config: simulated 8-device CPU mesh + float64.
+
+Multi-chip logic is tested without a pod via XLA's host-platform device
+simulation (SURVEY.md §4 "Consequences"): 8 virtual CPU devices exercise the
+same shard_map/collective code paths as a real TPU mesh. float64 is enabled
+so the JAX solver can be compared against the float64 NumPy oracle at
+tight tolerances.
+"""
+
+import os
+
+# Must run before jax initialises its backends.
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_enable_x64", True)

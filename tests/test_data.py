@@ -1,0 +1,85 @@
+"""Data layer tests: CSV semantics, scaler semantics, partitioner."""
+
+import numpy as np
+import pytest
+
+from tpusvm.data import (
+    MinMaxScaler,
+    blobs,
+    mnist_like,
+    partition,
+    read_csv,
+    write_csv,
+)
+
+
+def test_csv_roundtrip(tmp_path):
+    X = np.array([[0.5, 1.5], [2.0, -3.0], [4.25, 0.0]])
+    Y = np.array([1, -1, 1], np.int32)
+    p = tmp_path / "d.csv"
+    write_csv(str(p), X, Y)
+    X2, Y2 = read_csv(str(p))
+    np.testing.assert_array_equal(X, X2)
+    np.testing.assert_array_equal(Y, Y2)
+
+
+def test_csv_label_mapping_and_short_rows(tmp_path):
+    # label != 1 -> -1 (main3.cpp:49-52); rows with < 2 fields skipped (:41)
+    p = tmp_path / "d.csv"
+    p.write_text("a,b,label\n1.0,2.0,7\n\n3.0,4.0,1\n9\n5.0,6.0,0\n")
+    X, Y = read_csv(str(p))
+    assert X.shape == (3, 2)
+    np.testing.assert_array_equal(Y, [-1, 1, -1])
+
+
+def test_csv_n_limit(tmp_path):
+    # gpu_svm_main4.cu:38-40 row cap
+    p = tmp_path / "d.csv"
+    write_csv(str(p), np.arange(10.0).reshape(5, 2), np.ones(5, np.int32))
+    X, Y = read_csv(str(p), n_limit=3)
+    assert len(Y) == 3
+
+
+def test_scaler_matches_reference_semantics():
+    X = np.array([[0.0, 5.0, 7.0], [10.0, 5.0, 3.0], [5.0, 5.0, 5.0]])
+    s = MinMaxScaler().fit(X)
+    Xs = s.transform(X)
+    # normal feature scaled to [0,1]
+    np.testing.assert_allclose(Xs[:, 0], [0.0, 1.0, 0.5])
+    # degenerate range (< 1e-12) -> divide by 1.0, i.e. x - min (main3.cpp:80-82)
+    np.testing.assert_allclose(Xs[:, 1], [0.0, 0.0, 0.0])
+    np.testing.assert_allclose(Xs[:, 2], [1.0, 0.0, 0.5])
+
+
+def test_scaler_test_set_uses_train_minmax():
+    Xtr = np.array([[0.0], [10.0]])
+    Xte = np.array([[20.0]])
+    s = MinMaxScaler().fit(Xtr)
+    np.testing.assert_allclose(s.transform(Xte), [[2.0]])  # may leave [0,1]
+
+
+def test_partition_contiguous_with_ids():
+    X = np.arange(14.0).reshape(7, 2)
+    Y = np.array([1, -1, 1, -1, 1, -1, 1], np.int32)
+    part = partition(X, Y, 4)  # cap = ceil(7/4) = 2
+    assert part.X.shape == (4, 2, 2)
+    np.testing.assert_array_equal(part.count, [2, 2, 2, 1])
+    np.testing.assert_array_equal(part.ids[0], [0, 1])
+    np.testing.assert_array_equal(part.ids[3], [6, -1])
+    assert part.valid[3, 1] == False  # noqa: E712
+    # padded row is zeroed and label 0 (neither class)
+    assert part.Y[3, 1] == 0
+    np.testing.assert_array_equal(part.X[3, 1], [0.0, 0.0])
+    # reassembling valid rows in order gives back the original data
+    np.testing.assert_array_equal(part.X[part.valid], X)
+
+
+def test_synthetic_deterministic():
+    X1, Y1 = blobs(n=50, seed=3)
+    X2, Y2 = blobs(n=50, seed=3)
+    np.testing.assert_array_equal(X1, X2)
+    np.testing.assert_array_equal(Y1, Y2)
+    Xm, Ym = mnist_like(n=100, d=32, rank=4, seed=1)
+    assert Xm.shape == (100, 32)
+    assert Xm.min() >= 0 and Xm.max() <= 255
+    assert set(np.unique(Ym)) == {-1, 1}

@@ -1,0 +1,72 @@
+"""Oracle SMO tests: convergence, KKT properties, warm start.
+
+The reference's validation is cross-implementation parity (SURVEY.md §4);
+here the oracle additionally gets direct mathematical checks so it can anchor
+that parity chain.
+"""
+
+import numpy as np
+
+from tpusvm.config import SVMConfig
+from tpusvm.data import MinMaxScaler, blobs, rings
+from tpusvm.oracle import get_sv_indices, predict, smo_train
+from tpusvm.status import Status
+
+CFG = SVMConfig(C=1.0, gamma=0.125)  # banknote/debug preset (main3.cpp:308-312)
+
+
+def _train_scaled(gen, **kw):
+    X, Y = gen(**kw)
+    Xs = MinMaxScaler().fit_transform(X)
+    return Xs, Y
+
+
+def test_converges_on_blobs():
+    Xs, Y = _train_scaled(blobs, n=120, seed=0)
+    res = smo_train(Xs, Y, CFG)
+    assert res.status == Status.CONVERGED
+    # stopping criterion achieved (main3.cpp:213)
+    assert res.b_low <= res.b_high + 2 * CFG.tau
+    preds = predict(Xs, Xs, Y, res.alpha, res.b, CFG.gamma)
+    assert (preds == Y).mean() > 0.97
+
+
+def test_rbf_separates_rings():
+    # not linearly separable — succeeds only if the RBF kernel path is right
+    Xs, Y = _train_scaled(rings, n=200, seed=1)
+    res = smo_train(Xs, Y, SVMConfig(C=10.0, gamma=10.0))
+    assert res.status == Status.CONVERGED
+    preds = predict(Xs, Xs, Y, res.alpha, res.b, 10.0)
+    assert (preds == Y).mean() > 0.97
+
+
+def test_kkt_properties():
+    Xs, Y = _train_scaled(blobs, n=150, seed=2)
+    res = smo_train(Xs, Y, CFG)
+    a = res.alpha
+    # box constraint
+    assert (a >= -1e-12).all() and (a <= CFG.C + 1e-12).all()
+    # dual feasibility: sum alpha_i y_i = 0 is preserved by every paired update
+    assert abs(float(a @ Y)) < 1e-9
+    assert len(get_sv_indices(a)) > 0
+
+
+def test_warm_start_from_converged_solution_is_immediate():
+    # cascade semantics: retraining from a converged alpha must converge in
+    # one working-set check with no further updates (n_iter stays 1)
+    Xs, Y = _train_scaled(blobs, n=100, seed=4)
+    res = smo_train(Xs, Y, CFG)
+    res2 = smo_train(Xs, Y, CFG, alpha0=res.alpha, warm_start=True)
+    assert res2.status == Status.CONVERGED
+    assert res2.n_iter == 1
+    np.testing.assert_allclose(res2.alpha, res.alpha)
+    np.testing.assert_allclose(res2.b, res.b, atol=1e-9)
+
+
+def test_iteration_counter_reference_semantics():
+    # n_iter = successful updates + 1 (main3.cpp:197, :281); a run capped at
+    # max_iter must stop with MAX_ITER status
+    Xs, Y = _train_scaled(blobs, n=100, seed=5)
+    res = smo_train(Xs, Y, SVMConfig(C=1.0, gamma=0.125, max_iter=3))
+    assert res.status == Status.MAX_ITER
+    assert res.n_iter == 4  # 3 updates + 1, then > max_iter triggers

@@ -1,0 +1,120 @@
+"""JAX on-device solver vs. NumPy oracle: bit-level trajectory parity.
+
+This is the TPU-native version of the reference's cross-implementation parity
+methodology (SURVEY.md §4): every implementation must agree on the SV index
+set, b, and iteration count. With float64 enabled both solvers follow the
+same trajectory (same masked-argmin tie-breaks), so the comparison is exact
+on iteration count / SV set and tight on floats.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpusvm.config import SVMConfig
+from tpusvm.data import MinMaxScaler, blobs, partition, rings
+from tpusvm.oracle import get_sv_indices, smo_train
+from tpusvm.oracle import predict as oracle_predict
+from tpusvm.solver import predict as jax_predict
+from tpusvm.solver import smo_solve
+from tpusvm.status import Status
+
+CFG = SVMConfig(C=1.0, gamma=0.125)
+
+
+def _data(gen, **kw):
+    X, Y = gen(**kw)
+    Xs = MinMaxScaler().fit_transform(X)
+    return Xs, Y
+
+
+def _solve_both(Xs, Y, cfg, **jkw):
+    o = smo_train(Xs, Y, cfg)
+    j = smo_solve(
+        jnp.asarray(Xs), jnp.asarray(Y),
+        C=cfg.C, gamma=cfg.gamma, eps=cfg.eps, tau=cfg.tau,
+        max_iter=cfg.max_iter, **jkw,
+    )
+    return o, j
+
+
+@pytest.mark.parametrize(
+    "gen,kw,cfg",
+    [
+        (blobs, dict(n=120, seed=0), CFG),
+        (blobs, dict(n=151, d=5, seed=7), CFG),
+        (rings, dict(n=200, seed=1), SVMConfig(C=10.0, gamma=10.0)),
+    ],
+)
+def test_solution_parity(gen, kw, cfg):
+    # The reference's own parity criterion is SOLUTION-level, not
+    # trajectory-level: its serial and GPU builds report b = -5.9026206 vs
+    # -5.9027319 (agreement < 0.003%, SURVEY.md §6) yet identical SV sets and
+    # accuracy. ulp-level reduction-order differences (XLA vs NumPy) shift
+    # near-tied working-set picks, so iteration counts may differ by a few;
+    # the converged solution must still agree tightly.
+    Xs, Y = _data(gen, **kw)
+    o, j = _solve_both(Xs, Y, cfg)
+    assert int(j.status) == int(o.status) == Status.CONVERGED
+    # same order of magnitude of work (sanity against runaway divergence)
+    assert abs(int(j.n_iter) - o.n_iter) <= max(5, o.n_iter // 4)
+    np.testing.assert_allclose(np.asarray(j.b), o.b, rtol=0, atol=1e-4)
+    # the tau=1e-5 stopping tolerance only pins alphas to ~1e-4; compare
+    # loosely (the reference never compares alphas at all, only SV count/b)
+    np.testing.assert_allclose(np.asarray(j.alpha), o.alpha, atol=1e-3)
+    # identical SV index set — the reference's headline correctness criterion
+    sv_o = get_sv_indices(o.alpha)
+    sv_j = get_sv_indices(np.asarray(j.alpha))
+    np.testing.assert_array_equal(sv_o, sv_j)
+
+
+def test_padding_invariance():
+    # padded rows (validity mask False) must not change the result at all
+    Xs, Y = _data(blobs, n=100, seed=3)
+    o, j = _solve_both(Xs, Y, CFG)
+    pad = 28
+    Xp = np.concatenate([Xs, np.zeros((pad, Xs.shape[1]))])
+    Yp = np.concatenate([Y, np.zeros(pad, np.int32)])
+    valid = np.concatenate([np.ones(100, bool), np.zeros(pad, bool)])
+    jp = smo_solve(
+        jnp.asarray(Xp), jnp.asarray(Yp), valid=jnp.asarray(valid),
+        C=CFG.C, gamma=CFG.gamma, eps=CFG.eps, tau=CFG.tau,
+        max_iter=CFG.max_iter,
+    )
+    assert int(jp.n_iter) == int(j.n_iter)
+    np.testing.assert_allclose(np.asarray(jp.alpha)[:100], np.asarray(j.alpha),
+                               atol=1e-12)
+    assert (np.asarray(jp.alpha)[100:] == 0).all()
+    np.testing.assert_allclose(float(jp.b), float(j.b), atol=1e-12)
+
+
+def test_warm_start_parity():
+    Xs, Y = _data(blobs, n=90, seed=9)
+    o = smo_train(Xs, Y, CFG)
+    # perturb: zero out half the alphas, warm start both solvers from it
+    a0 = np.array(o.alpha)
+    a0[::2] = 0.0
+    o2 = smo_train(Xs, Y, CFG, alpha0=a0, warm_start=True)
+    j2 = smo_solve(
+        jnp.asarray(Xs), jnp.asarray(Y), alpha0=jnp.asarray(a0),
+        C=CFG.C, gamma=CFG.gamma, eps=CFG.eps, tau=CFG.tau,
+        max_iter=CFG.max_iter, warm_start=True,
+    )
+    assert int(j2.status) == int(o2.status)
+    assert abs(int(j2.n_iter) - o2.n_iter) <= max(5, o2.n_iter // 4)
+    np.testing.assert_allclose(np.asarray(j2.alpha), o2.alpha, atol=1e-3)
+    np.testing.assert_array_equal(
+        get_sv_indices(np.asarray(j2.alpha)), get_sv_indices(o2.alpha)
+    )
+
+
+def test_predict_parity():
+    Xs, Y = _data(blobs, n=80, seed=11)
+    Xt, Yt = _data(blobs, n=40, seed=12)
+    o, j = _solve_both(Xs, Y, CFG)
+    po = oracle_predict(Xt, Xs, Y, o.alpha, o.b, CFG.gamma)
+    pj = jax_predict(
+        jnp.asarray(Xt), jnp.asarray(Xs), jnp.asarray(Y), j.alpha, j.b,
+        gamma=CFG.gamma,
+    )
+    np.testing.assert_array_equal(po, np.asarray(pj))

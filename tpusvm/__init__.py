@@ -1,0 +1,17 @@
+"""tpusvm — TPU-native parallel SVM training (JAX / XLA / Pallas / shard_map).
+
+A from-scratch framework with the capabilities of the reference project
+"Parallelizing Support Vector Machine Training with GPU and MPI"
+(guaijiacc/…): binary RBF-kernel SVM training via SMO with Keerthi
+first-order working-set selection, a serial correctness oracle, a fully
+on-device single-chip solver, distributed Cascade SVM (classical tree and
+modified star merges) over a jax.sharding.Mesh, and one-vs-rest multi-class
+training. See SURVEY.md for the capability map.
+"""
+
+from tpusvm.config import CascadeConfig, SVMConfig, preset
+from tpusvm.status import Status
+
+__version__ = "0.1.0"
+
+__all__ = ["SVMConfig", "CascadeConfig", "preset", "Status", "__version__"]

@@ -1,0 +1,87 @@
+"""Configuration for TPU-native SVM training.
+
+All defaults reproduce the reference implementation's hardcoded constants
+(reference: main3.cpp:95 gamma, :163 C, :109/:165 eps, :196-198 tau/max_iter,
+:297 sv_tol; mpi_svm_main3.cpp:542-544 max_rounds) so a zero-flag run is a
+parity run. The reference has no config system at all (constants are edited
+in-source, SURVEY.md §5.6); this dataclass + the CLI in `tpusvm.cli` is the
+TPU-native replacement.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+
+@dataclasses.dataclass(frozen=True)
+class SVMConfig:
+    """Hyperparameters and numerical tolerances of the SMO solver.
+
+    Attributes:
+      C: box constraint (reference main3.cpp:342 — C=10 for MNIST, 1 for banknote).
+      gamma: RBF width, K(a,b)=exp(-gamma*||a-b||^2) (main3.cpp:95 — 0.00125 for
+        MNIST, 0.125 for banknote/debug).
+      tau: stopping tolerance; converged when b_low <= b_high + 2*tau
+        (main3.cpp:196, :213).
+      eps: index-set tolerance for I_high/I_low membership, eta positivity guard,
+        and U<=V feasibility slack (main3.cpp:109, :158, :253).
+      sv_tol: alpha > sv_tol defines a support vector (main3.cpp:297).
+      max_iter: SMO update cap (main3.cpp:198).
+      max_rounds: cascade round cap (mpi_svm_main3.cpp:544).
+    """
+
+    C: float = 10.0
+    gamma: float = 0.00125
+    tau: float = 1e-5
+    eps: float = 1e-12
+    sv_tol: float = 1e-8
+    max_iter: int = 100000
+    max_rounds: int = 50
+
+
+@dataclasses.dataclass(frozen=True)
+class CascadeConfig:
+    """Shapes and topology of the distributed cascade (SURVEY.md §2.2 C18-C24).
+
+    XLA needs static shapes, so the dynamically-sized SV sets of the reference
+    become fixed-capacity padded buffers carried with validity masks.
+
+    Attributes:
+      n_shards: number of mesh members P (reference: `mpirun -np P`).
+      sv_capacity: max support vectors a single merged model may hold. Must be
+        >= the true global SV count (1548 for MNIST-60k); overflow is detected
+        and reported at runtime.
+      topology: "tree" = classical binary-reduction cascade (mpi_svm_main3.cpp),
+        "star" = modified two-layer cascade (mpi_svm_main2.cpp).
+    """
+
+    n_shards: int = 8
+    sv_capacity: int = 4096
+    topology: str = "tree"
+
+    def __post_init__(self):
+        if self.topology not in ("tree", "star"):
+            raise ValueError(f"unknown cascade topology: {self.topology!r}")
+        if self.topology == "tree" and (self.n_shards & (self.n_shards - 1)) != 0:
+            # mpi_svm_main3.cpp:420-428 aborts on non-power-of-two world size.
+            raise ValueError(
+                f"tree cascade requires a power-of-two shard count, got {self.n_shards}"
+            )
+
+
+# Named dataset presets mirroring the reference's edit-in-place dataset switch
+# (main3.cpp:308-313): each maps to (C, gamma).
+DATASET_PRESETS = {
+    "mnist": (10.0, 0.00125),
+    "banknote": (1.0, 0.125),
+    "debug": (1.0, 0.125),
+}
+
+
+def preset(name: str, **overrides) -> SVMConfig:
+    """Build an SVMConfig from a named dataset preset."""
+    if name not in DATASET_PRESETS:
+        raise ValueError(f"unknown preset {name!r}; known: {sorted(DATASET_PRESETS)}")
+    C, gamma = DATASET_PRESETS[name]
+    return dataclasses.replace(SVMConfig(C=C, gamma=gamma), **overrides)

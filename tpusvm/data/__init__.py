@@ -1,0 +1,16 @@
+from tpusvm.data.csv_reader import read_csv, write_csv
+from tpusvm.data.partition import Partition, partition
+from tpusvm.data.scaler import MinMaxScaler
+from tpusvm.data.synthetic import blobs, mnist_like, mnist_like_multiclass, rings
+
+__all__ = [
+    "read_csv",
+    "write_csv",
+    "Partition",
+    "partition",
+    "MinMaxScaler",
+    "blobs",
+    "rings",
+    "mnist_like",
+    "mnist_like_multiclass",
+]

@@ -1,0 +1,63 @@
+"""CSV dataset reader with the reference's exact semantics.
+
+Reference: read_CSV in main3.cpp:13-54 (and the n_limit-capped variant in
+gpu_svm_main4.cu:16-59):
+  - the first line is a header and is discarded; the number of features is
+    (number of header fields - 1) — the last column is the label;
+  - data rows with fewer than 2 comma-separated fields are skipped;
+  - the label is the last field, parsed as int, mapped `label != 1 -> -1`
+    (one-vs-rest, digit "1" vs. rest);
+  - optional `n_limit` caps the number of rows kept (gpu_svm_main4.cu:38-40).
+
+Returns float64 row-major X and int32 Y, matching the reference's
+vector<double>/vector<int>.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import numpy as np
+
+
+def read_csv(
+    filename: str, n_limit: Optional[int] = None
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Read a labelled CSV the way the reference does.
+
+    Args:
+      filename: path to a CSV whose last column is an integer label.
+      n_limit: if given, keep at most this many data rows (gpu_svm_main4.cu).
+
+    Returns:
+      (X, Y): X float64 of shape (n, n_features); Y int32 of shape (n,) with
+      values in {+1, -1} (label != 1 mapped to -1).
+    """
+    xs = []
+    ys = []
+    with open(filename, "r") as f:
+        header = f.readline()  # discarded; defines the column count
+        n_features = len(header.rstrip("\n").split(",")) - 1
+        for line in f:
+            fields = line.rstrip("\n").split(",")
+            if len(fields) < 2:  # must have at least one feature + label
+                continue
+            xs.append([float(v) for v in fields[:-1]])
+            label = int(float(fields[-1]))
+            ys.append(1 if label == 1 else -1)
+            if n_limit is not None and len(ys) >= n_limit:
+                break
+    if not ys:
+        return np.zeros((0, max(n_features, 0)), np.float64), np.zeros((0,), np.int32)
+    X = np.asarray(xs, dtype=np.float64)
+    Y = np.asarray(ys, dtype=np.int32)
+    return X, Y
+
+
+def write_csv(filename: str, X: np.ndarray, Y: np.ndarray) -> None:
+    """Write (X, Y) in the format read_csv expects (header + last-column label)."""
+    n, d = X.shape
+    with open(filename, "w") as f:
+        f.write(",".join([f"f{j}" for j in range(d)] + ["label"]) + "\n")
+        for i in range(n):
+            f.write(",".join(repr(float(v)) for v in X[i]) + f",{int(Y[i])}\n")

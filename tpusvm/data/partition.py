@@ -1,0 +1,64 @@
+"""Contiguous data partitioning with global IDs.
+
+Reference: the MPI scatter (mpi_svm_main3.cpp:463-518) splits the dataset into
+P contiguous chunks of ceil(n/P) rows each (the last chunk may be short) and
+assigns each row its original index as a global ID; the cascade's dedup-by-ID
+union builder (C21) and ID-set convergence test (C24) both key on these IDs.
+
+On TPU there is no scatter: the partition is expressed as a padded (P, cap, d)
+array + validity mask, which is then laid out over the mesh with a
+NamedSharding so each mesh member holds exactly one chunk. Padding keeps
+shapes static for XLA (SURVEY.md §7.3 "Dynamic shapes").
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import numpy as np
+
+
+class Partition(NamedTuple):
+    """P padded chunks. Arrays are host-side numpy; sharding happens later.
+
+    X:     (P, cap, d) float  — rows beyond `count[p]` are zero padding
+    Y:     (P, cap) int32     — padded entries are 0 (neither +1 nor -1)
+    ids:   (P, cap) int32     — global row index; padded entries are -1
+    valid: (P, cap) bool
+    count: (P,) int32
+    """
+
+    X: np.ndarray
+    Y: np.ndarray
+    ids: np.ndarray
+    valid: np.ndarray
+    count: np.ndarray
+
+
+def partition(X: np.ndarray, Y: np.ndarray, n_shards: int) -> Partition:
+    """Split (X, Y) into n_shards contiguous ceil(n/P)-row padded chunks.
+
+    Like the reference's scatter, trailing shards can be short — or entirely
+    empty when n < n_shards * ceil(n/n_shards) by a full chunk. Empty shards
+    solve to NO_WORKING_SET with an empty SV set; the cascade layer masks
+    them out of merges, so they are harmless there, but callers running
+    per-shard solves directly should check `count` first.
+    """
+    n, d = X.shape
+    cap = -(-n // n_shards)  # ceil
+    Xp = np.zeros((n_shards, cap, d), X.dtype)
+    Yp = np.zeros((n_shards, cap), np.int32)
+    ids = np.full((n_shards, cap), -1, np.int32)
+    valid = np.zeros((n_shards, cap), bool)
+    count = np.zeros((n_shards,), np.int32)
+    for p in range(n_shards):
+        lo = p * cap
+        hi = min(lo + cap, n)
+        c = max(hi - lo, 0)
+        if c:
+            Xp[p, :c] = X[lo:hi]
+            Yp[p, :c] = Y[lo:hi]
+            ids[p, :c] = np.arange(lo, hi, dtype=np.int32)
+            valid[p, :c] = True
+        count[p] = c
+    return Partition(Xp, Yp, ids, valid, count)

@@ -1,0 +1,46 @@
+"""Min-max feature scaling with the reference's exact semantics.
+
+Reference: find_min_max (main3.cpp:57-71) and scale_features (main3.cpp:74-89):
+per-feature min-max scaling to [0,1], with degenerate ranges (< 1e-12) treated
+as range 1.0 so constant features pass through shifted by their min. The test
+set is always scaled with the TRAIN set's min/max (main3.cpp:338-339, 355).
+
+In the distributed cascade, rank 0 computes min/max over the FULL dataset
+before scattering and broadcasts it (mpi_svm_main3.cpp:529-539) — here the
+scaler is simply fit on the full array before sharding, which is the same
+computation without the broadcast.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+_DEGENERATE_RANGE = 1e-12
+
+
+@dataclasses.dataclass
+class MinMaxScaler:
+    """Per-feature min-max scaler. fit() on train data only."""
+
+    min_val: np.ndarray | None = None
+    max_val: np.ndarray | None = None
+
+    def fit(self, X: np.ndarray) -> "MinMaxScaler":
+        self.min_val = np.min(X, axis=0)
+        self.max_val = np.max(X, axis=0)
+        return self
+
+    @property
+    def range_(self) -> np.ndarray:
+        r = self.max_val - self.min_val
+        return np.where(r < _DEGENERATE_RANGE, 1.0, r)
+
+    def transform(self, X: np.ndarray) -> np.ndarray:
+        if self.min_val is None:
+            raise RuntimeError("scaler not fitted")
+        return (X - self.min_val) / self.range_
+
+    def fit_transform(self, X: np.ndarray) -> np.ndarray:
+        return self.fit(X).transform(X)

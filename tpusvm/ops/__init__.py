@@ -1,0 +1,25 @@
+from tpusvm.ops.rbf import (
+    rbf_cross,
+    rbf_matvec,
+    rbf_row,
+    rbf_rows_at,
+    sq_norms,
+)
+from tpusvm.ops.selection import (
+    i_high_mask,
+    i_low_mask,
+    masked_argmax,
+    masked_argmin,
+)
+
+__all__ = [
+    "rbf_cross",
+    "rbf_matvec",
+    "rbf_row",
+    "rbf_rows_at",
+    "sq_norms",
+    "i_high_mask",
+    "i_low_mask",
+    "masked_argmax",
+    "masked_argmin",
+]

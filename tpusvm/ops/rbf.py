@@ -1,0 +1,97 @@
+"""RBF kernel primitives as XLA-friendly JAX ops.
+
+TPU-native replacements for the reference's CUDA kernel computations:
+  - `rbf_row` / `rbf_two_rows` <- calc_kernel_matrix with n1=1
+    (gpu_svm_main3.cu:137-147, launched per SMO iteration at :400/:409);
+  - `rbf_cross` <- the general K(X1, X2) tile kernel, used for prediction
+    (gpu_svm_main3.cu:277-296) — expressed as one big matmul so XLA tiles it
+    onto the MXU;
+  - `rbf_matvec` <- the warm-start f reconstruction
+    sum_j coef_j K(x_j, x_i) (mpi_svm_main3.cpp:160-186), blocked so the
+    (n, n) kernel matrix is never materialised.
+
+Two formulations are provided:
+  - direct:  exp(-g * sum((X - x)^2))             — elementwise, VPU-bound,
+    numerically closest to the reference's per-pair loop;
+  - dot:     exp(-g * (|X|^2 + |x|^2 - 2 X @ x))  — one matmul on the MXU,
+    used whenever there is a batch dimension to amortise it over.
+
+The dot form can produce tiny negative squared distances in low precision;
+they are clamped at 0 before the exp.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def sq_norms(X: jax.Array) -> jax.Array:
+    """Per-row squared norms |x_i|^2, shape (n,)."""
+    return jnp.einsum("nd,nd->n", X, X)
+
+
+def rbf_row(X: jax.Array, x: jax.Array, gamma) -> jax.Array:
+    """K(x, X[j]) for all j via the direct formulation. Shape (n,)."""
+    diff = X - x[None, :]
+    return jnp.exp(-gamma * jnp.einsum("nd,nd->n", diff, diff))
+
+
+def rbf_rows_at(X: jax.Array, idx: jax.Array, gamma) -> jax.Array:
+    """K(X[idx[k]], X[j]) for a small static-size index vector idx.
+
+    One pass over X producing len(idx) kernel rows at once (the SMO hot loop
+    needs the i_high and i_low rows together — fusing them halves HBM traffic
+    vs. two independent row computations). Shape (len(idx), n).
+
+    Uses the direct (X - x)^2 formulation: the hot loop is HBM-bound either
+    way (n*d reads per refresh), and the direct form avoids the dot-trick's
+    cancellation error, keeping the solver's trajectory as close as possible
+    to the serial oracle's (SURVEY.md §7.3 "Precision").
+    """
+    Xi = X[idx]  # (k, d)
+    diff = X[None, :, :] - Xi[:, None, :]  # (k, n, d)
+    d2 = jnp.einsum("knd,knd->kn", diff, diff)
+    return jnp.exp(-gamma * d2)
+
+
+def rbf_cross(XA: jax.Array, XB: jax.Array, gamma,
+              snA: jax.Array | None = None, snB: jax.Array | None = None
+              ) -> jax.Array:
+    """Full K(XA, XB) kernel matrix, shape (nA, nB). MXU matmul."""
+    if snA is None:
+        snA = sq_norms(XA)
+    if snB is None:
+        snB = sq_norms(XB)
+    d2 = snA[:, None] + snB[None, :] - 2.0 * (XA @ XB.T)
+    d2 = jnp.maximum(d2, 0.0)
+    return jnp.exp(-gamma * d2)
+
+
+def rbf_matvec(X: jax.Array, coef: jax.Array, gamma, block: int = 1024
+               ) -> jax.Array:
+    """sum_j coef_j K(x_j, x_i) for all i, without materialising K.
+
+    Scans over j-blocks: each step is an (n, block) MXU matmul + exp + matvec.
+    Used for the cascade's warm-start f reconstruction. Shape (n,).
+    """
+    n, d = X.shape
+    nb = -(-n // block)
+    pad = nb * block - n
+    Xp = jnp.pad(X, ((0, pad), (0, 0)))
+    cp = jnp.pad(coef, (0, pad))  # padded rows have coef 0 -> no contribution
+    sn = sq_norms(X)
+
+    Xb = Xp.reshape(nb, block, d)
+    cb = cp.reshape(nb, block)
+    snb = sq_norms(Xp).reshape(nb, block)
+
+    def step(acc, args):
+        Xj, cj, snj = args
+        d2 = sn[:, None] + snj[None, :] - 2.0 * (X @ Xj.T)
+        d2 = jnp.maximum(d2, 0.0)
+        return acc + jnp.exp(-gamma * d2) @ cj, None
+
+    acc0 = jnp.zeros((n,), X.dtype)
+    acc, _ = jax.lax.scan(step, acc0, (Xb, cb, snb))
+    return acc

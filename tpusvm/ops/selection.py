@@ -1,0 +1,48 @@
+"""Masked working-set selection (Keerthi first-order heuristic) as XLA ops.
+
+TPU-native replacement for the reference's two-phase GPU selection
+(gpu_svm_main3.cu:166-239): the mask kernels that write f or +/-INF
+(calc_f_in_I_high/low) become a jnp.where, and the multi-launch index-array
+tree reductions (calc_i_high/low) become a single jnp.argmin/argmax — XLA
+lowers these to native tree reductions on the VPU, so the whole cascade of
+kernel launches collapses into one fused op.
+
+Tie-breaking: jnp.argmin/argmax return the FIRST occurrence of the extremum,
+which matches the serial oracle's strict-improvement scan (main3.cpp:113-121)
+— this is the deterministic tie-break SURVEY.md §7.3 calls for. (The
+reference's GPU reduction has launch-order-dependent ties; we standardise on
+the serial behaviour.)
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax.numpy as jnp
+
+
+def i_high_mask(alpha, y, C, eps, valid=None):
+    """I_high = {y=+1, a < C-eps} u {y=-1, a > eps} (main3.cpp:115)."""
+    m = jnp.where(y == 1, alpha < C - eps, (y == -1) & (alpha > eps))
+    if valid is not None:
+        m = m & valid
+    return m
+
+
+def i_low_mask(alpha, y, C, eps, valid=None):
+    """I_low = {y=+1, a > eps} u {y=-1, a < C-eps} (main3.cpp:134)."""
+    m = jnp.where(y == 1, alpha > eps, (y == -1) & (alpha < C - eps))
+    if valid is not None:
+        m = m & valid
+    return m
+
+
+def masked_argmin(f, mask) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """(first argmin of f over mask, any(mask))."""
+    vals = jnp.where(mask, f, jnp.inf)
+    return jnp.argmin(vals), jnp.any(mask)
+
+
+def masked_argmax(f, mask) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    vals = jnp.where(mask, f, -jnp.inf)
+    return jnp.argmax(vals), jnp.any(mask)

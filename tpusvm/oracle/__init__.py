@@ -1,0 +1,3 @@
+from tpusvm.oracle.smo import OracleResult, get_sv_indices, predict, smo_train
+
+__all__ = ["OracleResult", "smo_train", "get_sv_indices", "predict"]

@@ -1,0 +1,4 @@
+from tpusvm.solver.predict import decision_function, predict
+from tpusvm.solver.smo import SMOResult, SMOState, smo_solve
+
+__all__ = ["SMOResult", "SMOState", "smo_solve", "decision_function", "predict"]

@@ -1,0 +1,70 @@
+"""On-device batched prediction.
+
+TPU-native replacement for the reference's predict kernels: serial SV-only
+sum (main3.cpp:391-402, C15), GPU all-points sum (gpu_svm_main3.cu:277-296,
+C16). Both are algebraically sign(sum_j a_j y_j K(x, x_j) - b) with a_j = 0
+for non-SVs; here the sum over training points is one blocked MXU matmul per
+test block — K(X_test_blk, X_train) @ (alpha * y) — so XLA tiles the d- and
+n-contractions onto the systolic array.
+
+Sign convention: strict `> 0 -> +1`, matching the serial oracle
+(main3.cpp:399). The reference's MPI build uses `>= 0` (mpi_svm_main3.cpp:800)
+— a documented discrepancy (SURVEY.md §3.5); the oracle convention wins.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from tpusvm.ops.rbf import rbf_cross, sq_norms
+
+
+@functools.partial(jax.jit, static_argnames=("gamma", "block"))
+def decision_function(
+    X_test: jax.Array,
+    X_train: jax.Array,
+    coef: jax.Array,  # alpha * y, zeros for non-SVs / padding
+    b,
+    *,
+    gamma: float,
+    block: int = 2048,
+) -> jax.Array:
+    """f(x) = sum_j coef_j K(x, x_j) - b for each test row. Shape (m,)."""
+    m, d = X_test.shape
+    nb = -(-m // block)
+    pad = nb * block - m
+    Xp = jnp.pad(X_test, ((0, pad), (0, 0)))
+    sn_train = sq_norms(X_train)
+
+    def step(_, Xb):
+        K = rbf_cross(Xb, X_train, gamma, snB=sn_train)
+        return None, K @ coef
+
+    _, scores = jax.lax.scan(step, None, Xp.reshape(nb, block, d))
+    return scores.reshape(-1)[:m] - b
+
+
+def predict(
+    X_test: jax.Array,
+    X_train: jax.Array,
+    Y_train: jax.Array,
+    alpha: jax.Array,
+    b,
+    *,
+    gamma: float,
+    sv_tol: float = 1e-8,
+    block: int = 2048,
+) -> jax.Array:
+    """Labels in {+1,-1}; strict >0 -> +1 (main3.cpp:399).
+
+    Sub-threshold alphas (<= sv_tol) are zeroed before the sum so the score
+    matches the oracle's SV-only sum exactly (main3.cpp:394-397), not just
+    algebraically-up-to-clipped-residuals.
+    """
+    a = jnp.where(alpha > sv_tol, alpha, 0.0)
+    coef = a * Y_train.astype(X_train.dtype)
+    scores = decision_function(X_test, X_train, coef, b, gamma=gamma, block=block)
+    return jnp.where(scores > 0, 1, -1).astype(jnp.int32)

@@ -1,0 +1,24 @@
+"""Solver termination status codes.
+
+The reference signals these conditions with cerr prints + `break`, leaving
+partial state (SURVEY.md §5.3); here they are explicit status codes shared by
+the NumPy oracle and the on-device JAX solver so tests can assert on them.
+
+Reference exit paths in SMO_train (main3.cpp:200-288):
+  - CONVERGED:      b_low <= b_high + 2*tau            (main3.cpp:213)
+  - NO_WORKING_SET: i_high or i_low not found          (main3.cpp:205-209)
+  - INFEASIBLE_UV:  U > V + 1e-12                      (main3.cpp:246-250)
+  - NONPOS_ETA:     eta <= 1e-12                       (main3.cpp:253-257)
+  - MAX_ITER:       more than max_iter updates         (main3.cpp:283-287)
+"""
+
+import enum
+
+
+class Status(enum.IntEnum):
+    RUNNING = 0
+    CONVERGED = 1
+    NO_WORKING_SET = 2
+    INFEASIBLE_UV = 3
+    NONPOS_ETA = 4
+    MAX_ITER = 5
