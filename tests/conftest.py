@@ -3,14 +3,18 @@
 Multi-chip logic is tested without a pod via XLA's host-platform device
 simulation (SURVEY.md §4 "Consequences"): 8 virtual CPU devices exercise the
 same shard_map/collective code paths as a real TPU mesh. float64 is enabled
-so the JAX solver can be compared against the float64 NumPy oracle at
-tight tolerances.
+so the JAX solver can be compared against the float64 NumPy oracle at tight
+tolerances.
+
+Note: this environment's sitecustomize registers the experimental `axon` TPU
+platform at interpreter startup and programmatically sets jax_platforms, so
+an env-var JAX_PLATFORMS=cpu is ignored; the jax.config.update below is what
+actually selects CPU (backends are not yet initialised at conftest time).
 """
 
 import os
 
-# Must run before jax initialises its backends.
-os.environ["JAX_PLATFORMS"] = "cpu"
+# Must be set before the CPU backend initialises.
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
@@ -19,4 +23,9 @@ if "xla_force_host_platform_device_count" not in flags:
 
 import jax  # noqa: E402
 
+jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", True)
+
+
+def pytest_report_header(config):
+    return f"jax devices: {jax.devices()}"

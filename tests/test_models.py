@@ -1,0 +1,118 @@
+"""Model API tests: BinarySVC, OneVsRestSVC, persistence round-trips."""
+
+import numpy as np
+import pytest
+import jax.numpy as jnp
+
+from tpusvm.config import CascadeConfig, SVMConfig
+from tpusvm.data import blobs, rings
+from tpusvm.models import BinarySVC, OneVsRestSVC
+from tpusvm.status import Status
+
+CFG = SVMConfig(C=10.0, gamma=10.0)
+
+
+def test_binary_svc_fit_predict():
+    X, Y = rings(n=300, seed=2)
+    m = BinarySVC(CFG, dtype=jnp.float64).fit(X, Y)
+    assert m.status_ == Status.CONVERGED
+    assert m.n_support_ > 0
+    assert m.score(X, Y) > 0.97
+    Xt, Yt = rings(n=100, seed=3)
+    assert m.score(Xt, Yt) > 0.95
+    assert m.train_time_s_ > 0
+
+
+def test_binary_svc_save_load_roundtrip(tmp_path):
+    X, Y = rings(n=200, seed=4)
+    m = BinarySVC(CFG, dtype=jnp.float64).fit(X, Y)
+    p = str(tmp_path / "model.npz")
+    m.save(p)
+    m2 = BinarySVC.load(p, dtype=jnp.float64)
+    assert m2.config == m.config
+    Xt, _ = rings(n=50, seed=5)
+    np.testing.assert_allclose(
+        m2.decision_function(Xt), m.decision_function(Xt), rtol=1e-10
+    )
+    np.testing.assert_array_equal(m2.predict(Xt), m.predict(Xt))
+
+
+def test_binary_svc_cascade_matches_single_chip():
+    X, Y = rings(n=512, seed=5)
+    single = BinarySVC(CFG, dtype=jnp.float64).fit(X, Y)
+    casc = BinarySVC(CFG, dtype=jnp.float64).fit_cascade(
+        X, Y, CascadeConfig(n_shards=4, sv_capacity=256, topology="tree")
+    )
+    assert casc.status_ == Status.CONVERGED
+    assert set(casc.sv_ids_.tolist()) == set(single.sv_ids_.tolist())
+    np.testing.assert_allclose(casc.b_, single.b_, atol=1e-4)
+    assert casc.cascade_rounds_ >= 2
+
+
+def test_predict_unfitted_raises():
+    with pytest.raises(RuntimeError, match="not fitted"):
+        BinarySVC().predict(np.zeros((2, 2)))
+
+
+def _four_class_data(n=400, seed=0):
+    rng = np.random.default_rng(seed)
+    centers = np.array([[0, 0], [6, 0], [0, 6], [6, 6]], float)
+    labels = rng.integers(0, 4, n)
+    X = centers[labels] + rng.normal(0, 0.8, (n, 2))
+    return X, labels.astype(np.int32)
+
+
+def test_ovr_multiclass():
+    X, labels = _four_class_data()
+    m = OneVsRestSVC(SVMConfig(C=10.0, gamma=2.0), dtype=jnp.float64).fit(X, labels)
+    assert (m.statuses_ == Status.CONVERGED).all()
+    assert m.score(X, labels) > 0.97
+    assert m.decision_function(X[:5]).shape == (5, 4)
+    Xt, lt = _four_class_data(n=100, seed=1)
+    assert m.score(Xt, lt) > 0.95
+
+
+def test_ovr_batched_matches_sequential():
+    X, labels = _four_class_data(n=240, seed=2)
+    cfg = SVMConfig(C=10.0, gamma=2.0)
+    mb = OneVsRestSVC(cfg, dtype=jnp.float64, batched=True).fit(X, labels)
+    ms = OneVsRestSVC(cfg, dtype=jnp.float64, batched=False).fit(X, labels)
+    # vmapped lockstep solve must agree with per-class sequential solve
+    np.testing.assert_array_equal(mb.statuses_, ms.statuses_)
+    np.testing.assert_allclose(mb.b_, ms.b_, atol=1e-9)
+    np.testing.assert_allclose(mb.coef_, ms.coef_, atol=1e-9)
+    np.testing.assert_array_equal(mb.n_iter_, ms.n_iter_)
+
+
+def test_ovr_save_load_roundtrip(tmp_path):
+    X, labels = _four_class_data(n=200, seed=3)
+    m = OneVsRestSVC(SVMConfig(C=10.0, gamma=2.0), dtype=jnp.float64).fit(X, labels)
+    p = str(tmp_path / "ovr.npz")
+    m.save(p)
+    m2 = OneVsRestSVC.load(p, dtype=jnp.float64)
+    Xt, _ = _four_class_data(n=50, seed=4)
+    np.testing.assert_allclose(
+        m2.decision_function(Xt), m.decision_function(Xt), rtol=1e-10
+    )
+    np.testing.assert_array_equal(m2.predict(Xt), m.predict(Xt))
+
+
+def test_save_without_suffix_roundtrips(tmp_path):
+    # np.savez appends .npz; save/load must agree on the filename
+    X, Y = rings(n=120, seed=7)
+    m = BinarySVC(CFG, dtype=jnp.float64).fit(X, Y)
+    p = str(tmp_path / "model_no_suffix")
+    m.save(p)
+    m2 = BinarySVC.load(p, dtype=jnp.float64)
+    np.testing.assert_array_equal(m2.predict(X[:10]), m.predict(X[:10]))
+
+
+def test_fit_warns_on_non_convergence():
+    import warnings as w
+    X, Y = rings(n=200, seed=8)
+    with w.catch_warnings(record=True) as rec:
+        w.simplefilter("always")
+        m = BinarySVC(SVMConfig(C=10.0, gamma=10.0, max_iter=3),
+                      dtype=jnp.float64).fit(X, Y)
+    assert m.status_ == Status.MAX_ITER
+    assert any("MAX_ITER" in str(r.message) for r in rec)

@@ -1,0 +1,75 @@
+"""SVBuffer unit tests: compaction, dedup-by-ID, cascade merge semantics."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from tpusvm.parallel import svbuffer as sb
+
+
+def _buf(ids, alpha=None, valid=None, y=None, d=2):
+    ids = np.asarray(ids, np.int32)
+    n = len(ids)
+    X = np.stack([ids.astype(float), np.arange(n, dtype=float)], axis=1)
+    return sb.SVBuffer(
+        X=jnp.asarray(X),
+        Y=jnp.asarray(y if y is not None else np.ones(n, np.int32)),
+        alpha=jnp.asarray(alpha if alpha is not None else np.zeros(n)),
+        ids=jnp.asarray(ids),
+        valid=jnp.asarray(valid if valid is not None else np.ones(n, bool)),
+    )
+
+
+def test_compact_stable_order_and_count():
+    buf = _buf([5, 7, 9, 11], valid=[False, True, False, True])
+    out, count = sb.compact(buf, 4)
+    assert int(count) == 2
+    np.testing.assert_array_equal(np.asarray(out.ids)[:2], [7, 11])
+    assert not np.asarray(out.valid)[2:].any()
+
+
+def test_compact_overflow_drops_tail_but_reports_count():
+    buf = _buf([1, 2, 3])
+    out, count = sb.compact(buf, 2)
+    assert int(count) == 3  # pre-truncation count lets callers detect overflow
+    np.testing.assert_array_equal(np.asarray(out.ids), [1, 2])
+
+
+def test_dedup_keeps_first_occurrence():
+    # insert-if-new semantics of the reference's unordered_set loop
+    buf = _buf([4, 8, 4, 8, 2], alpha=[0.1, 0.2, 0.3, 0.4, 0.5])
+    out = sb.dedup_first(buf)
+    v = np.asarray(out.valid)
+    np.testing.assert_array_equal(v, [True, True, False, False, True])
+
+
+def test_merge_dedup_cascade_alpha_semantics():
+    # primary keeps alpha (warm start); secondary alphas reset to 0; secondary
+    # rows whose id is already present are dropped (mpi_svm_main2.cpp:481-502)
+    primary = _buf([10, 20], alpha=[0.5, 0.7])
+    secondary = _buf([20, 30, 10, 40], alpha=[9.0, 9.0, 9.0, 9.0])
+    merged, count = sb.merge_dedup(primary, secondary, 6)
+    ids = np.asarray(merged.ids)
+    al = np.asarray(merged.alpha)
+    valid = np.asarray(merged.valid)
+    assert int(count) == 4
+    np.testing.assert_array_equal(ids[:4], [10, 20, 30, 40])
+    np.testing.assert_allclose(al[:4], [0.5, 0.7, 0.0, 0.0])
+    assert valid[:4].all() and not valid[4:].any()
+
+
+def test_merge_dedup_duplicates_within_secondary():
+    # dup ids across two workers' SV sets: first occurrence wins
+    primary = _buf([], d=2)
+    secondary = _buf([3, 5, 3, 5, 3])
+    merged, count = sb.merge_dedup(primary, secondary, 8)
+    assert int(count) == 2
+    np.testing.assert_array_equal(np.asarray(merged.ids)[:2], [3, 5])
+
+
+def test_extract_svs_threshold():
+    train = _buf([1, 2, 3, 4], valid=[True, True, True, False])
+    alpha = jnp.asarray([0.5, 1e-9, 0.2, 0.9])  # last is padding: excluded
+    out, count = sb.extract_svs(train, alpha, 1e-8, 4)
+    assert int(count) == 2
+    np.testing.assert_array_equal(np.asarray(out.ids)[:2], [1, 3])
+    np.testing.assert_allclose(np.asarray(out.alpha)[:2], [0.5, 0.2])

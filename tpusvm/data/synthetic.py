@@ -61,12 +61,16 @@ def rings(
 
 def mnist_like_multiclass(
     n: int = 60000, d: int = 784, n_classes: int = 10, rank: int = 32, seed: int = 587,
+    noise: float = 0.0,
 ) -> Tuple[np.ndarray, np.ndarray]:
     """MNIST-shaped multi-class problem; returns raw class ids (0..n_classes-1).
 
     Each class lives on its own low-rank affine manifold in [0, 255]^d (like
     digit images: correlated pixels, bounded intensities), then values are
-    clipped to [0, 255] and rounded to integers like pixel data.
+    clipped to [0, 255] and rounded to integers like pixel data. `noise` adds
+    per-pixel gaussian noise (std in pixel units) to control the problem's
+    difficulty: higher noise -> more overlap -> more support vectors and SMO
+    iterations (used by bench.py to match real-MNIST difficulty).
     """
     rng = np.random.default_rng(seed)
     per = np.full(n_classes, n // n_classes)
@@ -77,6 +81,8 @@ def mnist_like_multiclass(
         center = rng.uniform(30, 225, size=(d,)) * (rng.random(d) < 0.25)
         coeff = rng.normal(0, 18.0, size=(per[c], rank))
         Xc = center + coeff @ basis
+        if noise > 0:
+            Xc += rng.normal(0, noise, size=Xc.shape)
         np.clip(Xc, 0, 255, out=Xc)
         np.rint(Xc, out=Xc)
         xs.append(Xc)
@@ -90,14 +96,25 @@ def mnist_like_multiclass(
 
 def mnist_like(
     n: int = 60000, d: int = 784, n_classes: int = 10, rank: int = 32,
-    positive_class: int = 1, seed: int = 587,
+    positive_class: int = 1, seed: int = 587, noise: float = 0.0,
+    label_noise: float = 0.0,
 ) -> Tuple[np.ndarray, np.ndarray]:
     """MNIST-shaped ONE-VS-REST problem: labels in {+1,-1}.
 
     One-vs-rest on `positive_class` exactly as the reference maps MNIST
     (label != 1 -> -1, main3.cpp:49-52). Returns (X, Y) with X float64 in
     [0, 255], Y in {+1,-1}.
+
+    `label_noise` deterministically flips that fraction of labels (separate
+    rng stream; X is unaffected). Flipped points become bound support
+    vectors, pushing SV count and SMO iteration count into the range real
+    MNIST exhibits (~1548 SVs / tens of thousands of iterations) — bench.py
+    uses this to match the reference workload's difficulty.
     """
-    X, labels = mnist_like_multiclass(n, d, n_classes, rank, seed)
+    X, labels = mnist_like_multiclass(n, d, n_classes, rank, seed, noise)
     Y = np.where(labels == positive_class, 1, -1).astype(np.int32)
+    if label_noise > 0:
+        flip_rng = np.random.default_rng(seed + 104729)
+        idx = flip_rng.choice(n, int(label_noise * n), replace=False)
+        Y[idx] = -Y[idx]
     return X, Y

@@ -1,0 +1,5 @@
+from tpusvm.models.ovr import OneVsRestSVC
+from tpusvm.models.serialization import load_model, save_model
+from tpusvm.models.svm import BinarySVC
+
+__all__ = ["BinarySVC", "OneVsRestSVC", "save_model", "load_model"]

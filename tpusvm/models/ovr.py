@@ -1,0 +1,177 @@
+"""One-vs-rest multi-class SVM, vmapped over classes.
+
+The reference trains a single one-vs-rest digit ("1" vs. rest); full 10-class
+MNIST is its natural extension (BASELINE.json config 5: "10 SVMs vmapped over
+chips"). TPU-native design:
+
+  - training: `jax.vmap` of the on-device SMO solver over the class axis —
+    one compiled program runs all K binary problems in lockstep (the batched
+    while_loop keeps stepping until every class has terminated; finished
+    classes are masked no-ops). X is shared, only the +/-1 label vectors
+    differ.
+  - prediction: ONE kernel matrix K(test, train) feeds all classes:
+    scores = K @ coef^T with coef (K, n) = alpha * y per class — a single
+    MXU matmul batched over classes instead of K separate predict passes.
+    Class = argmax_k score_k (standard OvR decision).
+"""
+
+from __future__ import annotations
+
+import time
+import warnings
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tpusvm.config import SVMConfig
+from tpusvm.data.scaler import MinMaxScaler
+from tpusvm.models.serialization import load_model, save_model
+from tpusvm.ops.rbf import rbf_cross, sq_norms
+from tpusvm.solver.smo import smo_solve
+from tpusvm.status import Status
+
+
+class OneVsRestSVC:
+    """K-class SVM as K one-vs-rest binary RBF SVMs trained in one vmap."""
+
+    def __init__(
+        self,
+        config: SVMConfig = SVMConfig(),
+        dtype=jnp.float32,
+        scale: bool = True,
+        batched: bool = True,
+        accum_dtype=None,
+    ):
+        self.config = config
+        self.dtype = dtype
+        self.scale = scale
+        self.batched = batched
+        self.accum_dtype = accum_dtype
+        self.scaler_: Optional[MinMaxScaler] = None
+        self.classes_: Optional[np.ndarray] = None
+        self.X_sv_: Optional[np.ndarray] = None   # union of SVs across classes
+        self.coef_: Optional[np.ndarray] = None   # (K, n_sv_union) alpha*y
+        self.b_: Optional[np.ndarray] = None      # (K,)
+        self.n_iter_: Optional[np.ndarray] = None
+        self.statuses_: Optional[np.ndarray] = None
+        self.train_time_s_: float = 0.0
+
+    def fit(self, X: np.ndarray, labels: np.ndarray) -> "OneVsRestSVC":
+        cfg = self.config
+        t0 = time.perf_counter()
+        X = np.asarray(X)
+        labels = np.asarray(labels)
+        self.classes_ = np.unique(labels)
+        Ys = np.stack(
+            [np.where(labels == c, 1, -1).astype(np.int32) for c in self.classes_]
+        )  # (K, n)
+
+        if self.scale:
+            self.scaler_ = MinMaxScaler().fit(X)
+            Xs = self.scaler_.transform(X)
+        else:
+            Xs = X
+        Xd = jnp.asarray(Xs, self.dtype)
+
+        def solve_one(y):
+            return smo_solve(
+                Xd, y, C=cfg.C, gamma=cfg.gamma, eps=cfg.eps, tau=cfg.tau,
+                max_iter=cfg.max_iter, accum_dtype=self.accum_dtype,
+            )
+
+        if self.batched:
+            res = jax.vmap(solve_one)(jnp.asarray(Ys))
+            alphas = np.asarray(res.alpha)           # (K, n)
+            bs = np.asarray(res.b)
+            iters = np.asarray(res.n_iter)
+            statuses = np.asarray(res.status)
+        else:
+            outs = [solve_one(jnp.asarray(y)) for y in Ys]
+            alphas = np.stack([np.asarray(o.alpha) for o in outs])
+            bs = np.asarray([float(o.b) for o in outs])
+            iters = np.asarray([int(o.n_iter) for o in outs])
+            statuses = np.asarray([int(o.status) for o in outs])
+        self.train_time_s_ = time.perf_counter() - t0
+
+        # keep only the union of support vectors across classes
+        is_sv = (alphas > cfg.sv_tol).any(axis=0)
+        sv_idx = np.nonzero(is_sv)[0]
+        alphas_sv = np.where(
+            alphas[:, sv_idx] > cfg.sv_tol, alphas[:, sv_idx], 0.0
+        )
+        self.X_sv_ = Xs[sv_idx]
+        self.coef_ = alphas_sv * Ys[:, sv_idx]
+        self.b_ = bs
+        self.n_iter_ = iters
+        self.statuses_ = statuses
+        not_conv = [
+            (int(c), Status(int(s)).name)
+            for c, s in zip(self.classes_, statuses)
+            if s != Status.CONVERGED
+        ]
+        if not_conv:
+            warnings.warn(
+                f"per-class SMO did not converge for {not_conv}; those "
+                "classifiers may be partially optimised",
+                RuntimeWarning,
+                stacklevel=2,
+            )
+        return self
+
+    def decision_function(self, X: np.ndarray) -> np.ndarray:
+        """(m, K) OvR scores via one batched kernel matmul."""
+        if self.X_sv_ is None:
+            raise RuntimeError("model is not fitted")
+        Xq = self.scaler_.transform(np.asarray(X)) if self.scale else np.asarray(X)
+        scores = _ovr_scores(
+            jnp.asarray(Xq, self.dtype),
+            jnp.asarray(self.X_sv_, self.dtype),
+            jnp.asarray(self.coef_, self.dtype),
+            jnp.asarray(self.b_, self.dtype),
+            self.config.gamma,
+        )
+        return np.asarray(scores)
+
+    def predict(self, X: np.ndarray) -> np.ndarray:
+        scores = self.decision_function(X)
+        return self.classes_[np.argmax(scores, axis=1)]
+
+    def score(self, X: np.ndarray, labels: np.ndarray) -> float:
+        return float((self.predict(X) == np.asarray(labels)).mean())
+
+    def save(self, path: str) -> None:
+        if self.X_sv_ is None:
+            raise RuntimeError("model is not fitted")
+        state = {
+            "classes": self.classes_,
+            "sv_X": self.X_sv_,
+            "coef": self.coef_,
+            "b": self.b_,
+            "scale": self.scale,
+        }
+        if self.scale:
+            state["scaler_min"] = self.scaler_.min_val
+            state["scaler_max"] = self.scaler_.max_val
+        save_model(path, state, self.config)
+
+    @classmethod
+    def load(cls, path: str, dtype=jnp.float32) -> "OneVsRestSVC":
+        state, config = load_model(path)
+        model = cls(config=config, dtype=dtype, scale=bool(state["scale"]))
+        model.classes_ = state["classes"]
+        model.X_sv_ = state["sv_X"]
+        model.coef_ = state["coef"]
+        model.b_ = state["b"]
+        if model.scale:
+            model.scaler_ = MinMaxScaler(
+                min_val=state["scaler_min"], max_val=state["scaler_max"]
+            )
+        return model
+
+
+@jax.jit
+def _ovr_scores(Xq, X_sv, coef, b, gamma):
+    K = rbf_cross(Xq, X_sv, gamma, snB=sq_norms(X_sv))  # (m, n_sv)
+    return K @ coef.T - b[None, :]

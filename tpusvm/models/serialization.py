@@ -1,0 +1,57 @@
+"""Model persistence: save/load = (SV X, Y, alpha, ids, b, scaler, config).
+
+The reference intended but never enabled model persistence — the final-model
+dump is commented out (mpi_svm_main3.cpp:754-770: final_sv_ids/labels/
+alphas/b.txt). This implements that intent properly as a single .npz
+(SURVEY.md §5.4): everything needed to predict — support vectors, duals,
+bias, the train-set min/max of the scaler, and the hyperparameters.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict
+
+import numpy as np
+
+from tpusvm.config import SVMConfig
+
+_FORMAT_VERSION = 1
+
+
+def _norm(path: str) -> str:
+    # np.savez appends ".npz" to suffix-less paths; normalise so save/load
+    # agree on the actual filename
+    return path if path.endswith(".npz") else path + ".npz"
+
+
+def save_model(path: str, state: Dict[str, Any], config: SVMConfig) -> None:
+    np.savez_compressed(
+        _norm(path),
+        format_version=_FORMAT_VERSION,
+        **state,
+        **{f"config_{k}": v for k, v in dataclasses.asdict(config).items()},
+    )
+
+
+def load_model(path: str):
+    """Returns (state dict, SVMConfig)."""
+    with np.load(_norm(path), allow_pickle=False) as z:
+        version = int(z["format_version"])
+        if version != _FORMAT_VERSION:
+            raise ValueError(f"unsupported model format version {version}")
+        cfg_fields = {f.name for f in dataclasses.fields(SVMConfig)}
+        cfg_kwargs = {}
+        state = {}
+        for key in z.files:
+            if key == "format_version":
+                continue
+            if key.startswith("config_"):
+                name = key[len("config_"):]
+                if name in cfg_fields:
+                    val = z[key].item()
+                    ftype = SVMConfig.__dataclass_fields__[name].type
+                    cfg_kwargs[name] = int(val) if ftype == "int" else float(val)
+            else:
+                state[key] = z[key]
+    return state, SVMConfig(**cfg_kwargs)

@@ -1,0 +1,200 @@
+"""User-facing binary SVM estimator.
+
+The reference's user interface is "edit the hardcoded dataset string and
+constants in main(), recompile" (main3.cpp:306-347, SURVEY.md §5.6). This
+class is the framework replacement: scikit-learn-flavoured fit/predict over
+the TPU-native solver, with both single-chip (gpu_svm_main3.cu capability)
+and distributed-cascade (mpi_svm_main*.cpp capability) training paths, and
+proper model persistence.
+
+Pipeline parity with the reference (main3.cpp:335-405):
+  fit:      min-max scale on TRAIN data -> SMO -> extract SVs
+  predict:  scale with TRAIN min/max -> sign(sum_sv a_k y_k K(x,x_k) - b)
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+import warnings
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tpusvm.config import CascadeConfig, SVMConfig
+from tpusvm.data.scaler import MinMaxScaler
+from tpusvm.models.serialization import load_model, save_model
+from tpusvm.oracle.smo import get_sv_indices
+from tpusvm.parallel.cascade import cascade_fit
+from tpusvm.solver.predict import decision_function as _decision
+from tpusvm.solver.smo import smo_solve
+from tpusvm.status import Status
+
+
+class BinarySVC:
+    """Binary RBF-kernel SVM trained with on-device SMO.
+
+    Attributes after fit: sv_X_, sv_Y_, sv_alpha_, sv_ids_, b_, n_iter_,
+    status_, train_time_s_, scaler_.
+    """
+
+    def __init__(
+        self,
+        config: SVMConfig = SVMConfig(),
+        dtype=jnp.float32,
+        scale: bool = True,
+        accum_dtype=None,
+    ):
+        """accum_dtype: solver accumulator dtype (see smo_solve) — pass
+        jnp.float64 with f32 features for the mixed-precision mode that
+        matches the f64 reference's convergence behaviour at f32 speed
+        (requires jax x64)."""
+        self.config = config
+        self.dtype = dtype
+        self.scale = scale
+        self.accum_dtype = accum_dtype
+        self.scaler_: Optional[MinMaxScaler] = None
+        self.sv_X_: Optional[np.ndarray] = None
+        self.sv_Y_: Optional[np.ndarray] = None
+        self.sv_alpha_: Optional[np.ndarray] = None
+        self.sv_ids_: Optional[np.ndarray] = None
+        self.b_: float = 0.0
+        self.n_iter_: int = 0
+        self.status_: Status = Status.RUNNING
+        self.train_time_s_: float = 0.0
+
+    # ------------------------------------------------------------------ fit
+    def _scale_fit(self, X: np.ndarray) -> np.ndarray:
+        if self.scale:
+            self.scaler_ = MinMaxScaler().fit(X)
+            return self.scaler_.transform(X)
+        return X
+
+    def fit(self, X: np.ndarray, Y: np.ndarray) -> "BinarySVC":
+        """Single-chip on-device SMO training (gpu_svm_main3.cu capability)."""
+        cfg = self.config
+        t0 = time.perf_counter()
+        Xs = self._scale_fit(np.asarray(X))
+        res = smo_solve(
+            jnp.asarray(Xs, self.dtype),
+            jnp.asarray(Y),
+            C=cfg.C,
+            gamma=cfg.gamma,
+            eps=cfg.eps,
+            tau=cfg.tau,
+            max_iter=cfg.max_iter,
+            accum_dtype=self.accum_dtype,
+        )
+        alpha = np.asarray(res.alpha)  # device->host copy = completion barrier
+        self.train_time_s_ = time.perf_counter() - t0
+        sv = get_sv_indices(alpha, cfg.sv_tol)
+        self.sv_X_ = Xs[sv]
+        self.sv_Y_ = np.asarray(Y)[sv].astype(np.int32)
+        self.sv_alpha_ = alpha[sv]
+        self.sv_ids_ = sv.astype(np.int32)
+        self.b_ = float(res.b)
+        self.n_iter_ = int(res.n_iter)
+        self.status_ = Status(int(res.status))
+        if self.status_ != Status.CONVERGED:
+            warnings.warn(
+                f"SMO terminated with {self.status_.name} after "
+                f"{self.n_iter_} iterations; the model may be partially "
+                "optimised (for STALLED in float32, try "
+                "accum_dtype=jnp.float64)",
+                RuntimeWarning,
+                stacklevel=2,
+            )
+        return self
+
+    def fit_cascade(
+        self,
+        X: np.ndarray,
+        Y: np.ndarray,
+        cascade_config: CascadeConfig = CascadeConfig(),
+        mesh=None,
+        verbose: bool = False,
+    ) -> "BinarySVC":
+        """Distributed cascade training over a device mesh (MPI capability)."""
+        t0 = time.perf_counter()
+        Xs = self._scale_fit(np.asarray(X))
+        res = cascade_fit(
+            Xs, Y, self.config, cascade_config, mesh=mesh, dtype=self.dtype,
+            accum_dtype=self.accum_dtype, verbose=verbose,
+        )
+        self.train_time_s_ = time.perf_counter() - t0
+        self.sv_X_ = res.sv_X
+        self.sv_Y_ = res.sv_Y
+        self.sv_alpha_ = res.sv_alpha
+        self.sv_ids_ = res.sv_ids
+        self.b_ = res.b
+        self.n_iter_ = int(sum(h["iters"].sum() for h in res.history))
+        self.status_ = (
+            Status.CONVERGED if res.converged else Status.MAX_ITER
+        )
+        self.cascade_history_ = res.history
+        self.cascade_rounds_ = res.rounds
+        return self
+
+    # -------------------------------------------------------------- predict
+    def _check_fitted(self):
+        if self.sv_X_ is None:
+            raise RuntimeError("model is not fitted")
+
+    def decision_function(self, X: np.ndarray) -> np.ndarray:
+        self._check_fitted()
+        Xs = self.scaler_.transform(np.asarray(X)) if self.scale else np.asarray(X)
+        coef = jnp.asarray(self.sv_alpha_ * self.sv_Y_, self.dtype)
+        scores = _decision(
+            jnp.asarray(Xs, self.dtype),
+            jnp.asarray(self.sv_X_, self.dtype),
+            coef,
+            jnp.asarray(self.b_, self.dtype),
+            gamma=self.config.gamma,
+        )
+        return np.asarray(scores)
+
+    def predict(self, X: np.ndarray) -> np.ndarray:
+        # strict > 0 -> +1, the oracle convention (main3.cpp:399)
+        return np.where(self.decision_function(X) > 0, 1, -1).astype(np.int32)
+
+    def score(self, X: np.ndarray, Y: np.ndarray) -> float:
+        return float((self.predict(X) == np.asarray(Y)).mean())
+
+    @property
+    def n_support_(self) -> int:
+        self._check_fitted()
+        return len(self.sv_alpha_)
+
+    # ---------------------------------------------------------- persistence
+    def save(self, path: str) -> None:
+        self._check_fitted()
+        state = {
+            "sv_X": self.sv_X_,
+            "sv_Y": self.sv_Y_,
+            "sv_alpha": self.sv_alpha_,
+            "sv_ids": self.sv_ids_,
+            "b": self.b_,
+            "scale": self.scale,
+        }
+        if self.scale:
+            state["scaler_min"] = self.scaler_.min_val
+            state["scaler_max"] = self.scaler_.max_val
+        save_model(path, state, self.config)
+
+    @classmethod
+    def load(cls, path: str, dtype=jnp.float32) -> "BinarySVC":
+        state, config = load_model(path)
+        model = cls(config=config, dtype=dtype, scale=bool(state["scale"]))
+        model.sv_X_ = state["sv_X"]
+        model.sv_Y_ = state["sv_Y"]
+        model.sv_alpha_ = state["sv_alpha"]
+        model.sv_ids_ = state["sv_ids"]
+        model.b_ = float(state["b"])
+        if model.scale:
+            model.scaler_ = MinMaxScaler(
+                min_val=state["scaler_min"], max_val=state["scaler_max"]
+            )
+        model.status_ = Status.CONVERGED
+        return model

@@ -3,6 +3,7 @@ from tpusvm.ops.rbf import (
     rbf_matvec,
     rbf_row,
     rbf_rows_at,
+    rbf_rows_at_direct,
     sq_norms,
 )
 from tpusvm.ops.selection import (
@@ -17,6 +18,7 @@ __all__ = [
     "rbf_matvec",
     "rbf_row",
     "rbf_rows_at",
+    "rbf_rows_at_direct",
     "sq_norms",
     "i_high_mask",
     "i_low_mask",

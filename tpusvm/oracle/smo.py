@@ -173,6 +173,11 @@ def smo_train(
         alpha[i_low] = a_low_new
 
         n_iter += 1
+        if da_high == 0.0 and da_low == 0.0:
+            # zero-change update: the same pair would be re-selected forever
+            # (the reference would spin to max_iter here); see Status.STALLED
+            status = Status.STALLED
+            break
         if n_iter > config.max_iter:
             status = Status.MAX_ITER
             break

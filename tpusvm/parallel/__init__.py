@@ -1,0 +1,27 @@
+from tpusvm.parallel.cascade import CascadeResult, cascade_fit
+from tpusvm.parallel.mesh import CASCADE_AXIS, make_mesh, replicate, shard_leading
+from tpusvm.parallel.svbuffer import (
+    SVBuffer,
+    compact,
+    dedup_first,
+    empty,
+    extract_svs,
+    from_arrays,
+    merge_dedup,
+)
+
+__all__ = [
+    "CascadeResult",
+    "cascade_fit",
+    "CASCADE_AXIS",
+    "make_mesh",
+    "replicate",
+    "shard_leading",
+    "SVBuffer",
+    "compact",
+    "dedup_first",
+    "empty",
+    "extract_svs",
+    "from_arrays",
+    "merge_dedup",
+]

@@ -1,0 +1,50 @@
+"""Device mesh helpers.
+
+The reference's process geometry is `mpirun -np P` over cluster nodes
+(code/mpi_svm3.sh); here it is a 1-D jax.sharding.Mesh over TPU chips whose
+axis carries the cascade's SV-exchange traffic on ICI. On a host without P
+real chips, tests use XLA's host-platform device simulation
+(tests/conftest.py) and the same code runs on virtual CPU devices.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+CASCADE_AXIS = "cascade"
+
+
+def make_mesh(
+    n_shards: Optional[int] = None,
+    devices: Optional[Sequence[jax.Device]] = None,
+    axis: str = CASCADE_AXIS,
+) -> Mesh:
+    """1-D mesh over the first n_shards devices (default: all)."""
+    if devices is None:
+        devices = jax.devices()
+    if n_shards is not None:
+        if n_shards > len(devices):
+            raise ValueError(
+                f"requested {n_shards} shards but only {len(devices)} devices"
+            )
+        devices = devices[:n_shards]
+    return Mesh(np.asarray(devices), (axis,))
+
+
+def shard_leading(mesh: Mesh, tree, axis: str = CASCADE_AXIS):
+    """device_put each array with its leading dim sharded over the mesh axis."""
+    def put(x):
+        spec = P(axis, *([None] * (x.ndim - 1)))
+        return jax.device_put(x, NamedSharding(mesh, spec))
+    return jax.tree.map(put, tree)
+
+
+def replicate(mesh: Mesh, tree):
+    """device_put each array fully replicated over the mesh."""
+    def put(x):
+        return jax.device_put(x, NamedSharding(mesh, P()))
+    return jax.tree.map(put, tree)

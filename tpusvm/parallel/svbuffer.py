@@ -1,0 +1,153 @@
+"""Fixed-capacity padded support-vector buffers + masked dedup/compaction.
+
+The reference's cascade passes dynamically-sized SV sets between ranks as
+(count, X, Y, alpha, ID) message groups (mpi_svm_main3.cpp:692-716) and
+dedups them with an unordered_set of global IDs (mpi_svm_main3.cpp:628-655).
+XLA requires static shapes, so SV sets become capacity-padded buffers with a
+validity mask (SURVEY.md §2.4, §7.3 "Dynamic shapes"), and the hash-set dedup
+becomes a lexicographic sort by (id, position): the first occurrence of each
+id survives, which reproduces the reference's sequential insert-if-new
+semantics exactly (earlier positions win).
+
+All functions here are pure jnp and run unchanged inside shard_map.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+class SVBuffer(NamedTuple):
+    """A padded SV set. Rows with valid=False are padding.
+
+    X:     (cap, d)   features
+    Y:     (cap,)     labels in {+1,-1}; 0 in padding
+    alpha: (cap,)     dual variables; 0 in padding
+    ids:   (cap,) int32 global sample IDs; -1 in padding
+    valid: (cap,) bool
+    """
+
+    X: jax.Array
+    Y: jax.Array
+    alpha: jax.Array
+    ids: jax.Array
+    valid: jax.Array
+
+    @property
+    def capacity(self) -> int:
+        return self.Y.shape[0]
+
+    def count(self) -> jax.Array:
+        return jnp.sum(self.valid).astype(jnp.int32)
+
+
+def empty(cap: int, d: int, dtype=jnp.float32) -> SVBuffer:
+    return SVBuffer(
+        X=jnp.zeros((cap, d), dtype),
+        Y=jnp.zeros((cap,), jnp.int32),
+        alpha=jnp.zeros((cap,), dtype),
+        ids=jnp.full((cap,), -1, jnp.int32),
+        valid=jnp.zeros((cap,), bool),
+    )
+
+
+def from_arrays(X, Y, alpha, ids, valid) -> SVBuffer:
+    return SVBuffer(
+        X=X,
+        Y=Y.astype(jnp.int32),
+        alpha=alpha.astype(X.dtype),
+        ids=ids.astype(jnp.int32),
+        valid=valid.astype(bool),
+    )
+
+
+def compact(buf: SVBuffer, cap_out: int) -> Tuple[SVBuffer, jax.Array]:
+    """Pack valid rows to the front (stable order) into a cap_out buffer.
+
+    Returns (packed buffer, valid count). Rows beyond cap_out are dropped —
+    callers must check count <= cap_out for overflow.
+    """
+    cap_in, d = buf.X.shape
+    count = buf.count()
+    # destination slot for each row; invalid / overflowing rows -> cap_out (drop)
+    pos = jnp.cumsum(buf.valid.astype(jnp.int32)) - 1
+    dest = jnp.where(buf.valid, pos, cap_out)
+    out = empty(cap_out, d, buf.X.dtype)
+    out = SVBuffer(
+        X=out.X.at[dest].set(buf.X, mode="drop"),
+        Y=out.Y.at[dest].set(buf.Y, mode="drop"),
+        alpha=out.alpha.at[dest].set(buf.alpha, mode="drop"),
+        ids=out.ids.at[dest].set(buf.ids, mode="drop"),
+        valid=out.valid.at[dest].set(buf.valid, mode="drop"),
+    )
+    return out, count
+
+
+def dedup_first(buf: SVBuffer) -> SVBuffer:
+    """Invalidate duplicate ids, keeping the FIRST valid occurrence.
+
+    Sort-based replacement for the reference's unordered_set insert-if-new
+    loop (mpi_svm_main3.cpp:644-655): lexicographic sort by (id, position),
+    mark rows whose id equals the previous sorted row's id as duplicates,
+    scatter the keep-mask back to original positions. O(cap log cap), static
+    shapes, no host round trip.
+    """
+    cap = buf.ids.shape[0]
+    big = jnp.int32(2**31 - 1)
+    key = jnp.where(buf.valid, buf.ids, big)  # invalid rows sort to the end
+    pos = jnp.arange(cap, dtype=jnp.int32)
+    sorted_key, sorted_pos = lax.sort((key, pos), num_keys=2)
+    first = jnp.concatenate(
+        [jnp.ones((1,), bool), sorted_key[1:] != sorted_key[:-1]]
+    )
+    keep_sorted = first & (sorted_key != big)
+    keep = jnp.zeros((cap,), bool).at[sorted_pos].set(keep_sorted)
+    return buf._replace(valid=buf.valid & keep)
+
+
+def merge_dedup(
+    primary: SVBuffer, secondary: SVBuffer, cap_out: int,
+) -> Tuple[SVBuffer, jax.Array]:
+    """Union of two SV sets with the cascade's exact alpha semantics.
+
+    Primary rows keep their alpha (warm start); secondary rows get alpha = 0
+    and are dropped when their id already appears in primary (or earlier in
+    secondary). This is precisely the reference's union builder:
+      - tree:  primary = received SVs (warm), secondary = own set, alpha=0
+               (mpi_svm_main3.cpp:628-655)
+      - star:  primary = rank0's own SVs (warm), secondary = workers' SVs,
+               alpha reset to 0 (mpi_svm_main2.cpp:596-604)
+      - round start: primary = broadcast global SVs (warm), secondary = local
+               partition (mpi_svm_main2.cpp:481-502)
+
+    Returns (merged buffer of capacity cap_out, pre-truncation valid count).
+    count > cap_out means overflow: rows were dropped and the caller should
+    raise/grow capacity.
+    """
+    cat = SVBuffer(
+        X=jnp.concatenate([primary.X, secondary.X]),
+        Y=jnp.concatenate([primary.Y, secondary.Y]),
+        alpha=jnp.concatenate([primary.alpha, jnp.zeros_like(secondary.alpha)]),
+        ids=jnp.concatenate([primary.ids, secondary.ids]),
+        valid=jnp.concatenate([primary.valid, secondary.valid]),
+    )
+    return compact(dedup_first(cat), cap_out)
+
+
+def extract_svs(
+    train: SVBuffer, alpha: jax.Array, sv_tol: float, cap_out: int,
+) -> Tuple[SVBuffer, jax.Array]:
+    """Keep rows with alpha > sv_tol (get_SV_indices, main3.cpp:297-304).
+
+    Returns (SV buffer of capacity cap_out, pre-truncation SV count).
+    """
+    is_sv = train.valid & (alpha > sv_tol)
+    buf = SVBuffer(
+        X=train.X, Y=train.Y, alpha=alpha.astype(train.X.dtype),
+        ids=train.ids, valid=is_sv,
+    )
+    return compact(buf, cap_out)

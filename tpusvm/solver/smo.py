@@ -36,7 +36,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from tpusvm.config import SVMConfig
-from tpusvm.ops.rbf import rbf_matvec, rbf_rows_at
+from tpusvm.ops.rbf import rbf_matvec, rbf_rows_at, sq_norms
 from tpusvm.ops.selection import (
     i_high_mask,
     i_low_mask,
@@ -70,7 +70,7 @@ class SMOResult(NamedTuple):
     status: jax.Array
 
 
-def _body(state: SMOState, X, Y, valid, C, gamma, eps, tau, max_iter):
+def _body(state: SMOState, X, Y, valid, sn, C, gamma, eps, tau, max_iter):
     alpha, f = state.alpha, state.f
     n = Y.shape[0]
 
@@ -95,7 +95,7 @@ def _body(state: SMOState, X, Y, valid, C, gamma, eps, tau, max_iter):
         # One fused pass computes both rows; lax.cond skips it entirely when
         # neither index changed (both-cached iterations are common: the pair
         # often repeats while alpha walks along the box boundary).
-        rows = rbf_rows_at(X, jnp.stack([i_high, i_low]), gamma)
+        rows = rbf_rows_at(X, jnp.stack([i_high, i_low]), gamma, sn)
         kh = jnp.where(need_h, rows[0], state.k_high)
         kl = jnp.where(need_l, rows[1], state.k_low)
         return kh, kl
@@ -105,12 +105,16 @@ def _body(state: SMOState, X, Y, valid, C, gamma, eps, tau, max_iter):
     )
 
     # --- analytic 2-variable update (main3.cpp:234-279) -------------------
-    y_h = Y[i_high].astype(X.dtype)
-    y_l = Y[i_low].astype(X.dtype)
+    # Scalar math runs in the accumulator dtype (= f.dtype): with the
+    # mixed-precision mode (f32 features, f64 accumulators) the tiny
+    # near-convergence updates stay representable (SURVEY.md §7.3 Precision).
+    adt = f.dtype
+    y_h = Y[i_high].astype(adt)
+    y_l = Y[i_low].astype(adt)
     s = y_h * y_l
-    K11 = k_high[i_high]
-    K22 = k_low[i_low]
-    K12 = k_high[i_low]
+    K11 = k_high[i_high].astype(adt)
+    K22 = k_low[i_low].astype(adt)
+    K12 = k_high[i_low].astype(adt)
     eta = K11 + K22 - 2.0 * K12
 
     a_h = alpha[i_high]
@@ -127,11 +131,16 @@ def _body(state: SMOState, X, Y, valid, C, gamma, eps, tau, max_iter):
     a_l_new = jnp.maximum(jnp.minimum(a_l_new, V), U)
     a_h_new = a_h + s * (a_l - a_l_new)
 
-    da_h = jnp.where(do_update, a_h_new - a_h, 0.0)
-    da_l = jnp.where(do_update, a_l_new - a_l, 0.0)
+    da_h = jnp.where(do_update, a_h_new - a_h, jnp.zeros_like(a_h))
+    da_l = jnp.where(do_update, a_l_new - a_l, jnp.zeros_like(a_l))
+    # A zero-change update means the deterministic selection will re-pick the
+    # same pair forever (see Status.STALLED) — terminate instead of spinning.
+    stalled = do_update & (da_h == 0) & (da_l == 0)
 
     # --- error-vector update (main3.cpp:271-275 / update_f kernel) --------
-    f = f + da_h * y_h * k_high + da_l * y_l * k_low
+    fdt = f.dtype
+    f = f + da_h * y_h.astype(fdt) * k_high.astype(fdt) \
+          + da_l * y_l.astype(fdt) * k_low.astype(fdt)
     alpha = alpha.at[i_high].add(da_h)
     alpha = alpha.at[i_low].add(da_l)
 
@@ -151,7 +160,13 @@ def _body(state: SMOState, X, Y, valid, C, gamma, eps, tau, max_iter):
                 jnp.where(
                     ~eta_ok,
                     Status.NONPOS_ETA,
-                    jnp.where(n_iter > max_iter, Status.MAX_ITER, Status.RUNNING),
+                    jnp.where(
+                        stalled,
+                        Status.STALLED,
+                        jnp.where(
+                            n_iter > max_iter, Status.MAX_ITER, Status.RUNNING
+                        ),
+                    ),
                 ),
             ),
         ),
@@ -171,9 +186,11 @@ def _body(state: SMOState, X, Y, valid, C, gamma, eps, tau, max_iter):
     )
 
 
-# Only max_iter/warm_start are static: the float hyperparameters are traced
-# scalars so a C/gamma grid search reuses one compiled solver.
-@functools.partial(jax.jit, static_argnames=("max_iter", "warm_start"))
+# Only max_iter/warm_start/accum_dtype are static: the float hyperparameters
+# are traced scalars so a C/gamma grid search reuses one compiled solver.
+@functools.partial(
+    jax.jit, static_argnames=("max_iter", "warm_start", "accum_dtype")
+)
 def smo_solve(
     X: jax.Array,
     Y: jax.Array,
@@ -186,6 +203,7 @@ def smo_solve(
     tau: float = 1e-5,
     max_iter: int = 100000,
     warm_start: bool = False,
+    accum_dtype=None,
 ) -> SMOResult:
     """Run SMO to termination entirely on device.
 
@@ -195,20 +213,25 @@ def smo_solve(
       valid: (n,) bool mask of real rows; None = all valid.
       alpha0: warm-start duals (cascade); zeros if None.
       warm_start: reconstruct f from alpha0 via a blocked MXU matvec.
+      accum_dtype: dtype of f/alpha/scalar math (default: X.dtype). Pass
+        jnp.float64 with float32 X for the mixed-precision mode: kernel rows
+        stay f32 (full HBM-bandwidth win) while the O(n) accumulators match
+        the f64 reference's ability to resolve tiny near-convergence updates.
 
     Returns SMOResult; `alpha` of padded rows is guaranteed 0.
     """
     n = Y.shape[0]
     dtype = X.dtype
+    adt = dtype if accum_dtype is None else accum_dtype
     if valid is None:
         valid = jnp.ones((n,), bool)
     if alpha0 is None:
-        alpha0 = jnp.zeros((n,), dtype)
-    alpha0 = jnp.where(valid, alpha0, 0.0).astype(dtype)
+        alpha0 = jnp.zeros((n,), adt)
+    alpha0 = jnp.where(valid, alpha0, 0.0).astype(adt)
 
-    yf = Y.astype(dtype)
+    yf = Y.astype(adt)
     if warm_start:
-        f0 = rbf_matvec(X, alpha0 * yf, gamma) - yf
+        f0 = rbf_matvec(X, (alpha0 * yf).astype(dtype), gamma).astype(adt) - yf
     else:
         f0 = -yf
     # Padded rows never enter the index sets; park their f at 0 for tidiness.
@@ -221,14 +244,17 @@ def smo_solve(
         k_low=jnp.zeros((n,), dtype),
         i_high_prev=jnp.int32(n),
         i_low_prev=jnp.int32(n),
-        b_high=jnp.array(jnp.nan, dtype),
-        b_low=jnp.array(jnp.nan, dtype),
+        b_high=jnp.array(jnp.nan, adt),
+        b_low=jnp.array(jnp.nan, adt),
         n_iter=jnp.int32(1),
         status=jnp.int32(Status.RUNNING),
     )
 
+    # Row squared-norms hoisted out of the loop: the dot-form kernel-row
+    # refresh then streams X from HBM exactly once per iteration.
+    sn = sq_norms(X)
     body = functools.partial(
-        _body, X=X, Y=Y, valid=valid, C=C, gamma=gamma, eps=eps,
+        _body, X=X, Y=Y, valid=valid, sn=sn, C=C, gamma=gamma, eps=eps,
         tau=tau, max_iter=max_iter,
     )
     final = lax.while_loop(

@@ -10,6 +10,14 @@ Reference exit paths in SMO_train (main3.cpp:200-288):
   - INFEASIBLE_UV:  U > V + 1e-12                      (main3.cpp:246-250)
   - NONPOS_ETA:     eta <= 1e-12                       (main3.cpp:253-257)
   - MAX_ITER:       more than max_iter updates         (main3.cpp:283-287)
+
+One addition beyond the reference:
+  - STALLED: the selected pair's update rounded to exactly zero change
+    (alpha and f unchanged), so the deterministic selection would pick the
+    same pair forever — the reference would spin to max_iter in this state
+    (possible in float32, or with a pair pinned at its box bound). Both the
+    oracle and the on-device solver terminate immediately instead; b is
+    still (b_high + b_low)/2 of the final iteration.
 """
 
 import enum
@@ -22,3 +30,4 @@ class Status(enum.IntEnum):
     INFEASIBLE_UV = 3
     NONPOS_ETA = 4
     MAX_ITER = 5
+    STALLED = 6
