@@ -1,0 +1,109 @@
+"""Blocked working-set solver vs. oracle: solution-level parity.
+
+The blocked solver intentionally follows a different iteration trajectory
+(many updates per X pass); the reference's own parity criterion — identical
+SV set, b within tolerance, same stopping rule satisfied — is what must
+hold (SURVEY.md §4).
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpusvm.config import SVMConfig
+from tpusvm.data import MinMaxScaler, blobs, rings
+from tpusvm.oracle import get_sv_indices, smo_train
+from tpusvm.solver import blocked_smo_solve
+from tpusvm.status import Status
+
+
+def _data(gen, **kw):
+    X, Y = gen(**kw)
+    return MinMaxScaler().fit_transform(X), Y
+
+
+@pytest.mark.parametrize(
+    "gen,kw,cfg,q",
+    [
+        (rings, dict(n=512, seed=5), SVMConfig(C=10.0, gamma=10.0), 64),
+        (rings, dict(n=512, seed=5), SVMConfig(C=10.0, gamma=10.0), 1024),
+        (blobs, dict(n=151, d=5, seed=7), SVMConfig(C=1.0, gamma=0.125), 32),
+    ],
+)
+def test_blocked_matches_oracle(gen, kw, cfg, q):
+    Xs, Y = _data(gen, **kw)
+    o = smo_train(Xs, Y, cfg)
+    r = blocked_smo_solve(
+        jnp.asarray(Xs), jnp.asarray(Y),
+        C=cfg.C, gamma=cfg.gamma, eps=cfg.eps, tau=cfg.tau, q=q,
+    )
+    assert int(r.status) == Status.CONVERGED
+    # the reference's stopping rule must actually be satisfied
+    assert float(r.b_low) <= float(r.b_high) + 2 * cfg.tau
+    np.testing.assert_array_equal(
+        get_sv_indices(np.asarray(r.alpha)), get_sv_indices(o.alpha)
+    )
+    np.testing.assert_allclose(float(r.b), o.b, atol=1e-4)
+    np.testing.assert_allclose(np.asarray(r.alpha), o.alpha, atol=1e-3)
+
+
+def test_blocked_padding_invariance():
+    Xs, Y = _data(blobs, n=100, seed=3)
+    r = blocked_smo_solve(
+        jnp.asarray(Xs), jnp.asarray(Y), C=1.0, gamma=0.125, q=32,
+    )
+    pad = 28
+    Xp = np.concatenate([Xs, np.zeros((pad, Xs.shape[1]))])
+    Yp = np.concatenate([Y, np.zeros(pad, np.int32)])
+    valid = np.concatenate([np.ones(100, bool), np.zeros(pad, bool)])
+    rp = blocked_smo_solve(
+        jnp.asarray(Xp), jnp.asarray(Yp), valid=jnp.asarray(valid),
+        C=1.0, gamma=0.125, q=32,
+    )
+    assert (np.asarray(rp.alpha)[100:] == 0).all()
+    np.testing.assert_array_equal(
+        get_sv_indices(np.asarray(rp.alpha)[:100]),
+        get_sv_indices(np.asarray(r.alpha)),
+    )
+    np.testing.assert_allclose(float(rp.b), float(r.b), atol=1e-6)
+
+
+def test_blocked_warm_start():
+    Xs, Y = _data(blobs, n=90, seed=9)
+    r = blocked_smo_solve(jnp.asarray(Xs), jnp.asarray(Y), C=1.0, gamma=0.125, q=32)
+    r2 = blocked_smo_solve(
+        jnp.asarray(Xs), jnp.asarray(Y), alpha0=r.alpha,
+        C=1.0, gamma=0.125, q=32, warm_start=True,
+    )
+    assert int(r2.status) == Status.CONVERGED
+    assert int(r2.n_iter) == 1  # converged at the first global check
+    np.testing.assert_allclose(np.asarray(r2.alpha), np.asarray(r.alpha))
+
+
+def test_blocked_single_class_no_working_set():
+    Xs, Y = _data(blobs, n=64, seed=1)
+    r = blocked_smo_solve(
+        jnp.asarray(Xs), jnp.ones(64, jnp.int32), C=1.0, gamma=0.5, q=16,
+    )
+    assert int(r.status) == Status.NO_WORKING_SET
+    assert (np.asarray(r.alpha) == 0).all()
+
+
+def test_blocked_respects_max_iter():
+    Xs, Y = _data(rings, n=512, seed=5)
+    r = blocked_smo_solve(
+        jnp.asarray(Xs), jnp.asarray(Y), C=10.0, gamma=10.0,
+        max_iter=10, q=16, max_inner=4,
+    )
+    assert int(r.status) == Status.MAX_ITER
+    # checked between outer rounds: overshoot bounded by max_inner
+    assert int(r.n_iter) - 1 < 10 + 4
+
+
+def test_blocked_surfaces_nonpos_eta():
+    # duplicate points with opposite labels: eta == 0 on the first pair —
+    # must report NONPOS_ETA like the pairwise solver, not generic STALLED
+    Xd = np.zeros((4, 2))
+    Yd = np.array([1, -1, 1, -1], np.int32)
+    r = blocked_smo_solve(jnp.asarray(Xd), jnp.asarray(Yd), C=1.0, gamma=0.5, q=4)
+    assert int(r.status) == Status.NONPOS_ETA

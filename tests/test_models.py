@@ -113,6 +113,6 @@ def test_fit_warns_on_non_convergence():
     with w.catch_warnings(record=True) as rec:
         w.simplefilter("always")
         m = BinarySVC(SVMConfig(C=10.0, gamma=10.0, max_iter=3),
-                      dtype=jnp.float64).fit(X, Y)
+                      dtype=jnp.float64, solver="pair").fit(X, Y)
     assert m.status_ == Status.MAX_ITER
     assert any("MAX_ITER" in str(r.message) for r in rec)

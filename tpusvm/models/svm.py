@@ -28,6 +28,7 @@ from tpusvm.data.scaler import MinMaxScaler
 from tpusvm.models.serialization import load_model, save_model
 from tpusvm.oracle.smo import get_sv_indices
 from tpusvm.parallel.cascade import cascade_fit
+from tpusvm.solver.blocked import blocked_smo_solve
 from tpusvm.solver.predict import decision_function as _decision
 from tpusvm.solver.smo import smo_solve
 from tpusvm.status import Status
@@ -46,15 +47,29 @@ class BinarySVC:
         dtype=jnp.float32,
         scale: bool = True,
         accum_dtype=None,
+        solver: str = "blocked",
+        solver_opts: Optional[dict] = None,
     ):
         """accum_dtype: solver accumulator dtype (see smo_solve) — pass
         jnp.float64 with f32 features for the mixed-precision mode that
         matches the f64 reference's convergence behaviour at f32 speed
-        (requires jax x64)."""
+        (requires jax x64).
+
+        solver: "blocked" (default — the TPU-first working-set solver,
+        solver/blocked.py) or "pair" (the reference-faithful one-pair-per-
+        iteration solver, solver/smo.py). SVMConfig.max_iter bounds total
+        alpha updates in both.
+
+        solver_opts: extra static solver knobs forwarded to the solve call
+        (blocked: q, max_outer, max_inner)."""
+        if solver not in ("blocked", "pair"):
+            raise ValueError(f"unknown solver {solver!r}")
         self.config = config
         self.dtype = dtype
         self.scale = scale
         self.accum_dtype = accum_dtype
+        self.solver = solver
+        self.solver_opts = dict(solver_opts or {})
         self.scaler_: Optional[MinMaxScaler] = None
         self.sv_X_: Optional[np.ndarray] = None
         self.sv_Y_: Optional[np.ndarray] = None
@@ -77,7 +92,8 @@ class BinarySVC:
         cfg = self.config
         t0 = time.perf_counter()
         Xs = self._scale_fit(np.asarray(X))
-        res = smo_solve(
+        solve = blocked_smo_solve if self.solver == "blocked" else smo_solve
+        res = solve(
             jnp.asarray(Xs, self.dtype),
             jnp.asarray(Y),
             C=cfg.C,
@@ -86,6 +102,7 @@ class BinarySVC:
             tau=cfg.tau,
             max_iter=cfg.max_iter,
             accum_dtype=self.accum_dtype,
+            **self.solver_opts,
         )
         alpha = np.asarray(res.alpha)  # device->host copy = completion barrier
         self.train_time_s_ = time.perf_counter() - t0

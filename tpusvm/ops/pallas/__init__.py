@@ -1,0 +1,3 @@
+from tpusvm.ops.pallas.rows import rbf_two_rows
+
+__all__ = ["rbf_two_rows"]

@@ -1,0 +1,92 @@
+"""Pallas TPU kernel: fused two-row RBF computation for the SMO hot loop.
+
+This is the BASELINE-named Pallas target (SURVEY.md §7.1): the TPU-native
+replacement for the reference's per-iteration calc_kernel_matrix launches
+(gpu_svm_main3.cu:137-147, launched twice per iteration at :395-411).
+
+One kernel produces BOTH needed rows K(x_{i_high}, .) and K(x_{i_low}, .) in
+a single pass: the grid walks n in TILE_N-row blocks; each step streams one
+(TILE_N, d) block of X from HBM into VMEM exactly once and
+  - computes the block's row squared-norms on the VPU (no separate sq_norms
+    array read),
+  - does the two multiply-reduce contractions on the VPU (a (d, 2) MXU
+    matmul would waste 126 of 128 output columns and become compute-bound),
+  - fuses the -gamma * d^2 -> exp into the same block,
+so HBM traffic is exactly one read of X per refresh.
+
+STATUS: experimental, not wired into the solvers. On this environment's
+TPU runtime it benchmarks at parity with the XLA dot-form rbf_rows_at
+(~530 us for 60k x 896 f32 — both near the platform's observed practical
+bandwidth), and the blocked working-set solver (solver/blocked.py) made the
+per-iteration row refresh a non-bottleneck altogether. Kept, tested in
+interpret mode (tests/test_pallas.py), as the starting point for future
+kernel-level tuning (e.g. fusing the f-update and selection partials into
+the same X pass for the pairwise solver).
+
+Shapes must be aligned: n % TILE_N == 0 and d % 128 == 0 — callers pad
+(MNIST's d=784 pads to 896).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+TILE_N = 512
+LANE = 128
+
+
+def _rows_kernel(x_ref, xi_ref, gamma_ref, out_ref):
+    # A (d, 2) contraction would waste 126 of the MXU's 128 output columns
+    # and become compute-bound; the VPU does the two multiply-reduces at
+    # full HBM bandwidth instead (the block is already in VMEM).
+    xb = x_ref[:]                    # (TILE_N, d) block of X
+    xi = xi_ref[:]                   # (2, d) gathered pair, replicated
+    gamma = gamma_ref[0]
+    dot0 = jnp.sum(xb * xi[0][None, :], axis=1)     # (TILE_N,)
+    dot1 = jnp.sum(xb * xi[1][None, :], axis=1)
+    snb = jnp.sum(xb * xb, axis=1)                  # (TILE_N,)
+    sni = jnp.sum(xi * xi, axis=1)                  # (2,)
+    d2 = jnp.stack(
+        [snb + sni[0] - 2.0 * dot0, snb + sni[1] - 2.0 * dot1], axis=1
+    )
+    out_ref[:] = jnp.exp(-gamma * jnp.maximum(d2, 0.0))
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def rbf_two_rows(
+    X: jax.Array, Xi: jax.Array, gamma, *, interpret: bool = False
+) -> jax.Array:
+    """K(Xi[k], X[j]) for the 2 gathered rows Xi. Returns (n, 2) float32.
+
+    Args:
+      X: (n, d) float32, n % TILE_N == 0, d % 128 == 0.
+      Xi: (2, d) float32 — the i_high/i_low rows (gathered outside; a 2-row
+        gather is too small to matter next to the (n, d) stream).
+      gamma: scalar RBF width (traced).
+    """
+    n, d = X.shape
+    if n % TILE_N or d % LANE:
+        raise ValueError(
+            f"rbf_two_rows needs n % {TILE_N} == 0 and d % {LANE} == 0, "
+            f"got {X.shape}; pad first"
+        )
+    gamma_arr = jnp.asarray([gamma], jnp.float32)
+    return pl.pallas_call(
+        _rows_kernel,
+        grid=(n // TILE_N,),
+        in_specs=[
+            pl.BlockSpec((TILE_N, d), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((2, d), lambda i: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+        ],
+        out_specs=pl.BlockSpec(
+            (TILE_N, 2), lambda i: (i, 0), memory_space=pltpu.VMEM
+        ),
+        out_shape=jax.ShapeDtypeStruct((n, 2), jnp.float32),
+        interpret=interpret,
+    )(X, Xi, gamma_arr)

@@ -87,6 +87,49 @@ def rbf_cross(XA: jax.Array, XB: jax.Array, gamma,
     return jnp.exp(-gamma * d2)
 
 
+def rbf_cross_matvec(
+    X: jax.Array, XB: jax.Array, coef: jax.Array, gamma,
+    sn: jax.Array | None = None, block: int = 8192,
+) -> jax.Array:
+    """sum_k coef_k K(x_i, xb_k) for all i, blocked over i. Shape (n,).
+
+    The blocked SMO solver's global error-vector update: after a working-set
+    subproblem changes q alphas, f moves by K(X, X_B) @ (dalpha * y_B) — one
+    (n, d) x (d, q) MXU contraction streamed in n-blocks so the (n, q)
+    kernel slab is never materialised. This is where the blocked solver's
+    FLOPs live, and it is exactly the shape the MXU wants.
+
+    Pass precomputed sn = sq_norms(X) when calling in a loop. Blocks are
+    taken with dynamic slices (no padded copy of X); when block does not
+    divide n, the final block's start is clamped so it re-reads trailing
+    rows, and the overlapping writes carry identical values.
+    """
+    n, d = X.shape
+    block = min(block, n)
+    nb = -(-n // block)
+    if sn is None:
+        sn = sq_norms(X)
+    snB = sq_norms(XB)
+    coef = coef.astype(X.dtype)
+
+    def step(_, start):
+        zero = jnp.zeros((), start.dtype)
+        Xblk = jax.lax.dynamic_slice(X, (start, zero), (block, d))
+        snblk = jax.lax.dynamic_slice(sn, (start,), (block,))
+        d2 = snblk[:, None] + snB[None, :] - 2.0 * (Xblk @ XB.T)
+        d2 = jnp.maximum(d2, 0.0)
+        return None, jnp.exp(-gamma * d2) @ coef
+
+    starts = jnp.minimum(
+        jnp.arange(nb, dtype=jnp.int32) * block, max(n - block, 0)
+    )
+    _, chunks = jax.lax.scan(step, None, starts)
+
+    idx = starts[:, None] + jnp.arange(block, dtype=jnp.int32)[None, :]
+    out = jnp.zeros((n,), X.dtype)
+    return out.at[idx.reshape(-1)].set(chunks.reshape(-1).astype(X.dtype))
+
+
 def rbf_matvec(X: jax.Array, coef: jax.Array, gamma, block: int = 1024
                ) -> jax.Array:
     """sum_j coef_j K(x_j, x_i) for all i, without materialising K.

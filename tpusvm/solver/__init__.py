@@ -1,4 +1,12 @@
+from tpusvm.solver.blocked import blocked_smo_solve
 from tpusvm.solver.predict import decision_function, predict
 from tpusvm.solver.smo import SMOResult, SMOState, smo_solve
 
-__all__ = ["SMOResult", "SMOState", "smo_solve", "decision_function", "predict"]
+__all__ = [
+    "SMOResult",
+    "SMOState",
+    "smo_solve",
+    "blocked_smo_solve",
+    "decision_function",
+    "predict",
+]

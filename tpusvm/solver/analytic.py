@@ -1,0 +1,51 @@
+"""The shared analytic 2-variable SMO update (main3.cpp:145-159, :234-279).
+
+Single source of truth for the numerically delicate scalar step used by both
+the pairwise solver (solver/smo.py) and the blocked working-set solver
+(solver/blocked.py): box bounds [U, V] from s = y_h*y_l, the eta positivity
+guard, the reference's exact clip order (cap at V first, then floor at U,
+main3.cpp:261-264), and zero-progress (stall) detection.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+
+class PairUpdate(NamedTuple):
+    da_h: jax.Array      # change to alpha[i_high] (0 unless do_update)
+    da_l: jax.Array      # change to alpha[i_low]
+    feasible: jax.Array  # U <= V + 1e-12 (main3.cpp:158)
+    eta_ok: jax.Array    # eta > eps (main3.cpp:253)
+    do_update: jax.Array
+    stalled: jax.Array   # do_update but both deltas rounded to exactly 0
+
+
+def pair_update(K11, K22, K12, y_h, y_l, a_h, a_l, b_high, b_low, C, eps,
+                proceed) -> PairUpdate:
+    """Compute the clipped 2-alpha step. All inputs are scalars (traced).
+
+    `proceed` gates the update (False -> zero deltas), so callers can keep
+    the computation unconditional inside compiled loops.
+    """
+    s = y_h * y_l
+    eta = K11 + K22 - 2.0 * K12
+    U = jnp.where(s < 0, jnp.maximum(0.0, a_l - a_h),
+                  jnp.maximum(0.0, a_l + a_h - C))
+    V = jnp.where(s < 0, jnp.minimum(C, C + a_l - a_h),
+                  jnp.minimum(C, a_l + a_h))
+    feasible = U <= V + 1e-12
+    eta_ok = eta > eps
+    do_update = proceed & feasible & eta_ok
+    safe_eta = jnp.where(eta_ok, eta, jnp.ones_like(eta))
+    a_l_new = a_l + y_l * (b_high - b_low) / safe_eta
+    # reference clip order: cap at V first, then floor at U (main3.cpp:261-264)
+    a_l_new = jnp.maximum(jnp.minimum(a_l_new, V), U)
+    a_h_new = a_h + s * (a_l - a_l_new)
+    da_h = jnp.where(do_update, a_h_new - a_h, jnp.zeros_like(a_h))
+    da_l = jnp.where(do_update, a_l_new - a_l, jnp.zeros_like(a_l))
+    stalled = do_update & (da_h == 0) & (da_l == 0)
+    return PairUpdate(da_h, da_l, feasible, eta_ok, do_update, stalled)

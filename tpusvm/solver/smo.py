@@ -37,6 +37,7 @@ from jax import lax
 
 from tpusvm.config import SVMConfig
 from tpusvm.ops.rbf import rbf_matvec, rbf_rows_at, sq_norms
+from tpusvm.solver.analytic import pair_update
 from tpusvm.ops.selection import (
     i_high_mask,
     i_low_mask,
@@ -68,6 +69,8 @@ class SMOResult(NamedTuple):
     b_low: jax.Array
     n_iter: jax.Array
     status: jax.Array
+    # blocked solver only: number of outer (working-set) iterations
+    n_outer: Optional[jax.Array] = None
 
 
 def _body(state: SMOState, X, Y, valid, sn, C, gamma, eps, tau, max_iter):
@@ -111,31 +114,17 @@ def _body(state: SMOState, X, Y, valid, sn, C, gamma, eps, tau, max_iter):
     adt = f.dtype
     y_h = Y[i_high].astype(adt)
     y_l = Y[i_low].astype(adt)
-    s = y_h * y_l
-    K11 = k_high[i_high].astype(adt)
-    K22 = k_low[i_low].astype(adt)
-    K12 = k_high[i_low].astype(adt)
-    eta = K11 + K22 - 2.0 * K12
-
-    a_h = alpha[i_high]
-    a_l = alpha[i_low]
-    U = jnp.where(s < 0, jnp.maximum(0.0, a_l - a_h), jnp.maximum(0.0, a_l + a_h - C))
-    V = jnp.where(s < 0, jnp.minimum(C, C + a_l - a_h), jnp.minimum(C, a_l + a_h))
-    feasible = U <= V + 1e-12
-    eta_ok = eta > eps
-
-    do_update = proceed & feasible & eta_ok
-    safe_eta = jnp.where(eta_ok, eta, jnp.ones_like(eta))
-    a_l_new = a_l + y_l * (b_high - b_low) / safe_eta
-    # reference clip order: cap at V first, then floor at U (main3.cpp:261-264)
-    a_l_new = jnp.maximum(jnp.minimum(a_l_new, V), U)
-    a_h_new = a_h + s * (a_l - a_l_new)
-
-    da_h = jnp.where(do_update, a_h_new - a_h, jnp.zeros_like(a_h))
-    da_l = jnp.where(do_update, a_l_new - a_l, jnp.zeros_like(a_l))
-    # A zero-change update means the deterministic selection will re-pick the
-    # same pair forever (see Status.STALLED) — terminate instead of spinning.
-    stalled = do_update & (da_h == 0) & (da_l == 0)
+    upd = pair_update(
+        k_high[i_high].astype(adt),
+        k_low[i_low].astype(adt),
+        k_high[i_low].astype(adt),
+        y_h, y_l,
+        alpha[i_high], alpha[i_low],
+        b_high, b_low, C, eps, proceed,
+    )
+    feasible, eta_ok = upd.feasible, upd.eta_ok
+    do_update, stalled = upd.do_update, upd.stalled
+    da_h, da_l = upd.da_h, upd.da_l
 
     # --- error-vector update (main3.cpp:271-275 / update_f kernel) --------
     fdt = f.dtype
