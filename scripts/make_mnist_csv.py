@@ -1,0 +1,133 @@
+#!/usr/bin/env python
+"""Generate the reference's expected MNIST CSVs (mnist3_train_data.csv /
+mnist3_test_data.csv layout: header row, 784 pixel columns, last column =
+digit label 0-9).
+
+The reference assumes these files exist in cwd and ships neither them nor a
+converter (SURVEY.md §4: "The CSVs themselves are not in the repo"). This
+script is the missing fixture generator. Sources, in order of preference:
+
+  1. --idx DIR     directory with the standard IDX files
+                   (train-images-idx3-ubyte[.gz], train-labels-idx1-ubyte[.gz],
+                   t10k-images-idx3-ubyte[.gz], t10k-labels-idx1-ubyte[.gz])
+  2. --npz FILE    an .npz with arrays x_train, y_train, x_test, y_test
+                   (the keras mnist.npz layout)
+  3. --synthetic   deterministic MNIST-shaped synthetic data
+                   (tpusvm.data.mnist_like_multiclass) — for air-gapped
+                   environments; labels 0-9, pixels in [0, 255]
+
+Usage:
+  python scripts/make_mnist_csv.py --idx ~/mnist --out-dir data/
+  python scripts/make_mnist_csv.py --synthetic --out-dir data/
+"""
+
+from __future__ import annotations
+
+import argparse
+import gzip
+import os
+import struct
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def _open_maybe_gz(path):
+    return gzip.open(path, "rb") if path.endswith(".gz") else open(path, "rb")
+
+
+def _find(dir_, stem):
+    for name in (stem, stem + ".gz"):
+        p = os.path.join(dir_, name)
+        if os.path.exists(p):
+            return p
+    raise FileNotFoundError(f"{stem}[.gz] not found in {dir_}")
+
+
+def read_idx_images(path: str) -> np.ndarray:
+    with _open_maybe_gz(path) as f:
+        magic, n, rows, cols = struct.unpack(">IIII", f.read(16))
+        if magic != 2051:
+            raise ValueError(f"{path}: bad IDX image magic {magic}")
+        data = np.frombuffer(f.read(n * rows * cols), np.uint8)
+    return data.reshape(n, rows * cols)
+
+
+def read_idx_labels(path: str) -> np.ndarray:
+    with _open_maybe_gz(path) as f:
+        magic, n = struct.unpack(">II", f.read(8))
+        if magic != 2049:
+            raise ValueError(f"{path}: bad IDX label magic {magic}")
+        return np.frombuffer(f.read(n), np.uint8).astype(np.int64)
+
+
+def load_idx(dir_):
+    return (
+        read_idx_images(_find(dir_, "train-images-idx3-ubyte")),
+        read_idx_labels(_find(dir_, "train-labels-idx1-ubyte")),
+        read_idx_images(_find(dir_, "t10k-images-idx3-ubyte")),
+        read_idx_labels(_find(dir_, "t10k-labels-idx1-ubyte")),
+    )
+
+
+def load_npz(path):
+    z = np.load(path)
+    return (
+        z["x_train"].reshape(len(z["x_train"]), -1),
+        z["y_train"].astype(np.int64),
+        z["x_test"].reshape(len(z["x_test"]), -1),
+        z["y_test"].astype(np.int64),
+    )
+
+
+def load_synthetic(n_train, n_test, seed):
+    from tpusvm.data.synthetic import mnist_like_multiclass
+
+    X, labels = mnist_like_multiclass(n=n_train + n_test, d=784, seed=seed)
+    X = np.clip(np.round(X), 0, 255).astype(np.int64)
+    return X[:n_train], labels[:n_train], X[n_train:], labels[n_train:]
+
+
+def write_csv(path: str, X: np.ndarray, labels: np.ndarray) -> None:
+    """Reference CSV layout: header (discarded by readers, defines column
+    count — main3.cpp:27), one row per sample, integer pixels, label last."""
+    d = X.shape[1]
+    header = ",".join([f"pixel{i}" for i in range(d)] + ["label"])
+    rows = np.column_stack([X.astype(np.int64), labels.astype(np.int64)])
+    np.savetxt(path, rows, fmt="%d", delimiter=",", header=header, comments="")
+    print(f"wrote {path}: {len(rows)} rows x {d} features")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    src = ap.add_mutually_exclusive_group(required=True)
+    src.add_argument("--idx", metavar="DIR", help="directory with IDX files")
+    src.add_argument("--npz", metavar="FILE", help="keras-layout mnist.npz")
+    src.add_argument("--synthetic", action="store_true")
+    ap.add_argument("--out-dir", default=".", help="output directory")
+    ap.add_argument("--prefix", default="mnist3",
+                    help="file prefix (reference expects 'mnist3')")
+    ap.add_argument("--n-train", type=int, default=60000,
+                    help="synthetic train size")
+    ap.add_argument("--n-test", type=int, default=10000,
+                    help="synthetic test size")
+    ap.add_argument("--seed", type=int, default=587, help="synthetic seed")
+    args = ap.parse_args(argv)
+
+    if args.idx:
+        xtr, ytr, xte, yte = load_idx(args.idx)
+    elif args.npz:
+        xtr, ytr, xte, yte = load_npz(args.npz)
+    else:
+        xtr, ytr, xte, yte = load_synthetic(args.n_train, args.n_test, args.seed)
+
+    os.makedirs(args.out_dir, exist_ok=True)
+    write_csv(os.path.join(args.out_dir, f"{args.prefix}_train_data.csv"), xtr, ytr)
+    write_csv(os.path.join(args.out_dir, f"{args.prefix}_test_data.csv"), xte, yte)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

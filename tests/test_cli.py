@@ -1,0 +1,124 @@
+"""CLI + utils tests (SURVEY.md §5.1, §5.5, §5.6, C26)."""
+
+import json
+
+import numpy as np
+import pytest
+
+from tpusvm.cli import main
+from tpusvm.utils import PhaseTimer, RunLogger, trace
+
+
+# ------------------------------------------------------------------- utils
+def test_phase_timer_accumulates():
+    t = PhaseTimer()
+    with t.phase("a"):
+        pass
+    with t.phase("a"):
+        pass
+    with t.phase("b"):
+        pass
+    assert t["a"] >= 0 and "b" in t
+    t.add("b", 1.0)
+    assert t["b"] >= 1.0
+    rep = t.report()
+    assert "a time:" in rep and "elapsed time:" in rep
+    assert set(t.asdict()) == {"a", "b", "total"}
+
+
+def test_run_logger_jsonl(tmp_path, capsys):
+    path = str(tmp_path / "run.jsonl")
+    with RunLogger(jsonl_path=path) as log:
+        log.info("n = %d", 5)
+        log.round_header(2)
+        log.event("round", round=2, sv=np.int64(7), arr=np.arange(2))
+    out = capsys.readouterr().out
+    assert "n = 5" in out and "=== Round 2 ===" in out
+    rec = json.loads(open(path).read().splitlines()[0])
+    assert rec["event"] == "round" and rec["sv"] == 7 and rec["arr"] == [0, 1]
+
+
+def test_run_logger_non_primary_silent(tmp_path, capsys):
+    log = RunLogger(jsonl_path=str(tmp_path / "x.jsonl"), primary=False)
+    log.info("should not print")
+    log.event("e")
+    log.close()
+    assert capsys.readouterr().out == ""
+    assert not (tmp_path / "x.jsonl").exists()
+
+
+def test_trace_noop():
+    with trace(None):
+        pass
+
+
+# --------------------------------------------------------------------- cli
+def test_cli_train_single_and_predict(tmp_path, capsys):
+    model = str(tmp_path / "m.npz")
+    rc = main([
+        "train", "--synthetic", "rings", "--n", "200", "--n-test", "60",
+        "--C", "10", "--gamma", "10", "--save", model,
+        "--jsonl", str(tmp_path / "run.jsonl"),
+    ])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "n = 200, n_features = 2" in out
+    assert "iterations = " in out and "b = " in out
+    assert "SV count = " in out and "accuracy = " in out
+    assert "training time:" in out and "prediction time:" in out
+    events = [json.loads(l) for l in open(tmp_path / "run.jsonl")]
+    assert {e["event"] for e in events} >= {"data", "train", "eval", "timing"}
+
+    from tpusvm.data import rings, write_csv
+
+    X, Y = rings(n=80, seed=3)
+    csv = str(tmp_path / "t.csv")
+    write_csv(csv, X, Y)
+    rc = main(["predict", "--model", model, "--data", csv])
+    assert rc == 0
+    assert "accuracy = " in capsys.readouterr().out
+
+
+def test_cli_train_oracle(capsys):
+    rc = main([
+        "train", "--synthetic", "rings", "--n", "120", "--n-test", "40",
+        "--mode", "oracle", "--C", "10", "--gamma", "10",
+    ])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "(b_high - b_low)/2 * 1e10" in out
+
+
+def test_cli_train_cascade(capsys):
+    rc = main([
+        "train", "--synthetic", "rings", "--n", "160", "--n-test", "40",
+        "--mode", "cascade", "--topology", "star", "--shards", "4",
+        "--sv-capacity", "128", "--C", "10", "--gamma", "10",
+    ])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "=== Round 1 ===" in out and "cascade:" in out
+
+
+def test_cli_rejects_ambiguous_source():
+    with pytest.raises(SystemExit):
+        main(["train"])
+    with pytest.raises(SystemExit):
+        main(["train", "--train", "x.csv", "--synthetic", "rings"])
+
+
+def test_cli_info(capsys):
+    assert main(["info"]) == 0
+    assert "backend:" in capsys.readouterr().out
+
+
+def test_cli_n_limit_caps_synthetic(capsys):
+    rc = main([
+        "train", "--synthetic", "rings", "--n", "200", "--n-test", "40",
+        "--n-limit", "100", "--C", "10", "--gamma", "10",
+    ])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "n = 100," in out
+    # the cap must not leak the cut training rows into the test set
+    assert "/40)" in out

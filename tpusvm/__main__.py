@@ -1,0 +1,7 @@
+"""`python -m tpusvm` — see tpusvm.cli."""
+
+import sys
+
+from tpusvm.cli import main
+
+sys.exit(main())

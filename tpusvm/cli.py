@@ -1,0 +1,314 @@
+"""Command-line interface.
+
+The reference's "CLI" is: edit a hardcoded dataset string / constants in
+main(), recompile with the commands in README.md:110-181, submit one of four
+SLURM scripts; the single real flag in the codebase is `gpu_svm_main4
+<n_limit>` (SURVEY.md §5.6, C26). This module is the framework replacement —
+one argparse entry point whose defaults are the reference's constants, so a
+zero-flag run is a parity run.
+
+    python -m tpusvm train --train train.csv --test test.csv
+    python -m tpusvm train --synthetic mnist-like --n 60000 --mode cascade \
+        --topology star --shards 8
+    python -m tpusvm predict --model model.npz --data test.csv
+    python -m tpusvm info
+
+Output reproduces the reference's diagnostics contract (SURVEY.md
+Appendix A): n / n_features, iteration count, b at 15 dp, the KKT gap
+residual (b_high-b_low)/2*1e10, SV count, accuracy as correct/m, and the
+three phase timings; cascade runs add per-round `=== Round k ===` lines.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from typing import Optional, Tuple
+
+import numpy as np
+
+
+def _build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="tpusvm",
+        description="TPU-native parallel SVM training (JAX/XLA/Pallas).",
+    )
+    sub = p.add_subparsers(dest="command", required=True)
+
+    tr = sub.add_parser("train", help="train a model and optionally evaluate")
+    src = tr.add_argument_group("data source (one of --train / --synthetic)")
+    src.add_argument("--train", metavar="CSV", help="training CSV (last column = label)")
+    src.add_argument("--test", metavar="CSV", help="held-out CSV to evaluate on")
+    src.add_argument(
+        "--synthetic",
+        choices=["mnist-like", "blobs", "rings"],
+        help="generate a deterministic synthetic dataset instead of reading CSVs",
+    )
+    src.add_argument("--n", type=int, default=60000,
+                     help="synthetic train size (default 60000)")
+    src.add_argument("--n-test", type=int, default=10000,
+                     help="synthetic test size (default 10000)")
+    src.add_argument("--d", type=int, default=784,
+                     help="synthetic feature count (default 784)")
+    src.add_argument("--seed", type=int, default=587, help="synthetic data seed")
+    src.add_argument(
+        "--n-limit", type=int, default=None, metavar="N",
+        help="cap training rows (the reference's gpu_svm_main4 argv[1])",
+    )
+
+    mode = tr.add_argument_group("training mode")
+    mode.add_argument(
+        "--mode", choices=["single", "cascade", "oracle"], default="single",
+        help="single = on-device SMO (GPU-build capability); cascade = "
+        "distributed cascade over the device mesh (MPI capability); "
+        "oracle = serial NumPy SMO (main3.cpp capability)",
+    )
+    mode.add_argument(
+        "--solver", choices=["blocked", "pair"], default=None,
+        help="single-chip solver: blocked working-set (TPU-first, default) "
+        "or pair (reference-faithful one-pair-per-iteration); ignored by "
+        "--multiclass, which uses its batched vmapped solver",
+    )
+    mode.add_argument("--topology", choices=["tree", "star"], default="tree",
+                      help="cascade merge topology (tree = mpi_svm_main3, "
+                      "star = mpi_svm_main2)")
+    mode.add_argument("--shards", type=int, default=None,
+                      help="cascade shard count P (default: all local devices)")
+    mode.add_argument("--sv-capacity", type=int, default=4096,
+                      help="padded SV buffer capacity per shard")
+    mode.add_argument("--multiclass", action="store_true",
+                      help="one-vs-rest over all labels instead of the "
+                      "reference's binary '1 vs rest' mapping")
+
+    hp = tr.add_argument_group("hyperparameters (defaults = reference constants)")
+    hp.add_argument("--preset", choices=["mnist", "banknote", "debug"],
+                    default=None, help="named (C, gamma) preset")
+    hp.add_argument("--C", type=float, default=10.0)
+    hp.add_argument("--gamma", type=float, default=0.00125)
+    hp.add_argument("--tau", type=float, default=1e-5)
+    hp.add_argument("--eps", type=float, default=1e-12)
+    hp.add_argument("--sv-tol", type=float, default=1e-8)
+    hp.add_argument("--max-iter", type=int, default=100000)
+    hp.add_argument("--max-rounds", type=int, default=50)
+
+    num = tr.add_argument_group("numerics")
+    num.add_argument("--dtype", choices=["float32", "bfloat16", "float64"],
+                     default="float32", help="feature/kernel dtype")
+    num.add_argument(
+        "--accum", choices=["none", "float64"], default="float64",
+        help="solver accumulator dtype; float64 (default) is the mixed-"
+        "precision mode matching the f64 reference's convergence at f32 speed",
+    )
+    num.add_argument("--no-scale", action="store_true",
+                     help="skip min-max feature scaling")
+
+    out = tr.add_argument_group("output")
+    out.add_argument("--save", metavar="NPZ", help="save the trained model")
+    out.add_argument("--jsonl", metavar="PATH",
+                     help="append structured run events to a JSONL file")
+    out.add_argument("--profile", metavar="DIR",
+                     help="capture a jax.profiler trace of training")
+    out.add_argument("-q", "--quiet", action="store_true")
+
+    pr = sub.add_parser("predict", help="evaluate a saved model on a CSV")
+    pr.add_argument("--model", required=True, metavar="NPZ")
+    pr.add_argument("--data", required=True, metavar="CSV")
+    pr.add_argument("--n-limit", type=int, default=None)
+    pr.add_argument("--scores", action="store_true",
+                    help="print decision scores instead of accuracy")
+
+    sub.add_parser("info", help="print device / backend information")
+    return p
+
+
+def _load_train_data(args) -> Tuple[np.ndarray, np.ndarray, Optional[np.ndarray], Optional[np.ndarray]]:
+    """Returns (X_train, Y_train, X_test, Y_test); test side may be None."""
+    from tpusvm.data import blobs, mnist_like, read_csv, rings
+    from tpusvm.data.synthetic import mnist_like_multiclass
+
+    if (args.train is None) == (args.synthetic is None):
+        raise SystemExit("train: pass exactly one of --train / --synthetic")
+    if args.train:
+        X, Y = read_csv(args.train, n_limit=args.n_limit)
+        Xt = Yt = None
+        if args.test:
+            Xt, Yt = read_csv(args.test)
+        return X, Y, Xt, Yt
+
+    n_total = args.n + args.n_test
+    if args.synthetic == "mnist-like":
+        if args.multiclass:
+            X, Y = mnist_like_multiclass(n=n_total, d=args.d, seed=args.seed)
+        else:
+            X, Y = mnist_like(n=n_total, d=args.d, seed=args.seed,
+                              noise=30.0, label_noise=0.005)
+    elif args.synthetic == "blobs":
+        X, Y = blobs(n=n_total, d=args.d, seed=args.seed)
+    else:
+        X, Y = rings(n=n_total, seed=args.seed)
+    if args.n_limit is not None:
+        args.n = min(args.n, args.n_limit)
+    # test slice anchored at the end so --n-limit shrinks the train set
+    # without changing the test set
+    return (X[: args.n], Y[: args.n],
+            X[n_total - args.n_test :], Y[n_total - args.n_test :])
+
+
+def _cmd_train(args) -> int:
+    import jax
+    import jax.numpy as jnp
+
+    from tpusvm.config import CascadeConfig, SVMConfig, preset
+    from tpusvm.models import BinarySVC, OneVsRestSVC
+    from tpusvm.utils import PhaseTimer, RunLogger, trace
+
+    accum_dtype = None
+    if args.accum == "float64":
+        jax.config.update("jax_enable_x64", True)
+        accum_dtype = jnp.float64
+    dtype = getattr(jnp, args.dtype)
+    if args.dtype == "float64":
+        jax.config.update("jax_enable_x64", True)
+
+    if args.preset:
+        cfg = preset(args.preset, tau=args.tau, eps=args.eps,
+                     sv_tol=args.sv_tol, max_iter=args.max_iter,
+                     max_rounds=args.max_rounds)
+    else:
+        cfg = SVMConfig(C=args.C, gamma=args.gamma, tau=args.tau,
+                        eps=args.eps, sv_tol=args.sv_tol,
+                        max_iter=args.max_iter, max_rounds=args.max_rounds)
+
+    log = RunLogger(jsonl_path=args.jsonl,
+                    primary=(jax.process_index() == 0) and not args.quiet)
+    timer = PhaseTimer()
+
+    with timer.phase("data"):
+        X, Y, Xt, Yt = _load_train_data(args)
+    n, n_features = X.shape
+    log.info("n = %d, n_features = %d", n, n_features)
+    log.event("data", n=n, n_features=n_features, mode=args.mode)
+
+    if args.multiclass:
+        if args.mode != "single":
+            raise SystemExit("--multiclass currently supports --mode single")
+        if args.solver is not None:
+            log.info("note: --solver is ignored with --multiclass "
+                     "(batched vmapped solver)")
+        model = OneVsRestSVC(config=cfg, dtype=dtype, scale=not args.no_scale,
+                             accum_dtype=accum_dtype)
+        with timer.phase("training"), trace(args.profile):
+            model.fit(X, Y)
+        log.info("classes = %s", list(model.classes_))
+    elif args.mode == "oracle":
+        model = _fit_oracle(X, Y, cfg, timer, log)
+    else:
+        model = BinarySVC(config=cfg, dtype=dtype, scale=not args.no_scale,
+                          accum_dtype=accum_dtype,
+                          solver=args.solver or "blocked")
+        with timer.phase("training"), trace(args.profile):
+            if args.mode == "cascade":
+                shards = args.shards or len(jax.devices())
+                cc = CascadeConfig(n_shards=shards,
+                                   sv_capacity=args.sv_capacity,
+                                   topology=args.topology)
+                model.fit_cascade(X, Y, cc, verbose=not args.quiet)
+                log.info("cascade: %d rounds, converged = %s",
+                         model.cascade_rounds_,
+                         model.status_.name == "CONVERGED")
+            else:
+                model.fit(X, Y)
+
+    if not args.multiclass:
+        log.info("iterations = %d", model.n_iter_)
+        log.info("b = %.15f", model.b_)
+        if np.isfinite(model.b_high_):
+            gap = (model.b_high_ - model.b_low_) / 2.0
+            log.info("(b_high - b_low)/2 * 1e10 = %.6f", gap * 1e10)
+        log.info("SV count = %d", model.n_support_)
+        log.event("train", n_iter=model.n_iter_, b=model.b_,
+                  sv_count=model.n_support_, status=model.status_.name,
+                  train_time_s=timer["training"])
+
+    if Xt is not None and len(Xt):
+        with timer.phase("prediction"):
+            acc = model.score(Xt, Yt)
+        m = len(Yt)
+        log.info("accuracy = %.4f (%d/%d)", acc, round(acc * m), m)
+        log.event("eval", accuracy=acc, m=m)
+
+    if args.save:
+        model.save(args.save)
+        log.info("model saved to %s", args.save)
+
+    log.info("%s", timer.report())
+    log.event("timing", **timer.asdict())
+    log.close()
+    return 0
+
+
+def _fit_oracle(X, Y, cfg, timer, log):
+    """Serial NumPy SMO (main3.cpp capability) behind the BinarySVC surface."""
+    from tpusvm.data import MinMaxScaler
+    from tpusvm.models import BinarySVC
+    from tpusvm.oracle.smo import get_sv_indices, smo_train
+
+    model = BinarySVC(config=cfg)
+    with timer.phase("training"):
+        model.scaler_ = MinMaxScaler().fit(X)
+        Xs = model.scaler_.transform(X)
+        res = smo_train(Xs, Y, cfg)
+    sv = get_sv_indices(res.alpha, cfg.sv_tol)
+    model.sv_X_ = Xs[sv]
+    model.sv_Y_ = np.asarray(Y)[sv].astype(np.int32)
+    model.sv_alpha_ = res.alpha[sv]
+    model.sv_ids_ = sv.astype(np.int32)
+    model.b_ = res.b
+    model.b_high_ = res.b_high
+    model.b_low_ = res.b_low
+    model.n_iter_ = res.n_iter
+    model.status_ = res.status
+    return model
+
+
+def _cmd_predict(args) -> int:
+    from tpusvm.data import read_csv
+    from tpusvm.models import BinarySVC
+    from tpusvm.utils import PhaseTimer
+
+    timer = PhaseTimer()
+    model = BinarySVC.load(args.model)
+    with timer.phase("data"):
+        X, Y = read_csv(args.data, n_limit=args.n_limit)
+    if args.scores:
+        for s in model.decision_function(X):
+            print(f"{s:.15f}")
+        return 0
+    with timer.phase("prediction"):
+        acc = model.score(X, Y)
+    m = len(Y)
+    print(f"accuracy = {acc:.4f} ({round(acc * m)}/{m})")
+    print(timer.report())
+    return 0
+
+
+def _cmd_info(args) -> int:
+    import jax
+
+    print(f"jax {jax.__version__}")
+    print(f"backend: {jax.default_backend()}")
+    print(f"process {jax.process_index()}/{jax.process_count()}")
+    for d in jax.devices():
+        print(f"  {d}")
+    return 0
+
+
+def main(argv=None) -> int:
+    args = _build_parser().parse_args(argv)
+    return {"train": _cmd_train, "predict": _cmd_predict, "info": _cmd_info}[
+        args.command
+    ](args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -76,6 +76,8 @@ class BinarySVC:
         self.sv_alpha_: Optional[np.ndarray] = None
         self.sv_ids_: Optional[np.ndarray] = None
         self.b_: float = 0.0
+        self.b_high_: float = float("nan")
+        self.b_low_: float = float("nan")
         self.n_iter_: int = 0
         self.status_: Status = Status.RUNNING
         self.train_time_s_: float = 0.0
@@ -112,6 +114,8 @@ class BinarySVC:
         self.sv_alpha_ = alpha[sv]
         self.sv_ids_ = sv.astype(np.int32)
         self.b_ = float(res.b)
+        self.b_high_ = float(res.b_high)
+        self.b_low_ = float(res.b_low)
         self.n_iter_ = int(res.n_iter)
         self.status_ = Status(int(res.status))
         if self.status_ != Status.CONVERGED:

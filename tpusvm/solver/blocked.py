@@ -203,35 +203,50 @@ def blocked_smo_solve(
         converged = found & (b_low <= b_high + 2.0 * tau)
         proceed = found & ~converged
 
-        # --- working-set selection: q distinct indices --------------------
-        key_up = jnp.where(m_h, f, jnp.inf).astype(jnp.float32)
-        _, idx_up = lax.top_k(-key_up, half)          # q/2 smallest f in I_high
-        in_up = jnp.zeros((n,), bool).at[idx_up].set(True)
-        key_low = jnp.where(m_l & ~in_up, f, -jnp.inf).astype(jnp.float32)
-        _, idx_low = lax.top_k(key_low, half)         # q/2 largest f in I_low
-        B = jnp.concatenate([idx_up, idx_low]).astype(jnp.int32)
+        def do_round(args):
+            alpha, f = args
+            # --- working-set selection: q distinct indices ----------------
+            key_up = jnp.where(m_h, f, jnp.inf).astype(jnp.float32)
+            _, idx_up = lax.top_k(-key_up, half)      # q/2 smallest f in I_high
+            # only genuine I_high members count as taken: when |I_high| < q/2
+            # top_k pads idx_up with arbitrary non-members, and excluding
+            # those from the I_low pick could hide real violators
+            in_up = jnp.zeros((n,), bool).at[idx_up].set(m_h[idx_up])
+            key_low = jnp.where(m_l & ~in_up, f, -jnp.inf).astype(jnp.float32)
+            _, idx_low = lax.top_k(key_low, half)     # q/2 largest f in I_low
+            B = jnp.concatenate([idx_up, idx_low]).astype(jnp.int32)
 
-        X_B = X[B]
-        y_B = Y[B]
-        a_B = alpha[B]
-        f_B = f[B]
-        # members selected only as +/-inf filler (sets smaller than q/2)
-        # must not participate in the subproblem
-        active_B = valid[B] & (i_high_mask(a_B, y_B, C, eps)
-                               | i_low_mask(a_B, y_B, C, eps)) & proceed
+            X_B = X[B]
+            y_B = Y[B]
+            a_B = alpha[B]
+            f_B = f[B]
+            # members selected only as +/-inf filler (sets smaller than q/2)
+            # must not participate in the subproblem
+            active_B = valid[B] & (i_high_mask(a_B, y_B, C, eps)
+                                   | i_low_mask(a_B, y_B, C, eps))
 
-        K_BB = rbf_cross(X_B, X_B, gamma)
-        a_B_new, upd, progress, inner_reason = _inner_smo(
-            K_BB, y_B, a_B, f_B, active_B, C, eps, tau, max_inner
+            K_BB = rbf_cross(X_B, X_B, gamma)
+            a_B_new, upd, progress, inner_reason = _inner_smo(
+                K_BB, y_B, a_B, f_B, active_B, C, eps, tau, max_inner
+            )
+
+            dcoef = (a_B_new - a_B) * y_B.astype(adt)
+            df = rbf_cross_matvec(X, X_B, dcoef, gamma, sn).astype(adt)
+            return alpha.at[B].set(a_B_new), f + df, upd, progress, inner_reason
+
+        def skip_round(args):
+            alpha, f = args
+            return (alpha, f, jnp.int32(0), jnp.array(False),
+                    jnp.int32(Status.RUNNING))
+
+        # terminal round (converged / no working set) skips the whole
+        # selection + K_BB + inner solve + O(n*d*q) f-update machinery
+        alpha, f, upd, progress, inner_reason = lax.cond(
+            proceed, do_round, skip_round, (alpha, f)
         )
 
-        dcoef = (a_B_new - a_B) * y_B.astype(adt)
-        alpha = alpha.at[B].set(jnp.where(proceed, a_B_new, a_B))
-        df = rbf_cross_matvec(X, X_B, dcoef, gamma, sn).astype(adt)
-        f = jnp.where(proceed, f + df, f)
-
         n_outer = st.n_outer + jnp.where(proceed, 1, 0).astype(jnp.int32)
-        n_updates = st.n_updates + jnp.where(proceed, upd, 0)
+        n_updates = st.n_updates + upd
         # zero progress: surface the inner numerical bail-out that caused it
         # (same statuses as smo_solve on the same degenerate data), generic
         # STALLED otherwise

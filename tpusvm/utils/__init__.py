@@ -1,0 +1,4 @@
+from tpusvm.utils.logging import RunLogger
+from tpusvm.utils.timing import PhaseTimer, trace
+
+__all__ = ["PhaseTimer", "RunLogger", "trace"]
