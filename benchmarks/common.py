@@ -1,0 +1,67 @@
+"""Shared benchmark harness pieces.
+
+The reference's benchmark methodology (report §6, SURVEY.md §6): MNIST-60k
+RBF SVM (gamma=0.00125, C=10), trained to the Keerthi stopping criterion,
+timed train/predict phases excluding IO. Real MNIST CSVs are unavailable in
+this environment (zero egress), so the workload is the deterministic
+MNIST-shaped synthetic problem bench.py uses, tuned to the same difficulty
+band (see tpusvm.data.mnist_like).
+
+Timing: AOT-compile first, then time pure execution, ending at host
+materialisation of the result — `jax.block_until_ready` is not a reliable
+barrier on this TPU runtime (.claude/skills/verify/SKILL.md).
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+# reference numbers (BASELINE.md): config id -> seconds
+GPU_TRAIN_S = {  # B3 n-sweep, 1 GPU
+    10000: 3.555, 20000: 6.719, 30000: 10.164, 40000: 16.270,
+    50000: 29.790, 60000: 58.570,
+}
+GPU_PREDICT_S = {  # B3 n-sweep predict (10k test points)
+    10000: 6.854, 20000: 13.140, 30000: 19.439, 40000: 25.720,
+    50000: 32.011, 60000: 38.297,
+}
+CASCADE_TRAIN_S = {  # (topology, P) -> seconds, B4-B13, 2x32-core nodes
+    ("tree", 4): 1194.269, ("tree", 8): 839.406, ("tree", 16): 662.153,
+    ("tree", 32): 671.448, ("tree", 64): 673.580,
+    ("star", 4): 886.733, ("star", 8): 649.773, ("star", 16): 440.705,
+    ("star", 32): 333.696, ("star", 64): 301.263,
+}
+SERIAL_TRAIN_S = 3285.662  # B1
+
+
+def log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+def make_workload(n: int, d: int = 784, seed: int = 587):
+    """Scaled float32 MNIST-shaped training set + labels (bench.py recipe)."""
+    from tpusvm.data import MinMaxScaler, mnist_like
+
+    X, Y = mnist_like(n=n, d=d, noise=30.0, label_noise=0.005, seed=seed)
+    Xs = MinMaxScaler().fit_transform(X).astype(np.float32)
+    return Xs, Y
+
+
+def timed_to_host(fn, *args):
+    """Run fn, materialise every array leaf on host, return (result, secs)."""
+    import jax
+
+    t0 = time.perf_counter()
+    out = fn(*args)
+    jax.tree.map(
+        lambda x: np.asarray(x) if hasattr(x, "dtype") else x, out
+    )
+    return out, time.perf_counter() - t0
+
+
+def emit(record: dict) -> None:
+    print(json.dumps(record), flush=True)

@@ -1,0 +1,129 @@
+#!/usr/bin/env python
+"""Training-size sweep — the reference's gpu_svm4.sh experiment (B3).
+
+The reference sweeps n in 10000..60000 on one GPU via SLURM array-style
+re-launches (code/gpu_svm4.sh; gpu_svm_main4.cu takes argv[1] = n_limit) and
+reports per-size train and predict seconds (report Table 2). This harness
+reproduces that sweep on one TPU chip with the blocked working-set solver
+and the on-device predictor, emitting one JSON line per size:
+
+  {"n": ..., "train_s": ..., "predict_s": ..., "vs_gpu_train": ...,
+   "vs_gpu_predict": ..., "status": ..., "n_sv": ...}
+
+Usage:
+  python benchmarks/sweep_n.py                    # reference sizes
+  python benchmarks/sweep_n.py --sizes 10000 20000
+  python benchmarks/sweep_n.py --smoke            # tiny sizes, CPU-safe
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import jax
+
+jax.config.update("jax_enable_x64", True)
+
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+from benchmarks.common import (  # noqa: E402
+    GPU_PREDICT_S,
+    GPU_TRAIN_S,
+    emit,
+    log,
+    make_workload,
+)
+from tpusvm.data import MinMaxScaler, mnist_like  # noqa: E402
+from tpusvm.oracle.smo import get_sv_indices  # noqa: E402
+from tpusvm.solver.blocked import blocked_smo_solve  # noqa: E402
+from tpusvm.solver.predict import predict as device_predict  # noqa: E402
+from tpusvm.status import Status  # noqa: E402
+
+
+def run_size(n, Xs, Y, Xt, Yt, solver_opts, gamma):
+    Xd = jax.device_put(jnp.asarray(Xs[:n]))
+    Yd = jax.device_put(jnp.asarray(Y[:n]))
+    traced = dict(C=10.0, gamma=gamma, eps=1e-12, tau=1e-5)
+
+    compiled = blocked_smo_solve.lower(Xd, Yd, **traced, **solver_opts).compile()
+    t0 = time.perf_counter()
+    res = compiled(Xd, Yd, **traced)
+    alpha = np.asarray(res.alpha)  # host materialisation = barrier
+    train_s = time.perf_counter() - t0
+
+    # predict with the GPU build's semantics (C16: all n train points):
+    # one jit'd decision over the test block
+    Xtd = jax.device_put(jnp.asarray(Xt))
+    pred_fn = jax.jit(
+        lambda Xq: device_predict(
+            Xq, Xd, Yd, res.alpha.astype(Xd.dtype), res.b.astype(Xd.dtype),
+            gamma=gamma,
+        )
+    )
+    pred_fn.lower(Xtd).compile()  # compile outside the timed region
+    t0 = time.perf_counter()
+    yp = np.asarray(pred_fn(Xtd))
+    predict_s = time.perf_counter() - t0
+
+    return {
+        "n": n,
+        "train_s": round(train_s, 4),
+        "predict_s": round(predict_s, 4),
+        "accuracy": float((yp == Yt).mean()),
+        "n_sv": int(len(get_sv_indices(alpha))),
+        "iterations": int(res.n_iter),
+        "status": Status(int(res.status)).name,
+        "vs_gpu_train": round(GPU_TRAIN_S[n] / train_s, 2) if n in GPU_TRAIN_S else None,
+        "vs_gpu_predict": round(GPU_PREDICT_S[n] / predict_s, 2) if n in GPU_PREDICT_S else None,
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--sizes", type=int, nargs="+",
+                    default=[10000, 20000, 30000, 40000, 50000, 60000])
+    ap.add_argument("--n-test", type=int, default=10000)
+    ap.add_argument("--d", type=int, default=784)
+    ap.add_argument("--smoke", action="store_true",
+                    help="tiny sizes for a fast functional check")
+    ap.add_argument("--q", type=int, default=1024)
+    ap.add_argument("--gamma", type=float, default=0.00125,
+                    help="RBF width (reference MNIST value); scaled to ~1/d in --smoke")
+    ap.add_argument("--max-inner", type=int, default=1024)
+    args = ap.parse_args(argv)
+
+    if args.smoke:
+        args.sizes = [512, 1024]
+        args.n_test = 256
+        args.d = 64
+        # gamma=0.00125 is tuned for d=784 in [0,1]; at small d the kernel
+        # degenerates to ~1 everywhere, so keep gamma*d roughly constant
+        args.gamma = 1.0 / args.d
+
+    log(f"devices: {jax.devices()}")
+    n_max = max(args.sizes)
+    log(f"generating workload (n={n_max + args.n_test}, d={args.d})...")
+    X, Y = mnist_like(n=n_max + args.n_test, d=args.d,
+                      noise=30.0, label_noise=0.005)
+    sc = MinMaxScaler().fit(X[:n_max])  # reference: scale with TRAIN min/max
+    Xs = sc.transform(X[:n_max]).astype(np.float32)
+    Xt = sc.transform(X[n_max:]).astype(np.float32)
+    Yt = Y[n_max:]
+
+    # q is clamped to n inside blocked_smo_solve
+    solver_opts = dict(q=args.q, max_outer=5000, max_inner=args.max_inner,
+                       accum_dtype=jnp.float64)
+    for n in args.sizes:
+        log(f"--- n = {n} ---")
+        emit(run_size(n, Xs, Y[:n_max], Xt, Yt, solver_opts, args.gamma))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,121 @@
+#!/usr/bin/env python
+"""Cascade shard-count sweep — the reference's MPI scaling study (B4-B13).
+
+The reference trains the cascade at P in {4,8,16,32,64} ranks over 2x32-core
+nodes for both topologies (report Tables 3-4) and reports train time,
+speedup over serial, and efficiency. This harness reproduces the sweep over
+a jax.sharding.Mesh. With one real TPU chip the mesh members are virtual
+(XLA_FLAGS=--xla_force_host_platform_device_count=P JAX_PLATFORMS=cpu for a
+CPU simulation, SURVEY.md §4), so absolute times on CPU are not TPU
+numbers — the sweep's purpose there is convergence behaviour (rounds,
+SV-set parity across P, the reference's Fig. 6 claim that ~97% of final
+SVs appear in round 1). On a real multi-chip TPU slice the same script is
+the wall-clock scaling benchmark.
+
+One JSON line per (topology, P):
+  {"topology": ..., "P": ..., "train_s": ..., "rounds": ..., "n_sv": ...,
+   "vs_cascade_ref": ..., "vs_serial_ref": ...}
+
+Usage:
+  python benchmarks/sweep_p.py --n 8192 --d 256 --shards 2 4 8
+  python benchmarks/sweep_p.py --smoke
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--n", type=int, default=60000)
+    ap.add_argument("--d", type=int, default=784)
+    ap.add_argument("--shards", type=int, nargs="+", default=[4, 8])
+    ap.add_argument("--topologies", nargs="+", default=["tree", "star"],
+                    choices=["tree", "star"])
+    ap.add_argument("--sv-capacity", type=int, default=4096)
+    ap.add_argument("--gamma", type=float, default=0.00125,
+                    help="RBF width (reference MNIST value); ~1/d in --smoke")
+    ap.add_argument("--platform", choices=["cpu", "native"], default="cpu",
+                    help="cpu = simulated multi-device mesh (default); "
+                    "native = use the real devices as configured")
+    ap.add_argument("--smoke", action="store_true")
+    args = ap.parse_args(argv)
+
+    if args.smoke:
+        args.n, args.d, args.shards = 2048, 64, [2, 4]
+        args.sv_capacity = 1024
+        args.gamma = 1.0 / args.d  # keep gamma*d ~ constant at small d
+
+    max_p = max(args.shards)
+    if args.platform == "cpu":
+        # virtual-device CPU mesh. Env-var JAX_PLATFORMS can be overridden
+        # by sitecustomize-registered plugins, so select the platform via
+        # jax.config (must happen before backend init), like tests/conftest.
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + f" --xla_force_host_platform_device_count={max_p}"
+            ).strip()
+
+    import jax
+
+    if args.platform == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_enable_x64", True)
+    import jax.numpy as jnp
+
+    from benchmarks.common import (
+        CASCADE_TRAIN_S,
+        SERIAL_TRAIN_S,
+        emit,
+        log,
+        make_workload,
+    )
+    from tpusvm.config import CascadeConfig, SVMConfig
+    from tpusvm.parallel import cascade_fit, make_mesh
+
+    log(f"devices: {len(jax.devices())} x {jax.devices()[0].platform}")
+    log(f"workload: n={args.n} d={args.d}")
+    Xs, Y = make_workload(args.n, args.d)
+    cfg = SVMConfig(gamma=args.gamma)  # other constants = reference
+
+    for topology in args.topologies:
+        for p in args.shards:
+            if topology == "tree" and (p & (p - 1)) != 0:
+                log(f"skip tree P={p} (needs power of two)")
+                continue
+            mesh = make_mesh(p)
+            t0 = time.perf_counter()
+            res = cascade_fit(
+                Xs, Y, cfg,
+                CascadeConfig(n_shards=p, sv_capacity=args.sv_capacity,
+                              topology=topology),
+                mesh=mesh, accum_dtype=jnp.float64,
+            )
+            train_s = time.perf_counter() - t0
+            round1_sv = res.history[0]["sv_count"] if res.history else 0
+            ref = CASCADE_TRAIN_S.get((topology, p))
+            emit({
+                "topology": topology,
+                "P": p,
+                "train_s": round(train_s, 3),
+                "rounds": res.rounds,
+                "converged": res.converged,
+                "n_sv": len(res.sv_ids),
+                "b": res.b,
+                "round1_sv_fraction": round(round1_sv / max(len(res.sv_ids), 1), 4),
+                "vs_cascade_ref": round(ref / train_s, 2) if ref else None,
+                "vs_serial_ref": round(SERIAL_TRAIN_S / train_s, 2),
+                "platform": jax.devices()[0].platform,
+            })
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

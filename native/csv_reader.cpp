@@ -1,0 +1,193 @@
+// Native CSV data loader — the framework's host-side IO fast path.
+//
+// The reference's data layer is a C++ read_CSV (main3.cpp:13-54;
+// gpu_svm_main4.cu:16-59 adds the n_limit cap): skip the header line (it
+// only defines the column count), parse comma-separated doubles, last
+// column is the integer label, rows with fewer than 2 fields are skipped,
+// and in binary mode label != 1 maps to -1. This file is the TPU
+// framework's native equivalent: same row/label semantics, but
+// multi-threaded — the file is split at newline boundaries into per-thread
+// byte ranges parsed concurrently, then copied into one contiguous
+// row-major buffer in file order. Exposed through a plain C ABI consumed
+// by ctypes (tpusvm/data/native_io.py); no pybind11 dependency.
+//
+// Build: scripts/build_native.sh  ->  tpusvm/_native/libtpusvm_io.so
+
+#include <cctype>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+struct Chunk {
+  std::vector<double> X;
+  std::vector<int32_t> Y;
+  long rows = 0;
+  bool parse_error = false;
+};
+
+// Parse one [begin, end) slice of complete lines into chunk storage.
+// d_features = columns - 1 (from the header). Contract matches the Python
+// reader (tpusvm/data/csv_reader.py): rows with < 2 fields are skipped;
+// an unparsable field or a row whose field count differs from the
+// header's is a parse error (the Python reader raises there too — the
+// fast path must not silently return different data than the fallback).
+void parse_slice(const char* begin, const char* end, long d_features,
+                 int binary_labels, Chunk* out) {
+  std::vector<double> fields;
+  fields.reserve(d_features + 1);
+  const char* p = begin;
+  while (p < end) {
+    const char* line_end = static_cast<const char*>(
+        memchr(p, '\n', static_cast<size_t>(end - p)));
+    if (line_end == nullptr) line_end = end;
+
+    fields.clear();
+    bool bad_field = false;
+    const char* q = p;
+    while (q < line_end) {
+      char* next = nullptr;
+      double v = strtod(q, &next);
+      if (next == q) {
+        bad_field = true;
+        break;
+      }
+      fields.push_back(v);
+      q = next;
+      while (q < line_end && *q != ',') ++q;  // tolerate trailing spaces
+      if (q < line_end) ++q;                  // skip comma
+    }
+
+    long nf = static_cast<long>(fields.size());
+    if (bad_field || (nf >= 2 && nf != d_features + 1)) {
+      out->parse_error = true;
+      return;
+    }
+    if (nf >= 2) {
+      size_t base = out->X.size();
+      out->X.resize(base + d_features, 0.0);
+      for (long j = 0; j < d_features; ++j) out->X[base + j] = fields[j];
+      int32_t label = static_cast<int32_t>(fields.back());
+      out->Y.push_back(binary_labels ? (label == 1 ? 1 : -1) : label);
+      out->rows += 1;
+    }
+    p = line_end < end ? line_end + 1 : end;
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+struct CsvData {
+  int64_t n;
+  int64_t d;
+  double* X;       // row-major (n, d), owned
+  int32_t* Y;      // (n,), owned
+  int64_t error;   // 0 = ok, 1 = parse error (X/Y are null)
+};
+
+// Returns nullptr on IO error. n_limit < 0 means "no cap".
+CsvData* tpusvm_read_csv(const char* path, int64_t n_limit,
+                         int binary_labels, int n_threads) {
+  FILE* fp = fopen(path, "rb");
+  if (fp == nullptr) return nullptr;
+  fseek(fp, 0, SEEK_END);
+  long size = ftell(fp);
+  fseek(fp, 0, SEEK_SET);
+  std::string buf(static_cast<size_t>(size), '\0');
+  if (size > 0 && fread(&buf[0], 1, static_cast<size_t>(size), fp) !=
+                      static_cast<size_t>(size)) {
+    fclose(fp);
+    return nullptr;
+  }
+  fclose(fp);
+
+  // header line: defines the column count, content discarded
+  const char* data = buf.data();
+  const char* data_end = data + buf.size();
+  const char* hdr_end = static_cast<const char*>(
+      memchr(data, '\n', buf.size()));
+  if (hdr_end == nullptr) hdr_end = data_end;
+  long d_features = 0;
+  for (const char* c = data; c < hdr_end; ++c)
+    if (*c == ',') ++d_features;  // columns - 1 = feature count
+  const char* body = hdr_end < data_end ? hdr_end + 1 : data_end;
+
+  if (n_threads <= 0) {
+    unsigned hw = std::thread::hardware_concurrency();
+    n_threads = hw ? static_cast<int>(hw) : 4;
+  }
+  long body_len = static_cast<long>(data_end - body);
+  if (body_len < (1 << 20)) n_threads = 1;  // small file: threads cost more
+
+  // split [body, data_end) at newline boundaries into n_threads slices
+  std::vector<const char*> starts{body};
+  for (int t = 1; t < n_threads; ++t) {
+    const char* guess = body + body_len * t / n_threads;
+    const char* nl = static_cast<const char*>(
+        memchr(guess, '\n', static_cast<size_t>(data_end - guess)));
+    starts.push_back(nl == nullptr ? data_end : nl + 1);
+  }
+  starts.push_back(data_end);
+
+  std::vector<Chunk> chunks(static_cast<size_t>(n_threads));
+  std::vector<std::thread> workers;
+  for (int t = 0; t < n_threads; ++t) {
+    workers.emplace_back(parse_slice, starts[t], starts[t + 1], d_features,
+                         binary_labels, &chunks[static_cast<size_t>(t)]);
+  }
+  for (auto& w : workers) w.join();
+
+  for (const auto& c : chunks) {
+    if (c.parse_error) {
+      CsvData* out = static_cast<CsvData*>(malloc(sizeof(CsvData)));
+      out->n = 0;
+      out->d = d_features;
+      out->X = nullptr;
+      out->Y = nullptr;
+      out->error = 1;
+      return out;
+    }
+  }
+
+  int64_t total = 0;
+  for (const auto& c : chunks) total += c.rows;
+  if (n_limit >= 0 && total > n_limit) total = n_limit;
+
+  CsvData* out = static_cast<CsvData*>(malloc(sizeof(CsvData)));
+  out->n = total;
+  out->d = d_features;
+  out->error = 0;
+  out->X = static_cast<double*>(
+      malloc(sizeof(double) * static_cast<size_t>(total * d_features)));
+  out->Y = static_cast<int32_t*>(
+      malloc(sizeof(int32_t) * static_cast<size_t>(total)));
+
+  int64_t row = 0;
+  for (const auto& c : chunks) {
+    if (row >= total) break;
+    int64_t take = c.rows;
+    if (row + take > total) take = total - row;
+    memcpy(out->X + row * d_features, c.X.data(),
+           sizeof(double) * static_cast<size_t>(take * d_features));
+    memcpy(out->Y + row, c.Y.data(),
+           sizeof(int32_t) * static_cast<size_t>(take));
+    row += take;
+  }
+  return out;
+}
+
+void tpusvm_free_csv(CsvData* data) {
+  if (data == nullptr) return;
+  free(data->X);
+  free(data->Y);
+  free(data);
+}
+
+}  // extern "C"

@@ -1,0 +1,10 @@
+#!/bin/sh
+# Build the native host-side components into tpusvm/_native/.
+# Requires g++ (C++17). Python never requires the result — every native
+# entry point has a pure-Python fallback (tpusvm/data/native_io.py).
+set -e
+cd "$(dirname "$0")/.."
+mkdir -p tpusvm/_native
+g++ -std=c++17 -O3 -march=native -Wall -shared -fPIC -pthread \
+    native/csv_reader.cpp -o tpusvm/_native/libtpusvm_io.so
+echo "built tpusvm/_native/libtpusvm_io.so"

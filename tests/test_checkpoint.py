@@ -1,0 +1,114 @@
+"""Cascade checkpoint/resume (SURVEY.md §5.4).
+
+The reference's inter-round global-SV broadcast is an in-memory checkpoint
+(warm-start semantics, C20/C21); these tests cover the persisted version:
+state written per round, resumable mid-run, and resume converging to the
+same model as an uninterrupted run.
+"""
+
+import dataclasses
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpusvm.config import CascadeConfig, SVMConfig
+from tpusvm.data import MinMaxScaler, rings
+from tpusvm.parallel.cascade import (
+    cascade_fit,
+    load_round_state,
+    save_round_state,
+)
+from tpusvm.parallel.svbuffer import empty
+
+
+CFG = SVMConfig(C=10.0, gamma=10.0)
+CC = CascadeConfig(n_shards=4, sv_capacity=64, topology="star")
+
+
+@pytest.fixture(scope="module")
+def data():
+    X, Y = rings(n=320, seed=11)
+    return MinMaxScaler().fit_transform(X), Y
+
+
+def test_round_state_roundtrip(tmp_path):
+    buf = empty(8, 3)
+    buf = buf._replace(
+        X=buf.X.at[0].set(1.5),
+        Y=buf.Y.at[0].set(1),
+        alpha=buf.alpha.at[0].set(0.25),
+        ids=buf.ids.at[0].set(42),
+        valid=buf.valid.at[0].set(True),
+    )
+    path = str(tmp_path / "ck.npz")
+    save_round_state(path, buf, {42}, rnd=3, b=-1.25)
+    loaded, prev_ids, next_round, b = load_round_state(path)
+    assert prev_ids == {42} and next_round == 4 and b == -1.25
+    np.testing.assert_allclose(np.asarray(loaded.X), np.asarray(buf.X))
+    assert np.asarray(loaded.valid).sum() == 1
+    assert int(np.asarray(loaded.ids)[0]) == 42
+
+
+def test_checkpoint_written_every_round(tmp_path, data):
+    X, Y = data
+    path = str(tmp_path / "cascade.npz")
+    res = cascade_fit(X, Y, CFG, CC, checkpoint_path=path)
+    assert res.converged
+    _, prev_ids, next_round, _ = load_round_state(path)
+    assert next_round == res.rounds + 1
+    assert prev_ids == set(res.sv_ids.tolist())
+
+
+def test_resume_matches_uninterrupted(tmp_path, data):
+    X, Y = data
+    full = cascade_fit(X, Y, CFG, CC)
+    assert full.converged and full.rounds >= 2
+
+    # interrupted run: only 1 round, then resume to convergence
+    path = str(tmp_path / "cascade.npz")
+    short_cfg = dataclasses.replace(CFG, max_rounds=1)
+    partial = cascade_fit(X, Y, short_cfg, CC, checkpoint_path=path)
+    assert not partial.converged
+
+    resumed = cascade_fit(X, Y, CFG, CC, checkpoint_path=path, resume=True)
+    assert resumed.converged
+    assert resumed.rounds == full.rounds  # same trajectory, same round count
+    assert set(resumed.sv_ids.tolist()) == set(full.sv_ids.tolist())
+    assert resumed.b == pytest.approx(full.b, rel=1e-6)
+
+
+def test_resume_shape_mismatch_raises(tmp_path, data):
+    X, Y = data
+    path = str(tmp_path / "cascade.npz")
+    cascade_fit(X, Y, dataclasses.replace(CFG, max_rounds=1), CC,
+                checkpoint_path=path)
+    bad_cc = dataclasses.replace(CC, sv_capacity=32)
+    with pytest.raises(ValueError, match="checkpoint shapes"):
+        cascade_fit(X, Y, CFG, bad_cc, checkpoint_path=path, resume=True)
+
+
+def test_resume_without_file_starts_fresh(tmp_path, data):
+    X, Y = data
+    path = str(tmp_path / "missing.npz")
+    res = cascade_fit(X, Y, CFG, CC, checkpoint_path=path, resume=True)
+    assert res.converged
+
+
+def test_resume_roundtrips_alpha_dtype(tmp_path, data):
+    # the checkpoint must hand back exactly the inter-round state the live
+    # run would carry: load keeps the STORED alpha dtype rather than
+    # casting to the feature dtype (extract_svs defines what that stored
+    # dtype is — currently the feature dtype, even in mixed precision)
+    X, Y = data
+    path = str(tmp_path / "ck64.npz")
+    res = cascade_fit(X, Y, dataclasses.replace(CFG, max_rounds=1), CC,
+                      checkpoint_path=path, accum_dtype=jnp.float64)
+    buf, _, _, _ = load_round_state(path, dtype=jnp.float32)
+    assert buf.alpha.dtype == res.sv_alpha.dtype
+    assert buf.X.dtype == jnp.float32
+    # and a hand-written f64 buffer survives the roundtrip untruncated
+    b64 = empty(4, 2)._replace(alpha=jnp.zeros(4, jnp.float64))
+    save_round_state(path, b64, set(), rnd=1, b=0.0)
+    loaded, _, _, _ = load_round_state(path, dtype=jnp.float32)
+    assert loaded.alpha.dtype == jnp.float64

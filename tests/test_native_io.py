@@ -1,0 +1,132 @@
+"""Native C++ CSV loader vs the reference-faithful Python reader (C1).
+
+The native path is optional (scripts/build_native.sh); tests that need the
+shared library build it on demand and skip if no compiler is available.
+"""
+
+import os
+import subprocess
+
+import numpy as np
+import pytest
+
+from tpusvm.data import read_csv, write_csv
+from tpusvm.data.native_io import native_available, read_csv_fast
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(scope="module")
+def native_lib():
+    if not native_available():
+        try:
+            subprocess.run(
+                [os.path.join(REPO, "scripts", "build_native.sh")],
+                check=True, capture_output=True,
+            )
+        except (OSError, subprocess.CalledProcessError) as e:
+            pytest.skip(f"cannot build native library: {e}")
+        # force re-probe after the build
+        import tpusvm.data.native_io as nio
+
+        nio._lib_checked = False
+        if not native_available():
+            pytest.skip("native library unavailable after build")
+    return True
+
+
+@pytest.fixture()
+def csv_file(tmp_path):
+    rng = np.random.default_rng(42)
+    X = rng.standard_normal((300, 17))
+    labels = rng.integers(0, 10, 300)
+    path = str(tmp_path / "d.csv")
+    d = X.shape[1]
+    header = ",".join([f"c{i}" for i in range(d)] + ["label"])
+    with open(path, "w") as f:
+        f.write(header + "\n")
+        for row, lab in zip(X, labels):
+            f.write(",".join(f"{v:.17g}" for v in row) + f",{lab}\n")
+    return path, X, labels
+
+
+def test_native_matches_python(native_lib, csv_file):
+    path, X, labels = csv_file
+    Xp, Yp = read_csv(path)
+    Xn, Yn = read_csv_fast(path)
+    np.testing.assert_allclose(Xn, Xp, rtol=0, atol=0)
+    np.testing.assert_array_equal(Yn, Yp)
+    assert set(np.unique(Yn)) <= {1, -1}
+
+
+def test_native_n_limit(native_lib, csv_file):
+    path, X, _ = csv_file
+    Xn, Yn = read_csv_fast(path, n_limit=37)
+    assert Xn.shape == (37, 17) and len(Yn) == 37
+    np.testing.assert_allclose(Xn, X[:37], rtol=1e-15)
+
+
+def test_native_raw_labels(native_lib, csv_file):
+    path, _, labels = csv_file
+    Xn, Yn = read_csv_fast(path, binary_labels=False)
+    np.testing.assert_array_equal(Yn, labels)
+
+
+def test_native_missing_file_raises(native_lib, tmp_path):
+    with pytest.raises(OSError):
+        read_csv_fast(str(tmp_path / "nope.csv"))
+
+
+def test_native_empty_body(native_lib, tmp_path):
+    path = str(tmp_path / "empty.csv")
+    with open(path, "w") as f:
+        f.write("a,b,label\n")
+    X, Y = read_csv_fast(path)
+    assert X.shape == (0, 2) and len(Y) == 0
+
+
+def test_native_skips_short_rows(native_lib, tmp_path):
+    path = str(tmp_path / "short.csv")
+    with open(path, "w") as f:
+        f.write("a,b,label\n1.5,2.5,1\n\n7\n3.5,4.5,0\n")
+    X, Y = read_csv_fast(path)
+    Xp, Yp = read_csv(path)
+    np.testing.assert_allclose(X, Xp)
+    np.testing.assert_array_equal(Y, Yp)
+    assert len(Y) == 2 and Y.tolist() == [1, -1]
+
+
+def test_python_raw_labels(csv_file):
+    path, _, labels = csv_file
+    X, Y = read_csv(path, binary=False)
+    np.testing.assert_array_equal(Y, labels)
+    X2, Y2 = read_csv(path, n_limit=10, binary=False)
+    assert len(Y2) == 10
+
+
+def test_write_read_roundtrip_via_fast(tmp_path, native_lib):
+    rng = np.random.default_rng(7)
+    X = rng.random((50, 5))
+    Y = rng.choice([1, -1], 50).astype(np.int32)
+    path = str(tmp_path / "rt.csv")
+    write_csv(path, X, Y)
+    Xr, Yr = read_csv_fast(path)
+    np.testing.assert_allclose(Xr, X, atol=1e-12)
+    np.testing.assert_array_equal(Yr, Y)
+
+
+def test_native_malformed_raises(native_lib, tmp_path):
+    # unparsable field: both readers raise ValueError
+    bad = str(tmp_path / "bad.csv")
+    with open(bad, "w") as f:
+        f.write("a,b,label\n1.0,oops,1\n")
+    with pytest.raises(ValueError):
+        read_csv_fast(bad)
+    with pytest.raises(ValueError):
+        read_csv(bad)
+    # ragged row (field count != header): native rejects loudly
+    ragged = str(tmp_path / "ragged.csv")
+    with open(ragged, "w") as f:
+        f.write("a,b,c,label\n1.0,2.0,3.0,1\n1.0,2.0,1\n")
+    with pytest.raises(ValueError):
+        read_csv_fast(ragged)

@@ -76,6 +76,11 @@ def _build_parser() -> argparse.ArgumentParser:
                       help="cascade shard count P (default: all local devices)")
     mode.add_argument("--sv-capacity", type=int, default=4096,
                       help="padded SV buffer capacity per shard")
+    mode.add_argument("--checkpoint", metavar="NPZ",
+                      help="cascade: write per-round state here; with "
+                      "--resume, restart from it")
+    mode.add_argument("--resume", action="store_true",
+                      help="cascade: resume from --checkpoint if it exists")
     mode.add_argument("--multiclass", action="store_true",
                       help="one-vs-rest over all labels instead of the "
                       "reference's binary '1 vs rest' mapping")
@@ -123,16 +128,19 @@ def _build_parser() -> argparse.ArgumentParser:
 
 def _load_train_data(args) -> Tuple[np.ndarray, np.ndarray, Optional[np.ndarray], Optional[np.ndarray]]:
     """Returns (X_train, Y_train, X_test, Y_test); test side may be None."""
-    from tpusvm.data import blobs, mnist_like, read_csv, rings
+    from tpusvm.data import blobs, mnist_like, rings
+    from tpusvm.data.native_io import read_csv_fast
     from tpusvm.data.synthetic import mnist_like_multiclass
 
     if (args.train is None) == (args.synthetic is None):
         raise SystemExit("train: pass exactly one of --train / --synthetic")
     if args.train:
-        X, Y = read_csv(args.train, n_limit=args.n_limit)
+        binary = not args.multiclass
+        X, Y = read_csv_fast(args.train, n_limit=args.n_limit,
+                             binary_labels=binary)
         Xt = Yt = None
         if args.test:
-            Xt, Yt = read_csv(args.test)
+            Xt, Yt = read_csv_fast(args.test, binary_labels=binary)
         return X, Y, Xt, Yt
 
     n_total = args.n + args.n_test
@@ -189,6 +197,8 @@ def _cmd_train(args) -> int:
     log.info("n = %d, n_features = %d", n, n_features)
     log.event("data", n=n, n_features=n_features, mode=args.mode)
 
+    if args.resume and not args.checkpoint:
+        raise SystemExit("--resume requires --checkpoint")
     if args.multiclass:
         if args.mode != "single":
             raise SystemExit("--multiclass currently supports --mode single")
@@ -212,7 +222,9 @@ def _cmd_train(args) -> int:
                 cc = CascadeConfig(n_shards=shards,
                                    sv_capacity=args.sv_capacity,
                                    topology=args.topology)
-                model.fit_cascade(X, Y, cc, verbose=not args.quiet)
+                model.fit_cascade(X, Y, cc, verbose=not args.quiet,
+                                  checkpoint_path=args.checkpoint,
+                                  resume=args.resume)
                 log.info("cascade: %d rounds, converged = %s",
                          model.cascade_rounds_,
                          model.status_.name == "CONVERGED")

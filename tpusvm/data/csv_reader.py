@@ -21,17 +21,19 @@ import numpy as np
 
 
 def read_csv(
-    filename: str, n_limit: Optional[int] = None
+    filename: str, n_limit: Optional[int] = None, binary: bool = True
 ) -> Tuple[np.ndarray, np.ndarray]:
     """Read a labelled CSV the way the reference does.
 
     Args:
       filename: path to a CSV whose last column is an integer label.
       n_limit: if given, keep at most this many data rows (gpu_svm_main4.cu).
+      binary: map labels `!= 1 -> -1` (the reference's one-vs-rest mapping,
+        main3.cpp:49-52); False keeps raw integer labels for multi-class use.
 
     Returns:
       (X, Y): X float64 of shape (n, n_features); Y int32 of shape (n,) with
-      values in {+1, -1} (label != 1 mapped to -1).
+      values in {+1, -1} when binary, raw labels otherwise.
     """
     xs = []
     ys = []
@@ -44,7 +46,7 @@ def read_csv(
                 continue
             xs.append([float(v) for v in fields[:-1]])
             label = int(float(fields[-1]))
-            ys.append(1 if label == 1 else -1)
+            ys.append((1 if label == 1 else -1) if binary else label)
             if n_limit is not None and len(ys) >= n_limit:
                 break
     if not ys:

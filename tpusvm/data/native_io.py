@@ -1,0 +1,113 @@
+"""ctypes bridge to the native CSV loader (native/csv_reader.cpp).
+
+The reference's data layer is C++ (read_CSV, main3.cpp:13-54); this is the
+framework's native equivalent — a multi-threaded C++ parser behind a C ABI,
+loaded with ctypes (no pybind11 in this environment). `read_csv_fast`
+transparently falls back to the pure-Python reference-faithful reader
+(csv_reader.read_csv) when the shared library hasn't been built
+(scripts/build_native.sh) — the native path is a fast path, never a
+requirement.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+from typing import Optional, Tuple
+
+import numpy as np
+
+from tpusvm.data.csv_reader import read_csv as _py_read_csv
+
+_LIB_PATH = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "_native",
+    "libtpusvm_io.so",
+)
+
+
+class _CsvData(ctypes.Structure):
+    _fields_ = [
+        ("n", ctypes.c_int64),
+        ("d", ctypes.c_int64),
+        ("X", ctypes.POINTER(ctypes.c_double)),
+        ("Y", ctypes.POINTER(ctypes.c_int32)),
+        ("error", ctypes.c_int64),
+    ]
+
+
+_lib = None
+_lib_checked = False
+
+
+def _load_lib():
+    global _lib, _lib_checked
+    if _lib_checked:
+        return _lib
+    _lib_checked = True
+    if not os.path.exists(_LIB_PATH):
+        return None
+    try:
+        lib = ctypes.CDLL(_LIB_PATH)
+    except OSError:
+        return None
+    lib.tpusvm_read_csv.restype = ctypes.POINTER(_CsvData)
+    lib.tpusvm_read_csv.argtypes = [
+        ctypes.c_char_p,
+        ctypes.c_int64,
+        ctypes.c_int,
+        ctypes.c_int,
+    ]
+    lib.tpusvm_free_csv.restype = None
+    lib.tpusvm_free_csv.argtypes = [ctypes.POINTER(_CsvData)]
+    _lib = lib
+    return _lib
+
+
+def native_available() -> bool:
+    return _load_lib() is not None
+
+
+def read_csv_fast(
+    filename: str,
+    n_limit: Optional[int] = None,
+    binary_labels: bool = True,
+    n_threads: int = 0,
+) -> Tuple[np.ndarray, np.ndarray]:
+    """read_csv with the native multi-threaded parser when available.
+
+    Same contract as data.read_csv (header skipped, last column = label,
+    binary mode maps label != 1 -> -1, rows with < 2 fields skipped,
+    n_limit caps rows); binary_labels=False keeps raw integer labels for
+    multi-class use. n_threads=0 = one per hardware thread.
+    """
+    lib = _load_lib()
+    if lib is None:
+        return _py_read_csv(filename, n_limit, binary=binary_labels)
+
+    ptr = lib.tpusvm_read_csv(
+        os.fsencode(filename),
+        -1 if n_limit is None else int(n_limit),
+        1 if binary_labels else 0,
+        int(n_threads),
+    )
+    if not ptr:
+        raise OSError(f"native CSV reader failed to open {filename!r}")
+    try:
+        data = ptr.contents
+        if int(data.error):
+            # mirror the pure-Python reader, which raises ValueError on
+            # unparsable fields / ragged rows
+            raise ValueError(
+                f"{filename!r}: malformed CSV (unparsable field or row "
+                "whose field count differs from the header)"
+            )
+        n, d = int(data.n), int(data.d)
+        if n == 0:
+            return (np.zeros((0, max(d, 0)), np.float64),
+                    np.zeros((0,), np.int32))
+        X = np.ctypeslib.as_array(data.X, shape=(n, d)).copy()
+        Y = np.ctypeslib.as_array(data.Y, shape=(n,)).copy()
+        return X, Y
+    finally:
+        lib.tpusvm_free_csv(ptr)

@@ -136,13 +136,19 @@ class BinarySVC:
         cascade_config: CascadeConfig = CascadeConfig(),
         mesh=None,
         verbose: bool = False,
+        checkpoint_path: Optional[str] = None,
+        resume: bool = False,
     ) -> "BinarySVC":
-        """Distributed cascade training over a device mesh (MPI capability)."""
+        """Distributed cascade training over a device mesh (MPI capability).
+
+        checkpoint_path/resume: persist per-round cascade state and restart
+        from it (parallel.cascade.cascade_fit)."""
         t0 = time.perf_counter()
         Xs = self._scale_fit(np.asarray(X))
         res = cascade_fit(
             Xs, Y, self.config, cascade_config, mesh=mesh, dtype=self.dtype,
             accum_dtype=self.accum_dtype, verbose=verbose,
+            checkpoint_path=checkpoint_path, resume=resume,
         )
         self.train_time_s_ = time.perf_counter() - t0
         self.sv_X_ = res.sv_X

@@ -25,20 +25,34 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
+# Matmul precision for the distance dot-products. TPU MXUs compute f32
+# matmuls in bfloat16 passes by default (~1e-2 absolute error on [0,1]^d
+# Gram entries) — enough to perturb the SMO trajectory and break SV-set
+# parity with the f64 oracle (the reference's correctness criterion,
+# SURVEY.md §4). "float32" forces full-f32-equivalent MXU passes; pass
+# precision="default" explicitly where raw bf16 speed is worth trajectory
+# divergence. CPU/GPU backends ignore this knob (always true f32).
+DEFAULT_PRECISION = "float32"
 
-def sq_norms(X: jax.Array) -> jax.Array:
+
+def _prec(precision):
+    return DEFAULT_PRECISION if precision is None else precision
+
+
+def sq_norms(X: jax.Array, precision=None) -> jax.Array:
     """Per-row squared norms |x_i|^2, shape (n,)."""
-    return jnp.einsum("nd,nd->n", X, X)
+    return jnp.einsum("nd,nd->n", X, X, precision=_prec(precision))
 
 
-def rbf_row(X: jax.Array, x: jax.Array, gamma) -> jax.Array:
+def rbf_row(X: jax.Array, x: jax.Array, gamma, precision=None) -> jax.Array:
     """K(x, X[j]) for all j via the direct formulation. Shape (n,)."""
     diff = X - x[None, :]
-    return jnp.exp(-gamma * jnp.einsum("nd,nd->n", diff, diff))
+    return jnp.exp(-gamma * jnp.einsum("nd,nd->n", diff, diff,
+                                       precision=_prec(precision)))
 
 
 def rbf_rows_at(X: jax.Array, idx: jax.Array, gamma,
-                sn: jax.Array | None = None) -> jax.Array:
+                sn: jax.Array | None = None, precision=None) -> jax.Array:
     """K(X[idx[k]], X[j]) for a small static-size index vector idx.
 
     The SMO hot loop needs the i_high and i_low rows together; this computes
@@ -55,13 +69,15 @@ def rbf_rows_at(X: jax.Array, idx: jax.Array, gamma,
     """
     Xi = X[idx]  # (k, d)
     if sn is None:
-        sn = sq_norms(X)
-    d2 = sn[idx][:, None] + sn[None, :] - 2.0 * (Xi @ X.T)
+        sn = sq_norms(X, precision)
+    d2 = (sn[idx][:, None] + sn[None, :]
+          - 2.0 * jnp.matmul(Xi, X.T, precision=_prec(precision)))
     d2 = jnp.maximum(d2, 0.0)
     return jnp.exp(-gamma * d2)
 
 
-def rbf_rows_at_direct(X: jax.Array, idx: jax.Array, gamma) -> jax.Array:
+def rbf_rows_at_direct(X: jax.Array, idx: jax.Array, gamma,
+                       precision=None) -> jax.Array:
     """rbf_rows_at via the broadcast (X - x)^2 formulation.
 
     Numerically identical to the serial oracle's per-pair loop (no dot-trick
@@ -70,26 +86,27 @@ def rbf_rows_at_direct(X: jax.Array, idx: jax.Array, gamma) -> jax.Array:
     """
     Xi = X[idx]  # (k, d)
     diff = X[None, :, :] - Xi[:, None, :]  # (k, n, d)
-    d2 = jnp.einsum("knd,knd->kn", diff, diff)
+    d2 = jnp.einsum("knd,knd->kn", diff, diff, precision=_prec(precision))
     return jnp.exp(-gamma * d2)
 
 
 def rbf_cross(XA: jax.Array, XB: jax.Array, gamma,
-              snA: jax.Array | None = None, snB: jax.Array | None = None
-              ) -> jax.Array:
+              snA: jax.Array | None = None, snB: jax.Array | None = None,
+              precision=None) -> jax.Array:
     """Full K(XA, XB) kernel matrix, shape (nA, nB). MXU matmul."""
     if snA is None:
-        snA = sq_norms(XA)
+        snA = sq_norms(XA, precision)
     if snB is None:
-        snB = sq_norms(XB)
-    d2 = snA[:, None] + snB[None, :] - 2.0 * (XA @ XB.T)
+        snB = sq_norms(XB, precision)
+    d2 = (snA[:, None] + snB[None, :]
+          - 2.0 * jnp.matmul(XA, XB.T, precision=_prec(precision)))
     d2 = jnp.maximum(d2, 0.0)
     return jnp.exp(-gamma * d2)
 
 
 def rbf_cross_matvec(
     X: jax.Array, XB: jax.Array, coef: jax.Array, gamma,
-    sn: jax.Array | None = None, block: int = 8192,
+    sn: jax.Array | None = None, block: int = 8192, precision=None,
 ) -> jax.Array:
     """sum_k coef_k K(x_i, xb_k) for all i, blocked over i. Shape (n,).
 
@@ -108,15 +125,16 @@ def rbf_cross_matvec(
     block = min(block, n)
     nb = -(-n // block)
     if sn is None:
-        sn = sq_norms(X)
-    snB = sq_norms(XB)
+        sn = sq_norms(X, precision)
+    snB = sq_norms(XB, precision)
     coef = coef.astype(X.dtype)
 
     def step(_, start):
         zero = jnp.zeros((), start.dtype)
         Xblk = jax.lax.dynamic_slice(X, (start, zero), (block, d))
         snblk = jax.lax.dynamic_slice(sn, (start,), (block,))
-        d2 = snblk[:, None] + snB[None, :] - 2.0 * (Xblk @ XB.T)
+        d2 = (snblk[:, None] + snB[None, :]
+              - 2.0 * jnp.matmul(Xblk, XB.T, precision=_prec(precision)))
         d2 = jnp.maximum(d2, 0.0)
         return None, jnp.exp(-gamma * d2) @ coef
 
@@ -130,8 +148,8 @@ def rbf_cross_matvec(
     return out.at[idx.reshape(-1)].set(chunks.reshape(-1).astype(X.dtype))
 
 
-def rbf_matvec(X: jax.Array, coef: jax.Array, gamma, block: int = 1024
-               ) -> jax.Array:
+def rbf_matvec(X: jax.Array, coef: jax.Array, gamma, block: int = 1024,
+               precision=None) -> jax.Array:
     """sum_j coef_j K(x_j, x_i) for all i, without materialising K.
 
     Scans over j-blocks: each step is an (n, block) MXU matmul + exp + matvec.
@@ -142,15 +160,16 @@ def rbf_matvec(X: jax.Array, coef: jax.Array, gamma, block: int = 1024
     pad = nb * block - n
     Xp = jnp.pad(X, ((0, pad), (0, 0)))
     cp = jnp.pad(coef, (0, pad))  # padded rows have coef 0 -> no contribution
-    sn = sq_norms(X)
+    sn = sq_norms(X, precision)
 
     Xb = Xp.reshape(nb, block, d)
     cb = cp.reshape(nb, block)
-    snb = sq_norms(Xp).reshape(nb, block)
+    snb = sq_norms(Xp, precision).reshape(nb, block)
 
     def step(acc, args):
         Xj, cj, snj = args
-        d2 = sn[:, None] + snj[None, :] - 2.0 * (X @ Xj.T)
+        d2 = (sn[:, None] + snj[None, :]
+              - 2.0 * jnp.matmul(X, Xj.T, precision=_prec(precision)))
         d2 = jnp.maximum(d2, 0.0)
         return acc + jnp.exp(-gamma * d2) @ cj, None
 

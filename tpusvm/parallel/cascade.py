@@ -70,6 +70,59 @@ class CascadeResult(NamedTuple):
     history: List[Dict[str, Any]]
 
 
+_CKPT_VERSION = 1
+
+
+def save_round_state(path: str, global_sv: SVBuffer, prev_ids, rnd: int,
+                     b: float) -> None:
+    """Persist the cascade's inter-round state (SURVEY.md §5.4: the
+    broadcast global-SV set IS the reference's in-memory checkpoint; this
+    writes it out). Atomic via temp-file rename so a crash mid-write never
+    corrupts the previous checkpoint."""
+    import os
+
+    tmp = path + ".tmp"
+    np.savez_compressed(
+        tmp,
+        ckpt_version=_CKPT_VERSION,
+        round=rnd,
+        b=b,
+        prev_ids=np.asarray(sorted(prev_ids), np.int32),
+        sv_X=np.asarray(global_sv.X),
+        sv_Y=np.asarray(global_sv.Y),
+        sv_alpha=np.asarray(global_sv.alpha),
+        sv_ids=np.asarray(global_sv.ids),
+        sv_valid=np.asarray(global_sv.valid),
+    )
+    # np.savez appends .npz to the temp name
+    os.replace(tmp + ".npz", path)
+
+
+def load_round_state(path: str, dtype=jnp.float32):
+    """Returns (global_sv: SVBuffer, prev_ids: set, next_round: int, b)."""
+    with np.load(path, allow_pickle=False) as z:
+        if int(z["ckpt_version"]) != _CKPT_VERSION:
+            raise ValueError(
+                f"unsupported cascade checkpoint version {int(z['ckpt_version'])}"
+            )
+        buf = SVBuffer(
+            X=jnp.asarray(z["sv_X"], dtype),
+            Y=jnp.asarray(z["sv_Y"]),
+            # keep the stored dual dtype: in mixed-precision runs alpha is
+            # float64 between rounds, and truncating it would make the
+            # resumed trajectory diverge from an uninterrupted run
+            alpha=jnp.asarray(z["sv_alpha"]),
+            ids=jnp.asarray(z["sv_ids"]),
+            valid=jnp.asarray(z["sv_valid"]),
+        )
+        return (
+            buf,
+            set(z["prev_ids"].tolist()),
+            int(z["round"]) + 1,
+            float(z["b"]),
+        )
+
+
 def _squeeze(tree):
     return jax.tree.map(lambda x: x[0], tree)
 
@@ -220,6 +273,8 @@ def cascade_fit(
     dtype=jnp.float32,
     accum_dtype=None,
     verbose: bool = False,
+    checkpoint_path: Optional[str] = None,
+    resume: bool = False,
 ) -> CascadeResult:
     """Train a binary SVM with the distributed cascade.
 
@@ -227,6 +282,12 @@ def cascade_fit(
     scattering, mpi_svm_main3.cpp:529-539 — use data.MinMaxScaler on the full
     array first). accum_dtype: see smo_solve (pass jnp.float64 with f32
     features for the mixed-precision mode; needs jax x64 enabled).
+
+    checkpoint_path: if set, the inter-round state (global SV buffer +
+    previous-round ID set) is written there after every round;
+    resume=True restarts from that file if it exists (the warm-start
+    semantics make rounds naturally resumable — same X/Y/config must be
+    passed again; only round state is persisted).
     """
     cc = cascade_config
     n_shards = cc.n_shards
@@ -259,8 +320,39 @@ def cascade_fit(
     converged = False
     rounds = 0
     b = 0.0
+    start_round = 1
 
-    for rnd in range(1, svm_config.max_rounds + 1):
+    if resume and checkpoint_path is not None:
+        import os
+
+        if os.path.exists(checkpoint_path):
+            global_sv, prev_ids, start_round, b = load_round_state(
+                checkpoint_path, dtype
+            )
+            if global_sv.capacity != sv_cap or global_sv.X.shape[1] != d:
+                raise ValueError(
+                    "cascade checkpoint shapes do not match this run: "
+                    f"capacity {global_sv.capacity} vs {sv_cap}, "
+                    f"d {global_sv.X.shape[1]} vs {d}"
+                )
+            if verbose:
+                print(f"resuming cascade from round {start_round} "
+                      f"({len(prev_ids)} SVs in checkpoint)")
+            rounds = start_round - 1
+            if start_round > svm_config.max_rounds:
+                warnings.warn(
+                    f"cascade checkpoint is already at round {rounds} >= "
+                    f"max_rounds={svm_config.max_rounds}; returning the "
+                    "checkpointed model without training (raise max_rounds "
+                    "to continue)",
+                    RuntimeWarning,
+                    stacklevel=2,
+                )
+
+    # fallback result if the loop body never runs (resumed past max_rounds)
+    new_global = jax.tree.map(np.asarray, global_sv)
+
+    for rnd in range(start_round, svm_config.max_rounds + 1):
         t0 = time.perf_counter()
         out_global, b_all, diag = round_fn(part_bufs, global_sv)
         new_global = jax.tree.map(lambda x: np.asarray(x[0]), out_global)
@@ -331,6 +423,9 @@ def cascade_fit(
         if ids_now == prev_ids:
             converged = True
         prev_ids = ids_now
+
+        if checkpoint_path is not None:
+            save_round_state(checkpoint_path, new_global, prev_ids, rnd, b)
 
         if converged:
             break

@@ -216,14 +216,21 @@ def blocked_smo_solve(
             _, idx_low = lax.top_k(key_low, half)     # q/2 largest f in I_low
             B = jnp.concatenate([idx_up, idx_low]).astype(jnp.int32)
 
+            # B can contain one sample twice (an idx_up filler re-picked by
+            # idx_low); keep only the first occurrence active — two live
+            # copies of one dual variable would corrupt the f update
+            pos = jnp.arange(q, dtype=jnp.int32)
+            first_pos = jnp.full((n,), q, jnp.int32).at[B].min(pos)
+            is_first = first_pos[B] == pos
+
             X_B = X[B]
             y_B = Y[B]
             a_B = alpha[B]
             f_B = f[B]
             # members selected only as +/-inf filler (sets smaller than q/2)
             # must not participate in the subproblem
-            active_B = valid[B] & (i_high_mask(a_B, y_B, C, eps)
-                                   | i_low_mask(a_B, y_B, C, eps))
+            active_B = valid[B] & is_first & (i_high_mask(a_B, y_B, C, eps)
+                                              | i_low_mask(a_B, y_B, C, eps))
 
             K_BB = rbf_cross(X_B, X_B, gamma)
             a_B_new, upd, progress, inner_reason = _inner_smo(
@@ -232,7 +239,10 @@ def blocked_smo_solve(
 
             dcoef = (a_B_new - a_B) * y_B.astype(adt)
             df = rbf_cross_matvec(X, X_B, dcoef, gamma, sn).astype(adt)
-            return alpha.at[B].set(a_B_new), f + df, upd, progress, inner_reason
+            # .add, not .set: inactive duplicate rows carry a zero delta, so
+            # double-indexed scatter stays correct
+            return (alpha.at[B].add(a_B_new - a_B), f + df, upd, progress,
+                    inner_reason)
 
         def skip_round(args):
             alpha, f = args
