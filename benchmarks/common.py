@@ -16,7 +16,6 @@ from __future__ import annotations
 
 import json
 import sys
-import time
 
 import numpy as np
 
@@ -49,18 +48,6 @@ def make_workload(n: int, d: int = 784, seed: int = 587):
     X, Y = mnist_like(n=n, d=d, noise=30.0, label_noise=0.005, seed=seed)
     Xs = MinMaxScaler().fit_transform(X).astype(np.float32)
     return Xs, Y
-
-
-def timed_to_host(fn, *args):
-    """Run fn, materialise every array leaf on host, return (result, secs)."""
-    import jax
-
-    t0 = time.perf_counter()
-    out = fn(*args)
-    jax.tree.map(
-        lambda x: np.asarray(x) if hasattr(x, "dtype") else x, out
-    )
-    return out, time.perf_counter() - t0
 
 
 def emit(record: dict) -> None:

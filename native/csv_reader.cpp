@@ -37,38 +37,65 @@ struct Chunk {
 // an unparsable field or a row whose field count differs from the
 // header's is a parse error (the Python reader raises there too — the
 // fast path must not silently return different data than the fallback).
+// row_cap >= 0 stops after that many kept rows WITHOUT looking at later
+// lines — the Python reader breaks at the cap, so malformed rows past it
+// must not raise.
 void parse_slice(const char* begin, const char* end, long d_features,
-                 int binary_labels, Chunk* out) {
+                 int binary_labels, long row_cap, Chunk* out) {
   std::vector<double> fields;
   fields.reserve(d_features + 1);
   const char* p = begin;
   while (p < end) {
+    if (row_cap >= 0 && out->rows >= row_cap) return;
     const char* line_end = static_cast<const char*>(
         memchr(p, '\n', static_cast<size_t>(end - p)));
     if (line_end == nullptr) line_end = end;
 
-    fields.clear();
-    bool bad_field = false;
-    const char* q = p;
-    while (q < line_end) {
-      char* next = nullptr;
-      double v = strtod(q, &next);
-      if (next == q) {
-        bad_field = true;
-        break;
-      }
-      fields.push_back(v);
-      q = next;
-      while (q < line_end && *q != ',') ++q;  // tolerate trailing spaces
-      if (q < line_end) ++q;                  // skip comma
-    }
+    // Field count = comma count + 1, exactly Python's line.split(','):
+    // rows with fewer than 2 fields are skipped WITHOUT parsing (a bare
+    // "7", an empty line, or a whitespace-only line is not an error).
+    long n_fields = 1;
+    for (const char* c = p; c < line_end; ++c)
+      if (*c == ',') ++n_fields;
 
-    long nf = static_cast<long>(fields.size());
-    if (bad_field || (nf >= 2 && nf != d_features + 1)) {
-      out->parse_error = true;
-      return;
-    }
-    if (nf >= 2) {
+    if (p != line_end && n_fields >= 2) {
+      if (n_fields != d_features + 1) {
+        out->parse_error = true;
+        return;
+      }
+      fields.clear();
+      bool bad_field = false;
+      const char* q = p;
+      for (long k = 0; k < n_fields; ++k) {
+        const char* field_end = static_cast<const char*>(
+            memchr(q, ',', static_cast<size_t>(line_end - q)));
+        if (field_end == nullptr) field_end = line_end;
+        char* next = nullptr;
+        double v = strtod(q, &next);
+        // The parse is bounded to this comma-delimited span: the number
+        // must start inside it (next > q, next <= field_end — otherwise
+        // strtod's leading-whitespace skip consumed text from a later
+        // field or line) and leave only whitespace behind. Empty,
+        // whitespace-only, and trailing-garbage fields all raise in the
+        // Python fallback (float()), so they are errors here too.
+        if (next == q || next > field_end) {
+          bad_field = true;
+          break;
+        }
+        // strtod accepts C hex floats ("0x10"); Python's float() does not
+        for (const char* c = q; c < next && !bad_field; ++c)
+          if (*c == 'x' || *c == 'X') bad_field = true;
+        if (bad_field) break;
+        for (const char* c = next; c < field_end && !bad_field; ++c)
+          if (!isspace(static_cast<unsigned char>(*c))) bad_field = true;
+        if (bad_field) break;
+        fields.push_back(v);
+        q = field_end < line_end ? field_end + 1 : line_end;
+      }
+      if (bad_field) {
+        out->parse_error = true;
+        return;
+      }
       size_t base = out->X.size();
       out->X.resize(base + d_features, 0.0);
       for (long j = 0; j < d_features; ++j) out->X[base + j] = fields[j];
@@ -89,7 +116,7 @@ struct CsvData {
   int64_t d;
   double* X;       // row-major (n, d), owned
   int32_t* Y;      // (n,), owned
-  int64_t error;   // 0 = ok, 1 = parse error (X/Y are null)
+  int64_t error;   // 0 = ok, 1 = parse error, 2 = out of memory (X/Y null)
 };
 
 // Returns nullptr on IO error. n_limit < 0 means "no cap".
@@ -125,6 +152,10 @@ CsvData* tpusvm_read_csv(const char* path, int64_t n_limit,
   }
   long body_len = static_cast<long>(data_end - body);
   if (body_len < (1 << 20)) n_threads = 1;  // small file: threads cost more
+  // n_limit must stop the scan at the cap (the Python reader breaks there,
+  // so malformed rows past it never raise) — that early-exit semantics is
+  // inherently sequential
+  if (n_limit >= 0) n_threads = 1;
 
   // split [body, data_end) at newline boundaries into n_threads slices
   std::vector<const char*> starts{body};
@@ -140,17 +171,21 @@ CsvData* tpusvm_read_csv(const char* path, int64_t n_limit,
   std::vector<std::thread> workers;
   for (int t = 0; t < n_threads; ++t) {
     workers.emplace_back(parse_slice, starts[t], starts[t + 1], d_features,
-                         binary_labels, &chunks[static_cast<size_t>(t)]);
+                         binary_labels, n_limit,
+                         &chunks[static_cast<size_t>(t)]);
   }
   for (auto& w : workers) w.join();
 
+  CsvData* out = static_cast<CsvData*>(malloc(sizeof(CsvData)));
+  if (out == nullptr) return nullptr;
+  out->n = 0;
+  out->d = d_features;
+  out->X = nullptr;
+  out->Y = nullptr;
+  out->error = 0;
+
   for (const auto& c : chunks) {
     if (c.parse_error) {
-      CsvData* out = static_cast<CsvData*>(malloc(sizeof(CsvData)));
-      out->n = 0;
-      out->d = d_features;
-      out->X = nullptr;
-      out->Y = nullptr;
       out->error = 1;
       return out;
     }
@@ -159,15 +194,22 @@ CsvData* tpusvm_read_csv(const char* path, int64_t n_limit,
   int64_t total = 0;
   for (const auto& c : chunks) total += c.rows;
   if (n_limit >= 0 && total > n_limit) total = n_limit;
+  if (total == 0) return out;  // malloc(0) may legally return NULL
 
-  CsvData* out = static_cast<CsvData*>(malloc(sizeof(CsvData)));
   out->n = total;
-  out->d = d_features;
-  out->error = 0;
   out->X = static_cast<double*>(
       malloc(sizeof(double) * static_cast<size_t>(total * d_features)));
   out->Y = static_cast<int32_t*>(
       malloc(sizeof(int32_t) * static_cast<size_t>(total)));
+  if (out->X == nullptr || out->Y == nullptr) {
+    free(out->X);
+    free(out->Y);
+    out->X = nullptr;
+    out->Y = nullptr;
+    out->n = 0;
+    out->error = 2;
+    return out;
+  }
 
   int64_t row = 0;
   for (const auto& c : chunks) {

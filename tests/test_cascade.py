@@ -49,6 +49,30 @@ def test_cascade_recovers_oracle_sv_set(oracle_rings, topology, n_shards):
     )
 
 
+@pytest.mark.parametrize("topology,n_shards", [("tree", 4), ("star", 3)])
+def test_cascade_blocked_solver_recovers_oracle(oracle_rings, topology, n_shards):
+    # per-shard blocked working-set solver (the accelerated-solver-per-rank
+    # hybrid): different iteration trajectory, same SV-set fixed point
+    Xs, Y, o = oracle_rings
+    res = cascade_fit(
+        Xs, Y, CFG,
+        CascadeConfig(n_shards=n_shards, sv_capacity=256, topology=topology),
+        dtype=jnp.float64,
+        solver="blocked",
+        solver_opts={"q": 64},
+    )
+    assert res.converged
+    assert set(res.sv_ids.tolist()) == set(get_sv_indices(o.alpha).tolist())
+    np.testing.assert_allclose(res.b, o.b, atol=1e-4)
+
+
+def test_cascade_unknown_solver_rejected():
+    Xs, Y = _ring_data(n=64)
+    with pytest.raises(ValueError, match="solver"):
+        cascade_fit(Xs, Y, CFG, CascadeConfig(n_shards=2, topology="star"),
+                    solver="newton")
+
+
 def test_star_non_power_of_two_shards():
     # the classical tree requires P = 2^k (mpi_svm_main3.cpp:420-428) but the
     # star variant runs at any P

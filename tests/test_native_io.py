@@ -86,14 +86,49 @@ def test_native_empty_body(native_lib, tmp_path):
 
 
 def test_native_skips_short_rows(native_lib, tmp_path):
+    # <2-field lines skip without parsing, like Python's len(split(',')) < 2:
+    # empty line, bare number, whitespace-only line, bare garbage
     path = str(tmp_path / "short.csv")
     with open(path, "w") as f:
-        f.write("a,b,label\n1.5,2.5,1\n\n7\n3.5,4.5,0\n")
+        f.write("a,b,label\n1.5,2.5,1\n\n7\n \nx\n3.5,4.5,0\n")
     X, Y = read_csv_fast(path)
     Xp, Yp = read_csv(path)
     np.testing.assert_allclose(X, Xp)
     np.testing.assert_array_equal(Y, Yp)
     assert len(Y) == 2 and Y.tolist() == [1, -1]
+
+
+def test_native_n_limit_stops_before_malformed(native_lib, tmp_path):
+    # the Python reader breaks at the cap, so malformed rows past it never
+    # raise; the fast path must do the same
+    path = str(tmp_path / "cap.csv")
+    with open(path, "w") as f:
+        f.write("a,b,label\n1.0,2.0,1\n3.0,4.0,0\noops,bad,1\n")
+    Xn, Yn = read_csv_fast(path, n_limit=2)
+    Xp, Yp = read_csv(path, n_limit=2)
+    np.testing.assert_allclose(Xn, Xp)
+    np.testing.assert_array_equal(Yn, Yp)
+    assert len(Yn) == 2
+
+
+def test_native_n_limit_zero_matches_python(native_lib, csv_file):
+    path, _, _ = csv_file
+    Xn, Yn = read_csv_fast(path, n_limit=0)
+    Xp, Yp = read_csv(path, n_limit=0)
+    assert len(Yn) == len(Yp) == 0
+    assert Xn.shape == Xp.shape == (0, 17)
+
+
+def test_native_rejects_hex_floats(native_lib, tmp_path):
+    # strtod parses C hex floats; Python's float() raises — parity demands
+    # the fast path raise too
+    path = str(tmp_path / "hex.csv")
+    with open(path, "w") as f:
+        f.write("a,b,label\n0x10,2.0,1\n")
+    with pytest.raises(ValueError):
+        read_csv_fast(path)
+    with pytest.raises(ValueError):
+        read_csv(path)
 
 
 def test_python_raw_labels(csv_file):
@@ -130,3 +165,21 @@ def test_native_malformed_raises(native_lib, tmp_path):
         f.write("a,b,c,label\n1.0,2.0,3.0,1\n1.0,2.0,1\n")
     with pytest.raises(ValueError):
         read_csv_fast(ragged)
+    # whitespace-only trailing field: strtod's leading-whitespace skip must
+    # not cross the newline and merge the next line's first number into this
+    # row (the Python reader raises on float(' '))
+    ws = str(tmp_path / "ws.csv")
+    with open(ws, "w") as f:
+        f.write("a,b,label\n1.0,2.0, \n3.0,4.0,1\n")
+    with pytest.raises(ValueError):
+        read_csv_fast(ws)
+    with pytest.raises(ValueError):
+        read_csv(ws)
+    # trailing garbage after a number raises, like Python float("1.0x")
+    junk = str(tmp_path / "junk.csv")
+    with open(junk, "w") as f:
+        f.write("a,b,label\n1.0x,2.0,1\n")
+    with pytest.raises(ValueError):
+        read_csv_fast(junk)
+    with pytest.raises(ValueError):
+        read_csv(junk)

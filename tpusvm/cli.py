@@ -65,8 +65,9 @@ def _build_parser() -> argparse.ArgumentParser:
     )
     mode.add_argument(
         "--solver", choices=["blocked", "pair"], default=None,
-        help="single-chip solver: blocked working-set (TPU-first, default) "
-        "or pair (reference-faithful one-pair-per-iteration); ignored by "
+        help="on-device solver, for both --mode single and each cascade "
+        "shard: blocked working-set (TPU-first, default) or pair "
+        "(reference-faithful one-pair-per-iteration); ignored by "
         "--multiclass, which uses its batched vmapped solver",
     )
     mode.add_argument("--topology", choices=["tree", "star"], default="tree",
@@ -187,6 +188,13 @@ def _cmd_train(args) -> int:
                         eps=args.eps, sv_tol=args.sv_tol,
                         max_iter=args.max_iter, max_rounds=args.max_rounds)
 
+    # pure flag-consistency checks, before the (possibly long) data load
+    if args.resume and not args.checkpoint:
+        raise SystemExit("--resume requires --checkpoint")
+    if args.checkpoint and args.mode != "cascade":
+        raise SystemExit("--checkpoint/--resume only apply to --mode cascade "
+                         "(per-round cascade state is what gets persisted)")
+
     log = RunLogger(jsonl_path=args.jsonl,
                     primary=(jax.process_index() == 0) and not args.quiet)
     timer = PhaseTimer()
@@ -196,9 +204,6 @@ def _cmd_train(args) -> int:
     n, n_features = X.shape
     log.info("n = %d, n_features = %d", n, n_features)
     log.event("data", n=n, n_features=n_features, mode=args.mode)
-
-    if args.resume and not args.checkpoint:
-        raise SystemExit("--resume requires --checkpoint")
     if args.multiclass:
         if args.mode != "single":
             raise SystemExit("--multiclass currently supports --mode single")
@@ -284,14 +289,14 @@ def _fit_oracle(X, Y, cfg, timer, log):
 
 
 def _cmd_predict(args) -> int:
-    from tpusvm.data import read_csv
+    from tpusvm.data.native_io import read_csv_fast
     from tpusvm.models import BinarySVC
     from tpusvm.utils import PhaseTimer
 
     timer = PhaseTimer()
     model = BinarySVC.load(args.model)
     with timer.phase("data"):
-        X, Y = read_csv(args.data, n_limit=args.n_limit)
+        X, Y = read_csv_fast(args.data, n_limit=args.n_limit)
     if args.scores:
         for s in model.decision_function(X):
             print(f"{s:.15f}")

@@ -41,14 +41,14 @@ def read_csv(
         header = f.readline()  # discarded; defines the column count
         n_features = len(header.rstrip("\n").split(",")) - 1
         for line in f:
+            if n_limit is not None and len(ys) >= n_limit:
+                break
             fields = line.rstrip("\n").split(",")
             if len(fields) < 2:  # must have at least one feature + label
                 continue
             xs.append([float(v) for v in fields[:-1]])
             label = int(float(fields[-1]))
             ys.append((1 if label == 1 else -1) if binary else label)
-            if n_limit is not None and len(ys) >= n_limit:
-                break
     if not ys:
         return np.zeros((0, max(n_features, 0)), np.float64), np.zeros((0,), np.int32)
     X = np.asarray(xs, dtype=np.float64)

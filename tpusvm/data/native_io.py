@@ -95,6 +95,10 @@ def read_csv_fast(
         raise OSError(f"native CSV reader failed to open {filename!r}")
     try:
         data = ptr.contents
+        if int(data.error) == 2:
+            raise MemoryError(
+                f"{filename!r}: native CSV reader ran out of memory"
+            )
         if int(data.error):
             # mirror the pure-Python reader, which raises ValueError on
             # unparsable fields / ragged rows

@@ -141,6 +141,10 @@ class BinarySVC:
     ) -> "BinarySVC":
         """Distributed cascade training over a device mesh (MPI capability).
 
+        Each shard runs this estimator's configured solver ("blocked" by
+        default — the accelerated-solver-per-mesh-member hybrid; "pair" for
+        the reference-faithful trajectory).
+
         checkpoint_path/resume: persist per-round cascade state and restart
         from it (parallel.cascade.cascade_fit)."""
         t0 = time.perf_counter()
@@ -149,6 +153,7 @@ class BinarySVC:
             Xs, Y, self.config, cascade_config, mesh=mesh, dtype=self.dtype,
             accum_dtype=self.accum_dtype, verbose=verbose,
             checkpoint_path=checkpoint_path, resume=resume,
+            solver=self.solver, solver_opts=self.solver_opts,
         )
         self.train_time_s_ = time.perf_counter() - t0
         self.sv_X_ = res.sv_X

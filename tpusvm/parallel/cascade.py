@@ -53,6 +53,7 @@ from tpusvm.config import CascadeConfig, SVMConfig
 from tpusvm.data.partition import partition as make_partition
 from tpusvm.parallel.mesh import CASCADE_AXIS, make_mesh
 from tpusvm.parallel.svbuffer import SVBuffer, empty, extract_svs, merge_dedup
+from tpusvm.solver.blocked import blocked_smo_solve
 from tpusvm.solver.smo import smo_solve
 from tpusvm.status import Status
 
@@ -131,8 +132,10 @@ def _unsqueeze(tree):
     return jax.tree.map(lambda x: x[None], tree)
 
 
-def _solve(train: SVBuffer, cfg: SVMConfig, accum_dtype=None):
-    return smo_solve(
+def _solve(train: SVBuffer, cfg: SVMConfig, accum_dtype=None,
+           solver: str = "pair", solver_opts: Optional[dict] = None):
+    solve = blocked_smo_solve if solver == "blocked" else smo_solve
+    return solve(
         train.X,
         train.Y,
         valid=train.valid,
@@ -144,11 +147,13 @@ def _solve(train: SVBuffer, cfg: SVMConfig, accum_dtype=None):
         max_iter=cfg.max_iter,
         warm_start=True,
         accum_dtype=accum_dtype,
+        **(solver_opts or {}),
     )
 
 
 def _tree_round_device(
-    part_buf, global_sv, *, n_shards, train_cap, sv_cap, cfg, accum_dtype
+    part_buf, global_sv, *, n_shards, train_cap, sv_cap, cfg, accum_dtype,
+    solver, solver_opts,
 ):
     """One classical-cascade round, per device (mpi_svm_main3.cpp:565-718)."""
     part_buf = _squeeze(part_buf)
@@ -163,7 +168,7 @@ def _tree_round_device(
         active = (rank % step) == 0
         train, mcount = merge_dedup(recv, own, train_cap)
         train = train._replace(valid=train.valid & active)
-        res = _solve(train, cfg, accum_dtype)
+        res = _solve(train, cfg, accum_dtype, solver, solver_opts)
         own, svcount = extract_svs(train, res.alpha, cfg.sv_tol, sv_cap)
         b = jnp.where(active, res.b, b)
         merged_counts.append(jnp.where(active, mcount, 0))
@@ -192,13 +197,13 @@ def _tree_round_device(
 
 def _star_round_device(
     part_buf, global_sv, *, n_shards, train_cap, merged_cap, sv_cap, cfg,
-    accum_dtype,
+    accum_dtype, solver, solver_opts,
 ):
     """One modified-cascade round, per device (mpi_svm_main2.cpp:439-769)."""
     part_buf = _squeeze(part_buf)
     # Layer 1: every rank trains (global SVs [warm] u partition [alpha=0])
     train, mcount = merge_dedup(global_sv, part_buf, train_cap)
-    res = _solve(train, cfg, accum_dtype)
+    res = _solve(train, cfg, accum_dtype, solver, solver_opts)
     sv, svcount = extract_svs(train, res.alpha, cfg.sv_tol, sv_cap)
 
     # Layer 2: gather all SV sets; merge with rank0-keeps-alpha semantics
@@ -209,7 +214,7 @@ def _star_round_device(
     primary = jax.tree.map(lambda x: x[0], g)
     secondary = jax.tree.map(lambda x: x[1:].reshape((-1,) + x.shape[2:]), g)
     merged, merged_count = merge_dedup(primary, secondary, merged_cap)
-    res2 = _solve(merged, cfg, accum_dtype)
+    res2 = _solve(merged, cfg, accum_dtype, solver, solver_opts)
     new_global, gcount = extract_svs(merged, res2.alpha, cfg.sv_tol, sv_cap)
 
     diag = {
@@ -222,7 +227,8 @@ def _star_round_device(
 
 
 def _build_round_fn(
-    mesh, topology, n_shards, train_cap, merged_cap, sv_cap, cfg, accum_dtype
+    mesh, topology, n_shards, train_cap, merged_cap, sv_cap, cfg, accum_dtype,
+    solver, solver_opts,
 ):
     if topology == "tree":
         device_fn = functools.partial(
@@ -232,6 +238,8 @@ def _build_round_fn(
             sv_cap=sv_cap,
             cfg=cfg,
             accum_dtype=accum_dtype,
+            solver=solver,
+            solver_opts=solver_opts,
         )
     else:
         device_fn = functools.partial(
@@ -242,6 +250,8 @@ def _build_round_fn(
             sv_cap=sv_cap,
             cfg=cfg,
             accum_dtype=accum_dtype,
+            solver=solver,
+            solver_opts=solver_opts,
         )
     part_specs = SVBuffer(*([P(CASCADE_AXIS)] * 5))
     repl_specs = SVBuffer(*([P()] * 5))
@@ -275,6 +285,8 @@ def cascade_fit(
     verbose: bool = False,
     checkpoint_path: Optional[str] = None,
     resume: bool = False,
+    solver: str = "pair",
+    solver_opts: Optional[dict] = None,
 ) -> CascadeResult:
     """Train a binary SVM with the distributed cascade.
 
@@ -288,7 +300,18 @@ def cascade_fit(
     resume=True restarts from that file if it exists (the warm-start
     semantics make rounds naturally resumable — same X/Y/config must be
     passed again; only round state is persisted).
+
+    solver: per-shard solver — "pair" (default; the reference-faithful
+    one-pair-per-iteration solver each MPI rank runs) or "blocked" (the
+    TPU-first working-set solver, solver/blocked.py) — the on-chip
+    accelerated-solver-per-mesh-member hybrid the reference's report lists
+    as future work (SURVEY.md §2.3 last row). Both converge to the same
+    stopping criterion, so the cascade's SV-set fixed point is unchanged.
+    solver_opts: extra static solver knobs (blocked: q, max_outer,
+    max_inner).
     """
+    if solver not in ("pair", "blocked"):
+        raise ValueError(f"unknown solver {solver!r}")
     cc = cascade_config
     n_shards = cc.n_shards
     if mesh is None:
@@ -312,7 +335,7 @@ def cascade_fit(
 
     round_fn = _build_round_fn(
         mesh, cc.topology, n_shards, train_cap, merged_cap, sv_cap,
-        svm_config, accum_dtype,
+        svm_config, accum_dtype, solver, dict(solver_opts or {}),
     )
 
     prev_ids: set = set()  # reference: global_ID_sv starts empty
