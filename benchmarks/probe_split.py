@@ -1,0 +1,46 @@
+"""One-shot probe: time the blocked solver at a given (q, max_inner, max_outer).
+
+Usage: python benchmarks/probe_split.py <q> <max_inner> <max_outer>
+Prints one JSON line {q, max_inner, outers, updates, time_s}. One heavy
+measurement per process (axon runtime faults on repeats — see verify skill).
+"""
+import json
+import sys
+import time
+
+import jax
+
+jax.config.update("jax_enable_x64", True)
+
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+from tpusvm.data import MinMaxScaler, mnist_like  # noqa: E402
+from tpusvm.solver.blocked import blocked_smo_solve  # noqa: E402
+
+q, max_inner, max_outer = (int(a) for a in sys.argv[1:4])
+
+X, Y = mnist_like(n=60000, d=784, seed=0, noise=30, label_noise=0.005)
+Xs = MinMaxScaler().fit_transform(X)
+Xd = jnp.asarray(Xs, jnp.float32)
+Yd = jnp.asarray(Y, jnp.int32)
+
+solve = jax.jit(
+    lambda X, Y: blocked_smo_solve(
+        X, Y, C=10.0, gamma=0.00125, tau=1e-5, max_iter=10**9,
+        q=q, max_inner=max_inner, max_outer=max_outer,
+        accum_dtype=jnp.float64,
+    )
+)
+lowered = solve.lower(Xd, Yd).compile()
+# force the H2D transfer of X/Y to complete before the timed region
+# (block_until_ready is not a barrier on axon; materialise a reduction)
+float(np.asarray(jnp.sum(Xd))), int(np.asarray(jnp.sum(Yd)))
+t0 = time.perf_counter()
+r = lowered(Xd, Yd)
+out = (int(np.asarray(r.n_outer)), int(np.asarray(r.n_iter)) - 1,
+       int(np.asarray(r.status)))
+t1 = time.perf_counter()
+print(json.dumps({"q": q, "max_inner": max_inner, "outers": out[0],
+                  "updates": out[1], "status": out[2],
+                  "time_s": round(t1 - t0, 4)}))
