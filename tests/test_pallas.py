@@ -5,7 +5,10 @@ import numpy as np
 import pytest
 
 from tpusvm.ops.pallas import rbf_two_rows
-from tpusvm.ops.rbf import rbf_rows_at
+from tpusvm.ops.pallas.inner_smo import inner_smo_pallas
+from tpusvm.ops.rbf import rbf_cross, rbf_rows_at
+from tpusvm.solver.blocked import _inner_smo, blocked_smo_solve
+from tpusvm.status import Status
 
 
 def test_two_rows_matches_xla():
@@ -24,3 +27,87 @@ def test_two_rows_rejects_unaligned():
     X = jnp.zeros((1000, 256), jnp.float32)  # n not a TILE_N multiple
     with pytest.raises(ValueError, match="pad first"):
         rbf_two_rows(X, X[:2], 0.5, interpret=True)
+
+
+def _subproblem(q=128, seed=0, d=8, gamma=0.5):
+    rng = np.random.default_rng(seed)
+    X = rng.random((q, d)).astype(np.float32)
+    y = np.where(rng.random(q) < 0.5, 1, -1).astype(np.int32)
+    K = rbf_cross(jnp.asarray(X), jnp.asarray(X), gamma)
+    a0 = jnp.zeros(q, jnp.float32)
+    f0 = -jnp.asarray(y, jnp.float32)
+    act = jnp.ones(q, bool)
+    return K, jnp.asarray(y), a0, f0, act
+
+
+def test_inner_smo_pallas_invariants():
+    """Box feasibility, sum(y*a) conservation, dual ascent."""
+    K, y, a0, f0, act = _subproblem()
+    C = 10.0
+    a, n_upd, progress, reason = inner_smo_pallas(
+        K, y, a0, f0, act, C, 1e-12, 1e-5, max_inner=512, interpret=True
+    )
+    a = np.asarray(a)
+    assert int(n_upd) > 0 and bool(progress)
+    assert (a >= -1e-6).all() and (a <= C + 1e-6).all()
+    # every 2-variable update preserves sum(y*a); started at 0
+    np.testing.assert_allclose(float(np.sum(a * np.asarray(y))), 0.0, atol=1e-3)
+    # dual objective W(a) = sum(a) - 0.5 a^T Q a must have increased from 0
+    Q = np.asarray(K) * np.outer(np.asarray(y), np.asarray(y))
+    dual = a.sum() - 0.5 * a @ Q @ a
+    assert dual > 0.1
+    assert int(reason) in (
+        Status.CONVERGED, Status.NO_WORKING_SET, Status.MAX_ITER
+    )
+
+
+def test_inner_smo_pallas_matches_xla_before_bailout():
+    """With no numerical bail-outs, the f32 trajectories are identical."""
+    K, y, a0, f0, act = _subproblem(seed=3)
+    a_x, n_x, _, r_x = _inner_smo(K, y, a0, f0, act, 10.0, 1e-12, 1e-5, 200)
+    a_p, n_p, _, r_p = inner_smo_pallas(
+        K, y, a0, f0, act, 10.0, 1e-12, 1e-5, max_inner=200, interpret=True
+    )
+    # the XLA engine hit its cap cleanly (no stall/infeasible/eta bail-out),
+    # so shrinking never engaged and the two runs are the same sequence
+    assert int(r_x) == Status.MAX_ITER, Status(int(r_x)).name
+    assert int(n_x) == int(n_p) == 200
+    np.testing.assert_array_equal(np.asarray(a_x), np.asarray(a_p))
+
+
+def test_inner_smo_pallas_rejects_unaligned():
+    K, y, a0, f0, act = _subproblem(q=100)
+    with pytest.raises(ValueError, match="q % 128"):
+        inner_smo_pallas(K, y, a0, f0, act, 10.0, 1e-12, 1e-5,
+                         max_inner=64, interpret=True)
+
+
+def test_blocked_pallas_engine_matches_xla_solution():
+    """Same optimum (solution-level parity) from both inner engines."""
+    rng = np.random.default_rng(42)
+    n, d = 256, 16
+    X = jnp.asarray(rng.random((n, d)), jnp.float32)
+    Y = jnp.asarray(np.where(rng.random(n) < 0.5, 1, -1), jnp.int32)
+    kw = dict(C=10.0, gamma=1.0, tau=1e-5, q=128, max_inner=256,
+              max_outer=500, accum_dtype=jnp.float64)
+    r_x = blocked_smo_solve(X, Y, inner="xla", **kw)
+    r_p = blocked_smo_solve(X, Y, inner="pallas", **kw)
+    assert int(r_x.status) == Status.CONVERGED
+    assert int(r_p.status) == Status.CONVERGED
+    np.testing.assert_allclose(float(r_p.b), float(r_x.b), atol=5e-4)
+    sv_x = np.asarray(r_x.alpha) > 1e-8
+    sv_p = np.asarray(r_p.alpha) > 1e-8
+    # SV sets agree up to tau-level boundary cases
+    assert (sv_x != sv_p).mean() < 0.02
+    np.testing.assert_allclose(
+        np.asarray(r_p.alpha), np.asarray(r_x.alpha), atol=2e-3
+    )
+
+
+def test_blocked_rejects_bad_inner():
+    X = jnp.zeros((16, 4), jnp.float32)
+    Y = jnp.asarray([1, -1] * 8, jnp.int32)
+    with pytest.raises(ValueError, match="inner must be"):
+        blocked_smo_solve(X, Y, inner="cuda")
+    with pytest.raises(ValueError, match="multiple of 128"):
+        blocked_smo_solve(X, Y, inner="pallas", q=16)

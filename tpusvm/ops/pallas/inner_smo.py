@@ -1,0 +1,225 @@
+"""Pallas TPU kernel: the ENTIRE blocked-SMO inner subproblem in one launch.
+
+The blocked solver (solver/blocked.py) spends ~85% of its wall-clock in the
+inner working-set subproblem: up to max_inner sequential 2-variable SMO
+updates over a VMEM-sized K_BB. Expressed as an XLA `lax.while_loop`, each
+tiny O(q) iteration costs ~36us of fixed per-op dispatch overhead on this
+TPU runtime (measured with benchmarks/probe_split.py: 84k updates = 3.4s of
+a 4.1s MNIST-60k solve). This kernel fuses the whole subproblem — working
+-set selection, analytic pair update, f/alpha updates, and the termination
+cascade — into ONE kernel launch with K_BB resident in VMEM, so each inner
+iteration is a handful of VPU ops on (1, q) vectors instead of a dispatched
+XLA op graph.
+
+This is the TPU-native analogue of how GPU SVM solvers run the subproblem in
+a single thread block against shared-memory K (the design the reference's
+own literature uses — SURVEY.md §2 papers list); the reference itself pays a
+host round-trip per update (gpu_svm_main3.cu:363-467, 9 memcpys/iter), which
+SURVEY.md §3.2 flags as the structural inefficiency to eliminate.
+
+Semantics match solver/blocked.py's `_inner_smo` (same selection rule, same
+shared `pair_update` scalar step from solver/analytic.py) with two
+deviations:
+  - float32 compute (TPU VPU/Mosaic has no f64). The outer loop re-derives
+    the global f in the accum dtype each round, so inner f32 drift is
+    bounded by one subproblem and reset every outer round; convergence is
+    still judged on the accum-dtype global f.
+  - SHRINKING instead of bail-out: where `_inner_smo` ends the subproblem
+    on a zero-progress pair (box-pinned, infeasible [U,V], or eta <= eps —
+    deterministic re-selection would spin), this kernel deactivates that
+    pair's i_low for the rest of the subproblem and keeps going, so the
+    possible end reasons are only CONVERGED / NO_WORKING_SET / MAX_ITER.
+    f32 hits zero-progress pairs mid-optimisation (measured: a box-pinned
+    pair 12 rounds into MNIST-60k with b-gap still 0.42) where f64 happens
+    to take a different trajectory; shrinking makes the subproblem finish
+    its violator budget regardless.
+
+Alignment: q % 128 == 0 (lane width). Callers fall back to the XLA inner
+loop for small/unaligned working sets.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from tpusvm.solver.analytic import pair_update
+from tpusvm.status import Status
+
+LANE = 128
+
+_RUNNING = int(Status.RUNNING)
+_CONVERGED = int(Status.CONVERGED)
+_NO_WS = int(Status.NO_WORKING_SET)
+_INFEASIBLE = int(Status.INFEASIBLE_UV)
+_NONPOS_ETA = int(Status.NONPOS_ETA)
+_MAX_ITER = int(Status.MAX_ITER)
+_STALLED = int(Status.STALLED)
+
+
+def _make_kernel(q: int, max_inner: int):
+    def kernel(scal_ref, K_ref, diag_ref, y_ref, a0_ref, f0_ref, act_ref,
+               aout_ref, stat_ref):
+        iota = lax.broadcasted_iota(jnp.int32, (1, q), 1)
+
+        def pick(v, i):
+            """v[0, i] for a traced scalar i, as a masked reduction (no
+            dynamic scalar addressing into loop-carried values on the VPU)."""
+            return jnp.sum(jnp.where(iota == i, v, 0.0))
+
+        C = scal_ref[0]
+        eps = scal_ref[1]
+        tau = scal_ref[2]
+        y = y_ref[:]                      # (1, q) float32, +/-1 (0 on pads)
+        diag = diag_ref[:]                # (1, q) K_BB diagonal
+        pos = y > 0.0
+
+        def cond(st):
+            return st[5] == _RUNNING
+
+        def body(st):
+            # act carried as a f32 mask: Mosaic can't lay out i1 vector
+            # carries in scf.while
+            a, f, act_f, n_upd, progress, _ = st
+            act = act_f > 0.5
+            # boolean algebra, not jnp.where over bools: Mosaic can't lower
+            # i8->i1 vector select operands
+            lo = a > eps
+            hi = a < C - eps
+            m_h = act & ((pos & hi) | (~pos & lo))
+            m_l = act & ((pos & lo) | (~pos & hi))
+
+            vh = jnp.where(m_h, f, jnp.inf)
+            b_h = jnp.min(vh)
+            i_h = jnp.min(jnp.where(vh == b_h, iota, jnp.int32(q)))
+            vl = jnp.where(m_l, f, -jnp.inf)
+            b_l = jnp.max(vl)
+            i_l = jnp.min(jnp.where(vl == b_l, iota, jnp.int32(q)))
+
+            # emptiness check without jnp.any (whose Mosaic lowering goes
+            # through an f64 squeeze under x64): masked-out lanes are +/-inf,
+            # and live f values are always finite
+            found = (b_h < jnp.inf) & (b_l > -jnp.inf)
+            converged = found & (b_l <= b_h + 2.0 * tau)
+            proceed = found & ~converged
+
+            # clamp so the row loads stay in bounds when not found (i == q)
+            i_h = jnp.minimum(i_h, jnp.int32(q - 1))
+            i_l = jnp.minimum(i_l, jnp.int32(q - 1))
+
+            row_h = K_ref[pl.ds(i_h, 1), :]   # (1, q)
+            row_l = K_ref[pl.ds(i_l, 1), :]
+            K11 = pick(diag, i_h)
+            K22 = pick(diag, i_l)
+            K12 = pick(row_h, i_l)
+            y_h = pick(y, i_h)
+            y_l = pick(y, i_l)
+            a_h = pick(a, i_h)
+            a_l = pick(a, i_l)
+
+            upd = pair_update(K11, K22, K12, y_h, y_l, a_h, a_l, b_h, b_l,
+                              C, eps, proceed)
+
+            f = f + upd.da_h * y_h * row_h + upd.da_l * y_l * row_l
+            a = (a + jnp.where(iota == i_h, upd.da_h, 0.0)
+                   + jnp.where(iota == i_l, upd.da_l, 0.0))
+            ok = upd.do_update & ~upd.stalled
+            n_upd = n_upd + ok.astype(jnp.int32)
+            progress = jnp.maximum(progress, ok.astype(jnp.int32))
+
+            # SHRINKING: a pair that yields zero progress (box-pinned pair,
+            # U > V, or eta <= eps — all deterministic given (i_h, i_l), so
+            # re-selecting it would spin forever, which is exactly how the
+            # f32 subproblem stalls mid-optimisation) deactivates its i_low
+            # for the REST OF THIS SUBPROBLEM ONLY; selection then moves to
+            # the next violator. The outer round rebuilds the working set
+            # with full masks, so nothing leaks out. Termination: every
+            # iteration either updates (bounded by max_inner) or deactivates
+            # one index (bounded by q).
+            dead = proceed & (~upd.feasible | ~upd.eta_ok | upd.stalled)
+            act_f = jnp.where(dead & (iota == i_l), 0.0, act_f)
+
+            # explicit int32 constants: under jax_enable_x64 bare python ints
+            # promote to int64, which Mosaic cannot lower
+            reason = jnp.where(
+                ~found,
+                jnp.int32(_NO_WS),
+                jnp.where(
+                    converged,
+                    jnp.int32(_CONVERGED),
+                    jnp.where(
+                        n_upd >= max_inner,
+                        jnp.int32(_MAX_ITER),
+                        jnp.int32(_RUNNING),
+                    ),
+                ),
+            )
+            return (a, f, act_f, n_upd, progress, reason)
+
+        a, _f, _act, n_upd, progress, reason = lax.while_loop(
+            cond, body,
+            (a0_ref[:], f0_ref[:], act_ref[:], jnp.int32(0),
+             jnp.int32(0), jnp.int32(_RUNNING)),
+        )
+        aout_ref[:] = a
+        stat_ref[0] = n_upd
+        stat_ref[1] = progress
+        stat_ref[2] = reason
+
+    return kernel
+
+
+@functools.partial(jax.jit, static_argnames=("max_inner", "interpret"))
+def inner_smo_pallas(K_BB, y_B, a_B, f_B, active_B, C, eps, tau, *,
+                     max_inner: int, interpret: bool = False):
+    """Run the inner working-set SMO subproblem as one fused TPU kernel.
+
+    Same contract as solver/blocked.py `_inner_smo`: returns
+    (a_B_new, n_updates, made_progress, end_reason). Inputs may be any float
+    dtype; compute is float32 (see module docstring), and a_B_new comes back
+    in a_B's dtype.
+    """
+    q = y_B.shape[0]
+    if q % LANE:
+        raise ValueError(f"inner_smo_pallas needs q % {LANE} == 0, got {q}")
+    scal = jnp.stack([
+        jnp.asarray(C, jnp.float32),
+        jnp.asarray(eps, jnp.float32),
+        jnp.asarray(tau, jnp.float32),
+    ])
+    K32 = K_BB.astype(jnp.float32)
+    aout, stat = pl.pallas_call(
+        _make_kernel(q, max_inner),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((1, q), jnp.float32),
+            jax.ShapeDtypeStruct((3,), jnp.int32),
+        ],
+        interpret=interpret,
+    )(
+        scal,
+        K32,
+        jnp.diagonal(K32)[None, :],
+        y_B.astype(jnp.float32)[None, :],
+        a_B.astype(jnp.float32)[None, :],
+        f_B.astype(jnp.float32)[None, :],
+        active_B.astype(jnp.float32)[None, :],
+    )
+    return (aout[0].astype(a_B.dtype), stat[0], stat[1] > 0, stat[2])

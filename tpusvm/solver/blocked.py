@@ -48,6 +48,8 @@ from tpusvm.solver.analytic import pair_update
 from tpusvm.solver.smo import SMOResult
 from tpusvm.status import Status
 
+_PALLAS_LANE = 128
+
 
 class _OuterState(NamedTuple):
     alpha: jax.Array      # (n,) accum dtype
@@ -140,7 +142,8 @@ def _inner_smo(K_BB, y_B, a_B, f_B, active_B, C, eps, tau, max_inner):
 
 @functools.partial(
     jax.jit,
-    static_argnames=("q", "max_outer", "max_inner", "warm_start", "accum_dtype"),
+    static_argnames=("q", "max_outer", "max_inner", "warm_start",
+                     "accum_dtype", "inner"),
 )
 def blocked_smo_solve(
     X: jax.Array,
@@ -158,6 +161,7 @@ def blocked_smo_solve(
     max_inner: int = 1024,
     warm_start: bool = False,
     accum_dtype=None,
+    inner: str = "auto",
 ) -> SMOResult:
     """Train to the reference's stopping criterion with blocked working sets.
 
@@ -170,12 +174,31 @@ def blocked_smo_solve(
     benchmark: larger working sets amortise the outer O(n*d*q) update over
     more inner updates, while capping the inner loop stops the subproblem
     from being over-optimised against stale fixed alphas.
+
+    inner selects the subproblem engine: "xla" = the lax.while_loop
+    `_inner_smo` (runs anywhere, ~36us/update dispatch overhead on TPU);
+    "pallas" = the fused single-launch kernel (ops/pallas/inner_smo.py,
+    float32 subproblem, interpreted off-TPU); "auto" = pallas on TPU when
+    q is lane-aligned, xla otherwise.
     """
     n = Y.shape[0]
     dtype = X.dtype
     adt = dtype if accum_dtype is None else accum_dtype
     q = min(q, n if n % 2 == 0 else n - 1) if n >= 2 else 2
     half = q // 2
+
+    if inner not in ("auto", "xla", "pallas"):
+        raise ValueError(f"inner must be auto|xla|pallas, got {inner!r}")
+    if inner == "auto":
+        inner = ("pallas" if jax.default_backend() == "tpu"
+                 and q % _PALLAS_LANE == 0 else "xla")
+    elif inner == "pallas" and q % _PALLAS_LANE:
+        raise ValueError(
+            f"inner='pallas' needs the working-set size to be a multiple of "
+            f"{_PALLAS_LANE}, but q={q} after clamping to the n={n} training "
+            f"rows; use inner='auto' to fall back to the XLA engine on "
+            f"small/unaligned problems"
+        )
 
     if valid is None:
         valid = jnp.ones((n,), bool)
@@ -233,15 +256,48 @@ def blocked_smo_solve(
                                               | i_low_mask(a_B, y_B, C, eps))
 
             K_BB = rbf_cross(X_B, X_B, gamma)
-            a_B_new, upd, progress, inner_reason = _inner_smo(
-                K_BB, y_B, a_B, f_B, active_B, C, eps, tau, max_inner
-            )
+            if inner == "pallas":
+                from tpusvm.ops.pallas.inner_smo import inner_smo_pallas
 
-            dcoef = (a_B_new - a_B) * y_B.astype(adt)
+                # delta against the f32-QUANTIZED baseline, not the f64 a_B:
+                # the kernel round-trips alpha through f32, so lanes it never
+                # touched come back as f32(a_B) — diffing against a_B would
+                # scatter ~6e-8*C quantization residues into the f64
+                # accumulator on every selected-but-unchanged lane (and
+                # double-count them on inactive duplicate rows)
+                a_B_q = a_B.astype(jnp.float32).astype(adt)
+                a_B_new, upd, progress, inner_reason = inner_smo_pallas(
+                    K_BB, y_B, a_B, f_B, active_B, C, eps, tau,
+                    max_inner=max_inner,
+                    interpret=jax.default_backend() != "tpu",
+                )
+                da_B = a_B_new - a_B_q
+                # f32 rescue hatch: if the fused kernel's float32 subproblem
+                # made zero progress (every selected violator box-pinned at
+                # f32 resolution), retry the round with the accum-dtype XLA
+                # engine before letting the outer loop declare a stall. The
+                # slow path compiles into the graph but executes only on
+                # zero-progress rounds (rare: none on the converged MNIST-60k
+                # runs, but q=1536 runs hit it mid-solve).
+                da_B, upd, progress, inner_reason = lax.cond(
+                    progress,
+                    lambda: (da_B, upd, progress, inner_reason),
+                    lambda: (lambda r: (r[0] - a_B, r[1], r[2], r[3]))(
+                        _inner_smo(K_BB, y_B, a_B, f_B, active_B, C, eps,
+                                   tau, max_inner)
+                    ),
+                )
+            else:
+                a_B_new, upd, progress, inner_reason = _inner_smo(
+                    K_BB, y_B, a_B, f_B, active_B, C, eps, tau, max_inner
+                )
+                da_B = a_B_new - a_B
+
+            dcoef = da_B * y_B.astype(adt)
             df = rbf_cross_matvec(X, X_B, dcoef, gamma, sn).astype(adt)
             # .add, not .set: inactive duplicate rows carry a zero delta, so
             # double-indexed scatter stays correct
-            return (alpha.at[B].add(a_B_new - a_B), f + df, upd, progress,
+            return (alpha.at[B].add(da_B), f + df, upd, progress,
                     inner_reason)
 
         def skip_round(args):
