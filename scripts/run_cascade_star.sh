@@ -1,0 +1,21 @@
+#!/usr/bin/env bash
+# Modified two-layer (star) Cascade SVM run — the TPU equivalent of the
+# reference's code/mpi_svm2.sh (2 nodes x 32 tasks, mpirun -np 4
+# ./mpi_svm2). Every shard trains in parallel, support vectors gather to
+# shard 0 for the merged retrain (mpi_svm_main2.cpp:439-769 capability).
+# Star topology accepts any shard count (no power-of-two restriction).
+#
+#   scripts/run_cascade_star.sh                # P = all visible devices
+#   SHARDS=8 scripts/run_cascade_star.sh       # explicit P
+#
+# CPU-simulated mesh and multi-host notes: see run_cascade_tree.sh.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+ARGS=(--mode cascade --topology star)
+[ -n "${SHARDS:-}" ] && ARGS+=(--shards "$SHARDS")
+if [ "$#" -gt 0 ]; then
+  exec python -m tpusvm train "${ARGS[@]}" "$@"
+fi
+exec python -m tpusvm train "${ARGS[@]}" --synthetic mnist-like \
+  --n 60000 --n-test 10000

@@ -1,0 +1,26 @@
+#!/usr/bin/env bash
+# Classical (binary-tree) Cascade SVM run — the TPU equivalent of the
+# reference's code/mpi_svm3.sh (2 nodes x 32 tasks, mpirun -np 2
+# ./mpi_svm3). Shard count P maps to mesh size instead of MPI ranks; the
+# tree topology requires P to be a power of two, exactly like the
+# reference's __builtin_ctz world-size check (mpi_svm_main3.cpp:420-428).
+#
+#   scripts/run_cascade_tree.sh                # P = all visible devices
+#   SHARDS=8 scripts/run_cascade_tree.sh       # explicit P
+#
+# Without TPU hardware, simulate a mesh on CPU the same way the tests do
+# (--platform cpu, because site configuration may override JAX_PLATFORMS):
+#   XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+#     SHARDS=8 scripts/run_cascade_tree.sh --platform cpu
+# Multi-host pods need no mpirun equivalent: launch the same command on
+# every host (jax.distributed discovers peers from the TPU metadata).
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+ARGS=(--mode cascade --topology tree)
+[ -n "${SHARDS:-}" ] && ARGS+=(--shards "$SHARDS")
+if [ "$#" -gt 0 ]; then
+  exec python -m tpusvm train "${ARGS[@]}" "$@"
+fi
+exec python -m tpusvm train "${ARGS[@]}" --synthetic mnist-like \
+  --n 60000 --n-test 10000

@@ -1,0 +1,20 @@
+#!/usr/bin/env bash
+# Single-accelerator SMO training run — the TPU equivalent of the
+# reference's code/gpu_svm.sh (1 node, --gres=gpu:1, runs ./gpu_svm on
+# MNIST-60k). Here: one TPU chip, the blocked working-set solver, the
+# MNIST-60k-shaped workload, reference hyperparameters (zero flags needed
+# for a parity run).
+#
+# Real-data variant (after scripts/make_mnist_csv.py has produced CSVs):
+#   scripts/run_single.sh --train mnist3_train_data.csv --test mnist3_test_data.csv
+#
+# On a Cloud TPU VM there is no SLURM; run directly, or under
+# `gcloud compute tpus tpu-vm ssh ... --command` for remote submission.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+if [ "$#" -gt 0 ]; then
+  exec python -m tpusvm train --mode single "$@"
+fi
+exec python -m tpusvm train --mode single --synthetic mnist-like \
+  --n 60000 --n-test 10000

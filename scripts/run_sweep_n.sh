@@ -1,0 +1,24 @@
+#!/usr/bin/env bash
+# Training-size sweep — the TPU equivalent of the reference's
+# code/gpu_svm4.sh (loop n in 10000..60000 running ./gpu_svm4 $n, i.e. the
+# gpu_svm_main4.cu n_limit build; report Table 2 / BASELINE.md B3).
+#
+#   scripts/run_sweep_n.sh                          # synthetic, 10k..60k
+#   scripts/run_sweep_n.sh --train mnist3_train_data.csv --test mnist3_test_data.csv
+#
+# Any extra flags are forwarded to every run; --n-limit supplies the cap
+# exactly as gpu_svm_main4 took argv[1]. benchmarks/sweep_n.py is the
+# richer harness (JSON output, per-phase timings) — this script is the
+# operational parity launcher.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+for n in 10000 20000 30000 40000 50000 60000; do
+  echo "=== n_limit = $n ==="
+  if [ "$#" -gt 0 ]; then
+    python -m tpusvm train --mode single --n-limit "$n" "$@"
+  else
+    python -m tpusvm train --mode single --synthetic mnist-like \
+      --n 60000 --n-test 10000 --n-limit "$n"
+  fi
+done

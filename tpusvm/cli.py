@@ -29,13 +29,29 @@ import numpy as np
 
 
 def _build_parser() -> argparse.ArgumentParser:
+    # --platform is accepted both before and after the subcommand (launcher
+    # scripts append user flags after `train`). The subparser copy defaults
+    # to SUPPRESS so that when the flag is absent there, it does not
+    # overwrite a value the root parser already captured.
+    platform_help = (
+        "force a JAX platform (set before backend init, so it works even "
+        "where site configuration overrides the JAX_PLATFORMS env var); "
+        "combine with XLA_FLAGS=--xla_force_host_platform_device_count=N "
+        "for an N-device simulated CPU mesh"
+    )
+    common = argparse.ArgumentParser(add_help=False)
+    common.add_argument("--platform", choices=["cpu", "tpu"],
+                        default=argparse.SUPPRESS, help=platform_help)
     p = argparse.ArgumentParser(
         prog="tpusvm",
         description="TPU-native parallel SVM training (JAX/XLA/Pallas).",
     )
+    p.add_argument("--platform", choices=["cpu", "tpu"], default=None,
+                   help=platform_help)
     sub = p.add_subparsers(dest="command", required=True)
 
-    tr = sub.add_parser("train", help="train a model and optionally evaluate")
+    tr = sub.add_parser("train", parents=[common],
+                        help="train a model and optionally evaluate")
     src = tr.add_argument_group("data source (one of --train / --synthetic)")
     src.add_argument("--train", metavar="CSV", help="training CSV (last column = label)")
     src.add_argument("--test", metavar="CSV", help="held-out CSV to evaluate on")
@@ -116,14 +132,16 @@ def _build_parser() -> argparse.ArgumentParser:
                      help="capture a jax.profiler trace of training")
     out.add_argument("-q", "--quiet", action="store_true")
 
-    pr = sub.add_parser("predict", help="evaluate a saved model on a CSV")
+    pr = sub.add_parser("predict", parents=[common],
+                        help="evaluate a saved model on a CSV")
     pr.add_argument("--model", required=True, metavar="NPZ")
     pr.add_argument("--data", required=True, metavar="CSV")
     pr.add_argument("--n-limit", type=int, default=None)
     pr.add_argument("--scores", action="store_true",
                     help="print decision scores instead of accuracy")
 
-    sub.add_parser("info", help="print device / backend information")
+    sub.add_parser("info", parents=[common],
+                   help="print device / backend information")
     return p
 
 
@@ -322,6 +340,10 @@ def _cmd_info(args) -> int:
 
 def main(argv=None) -> int:
     args = _build_parser().parse_args(argv)
+    if args.platform:
+        import jax
+
+        jax.config.update("jax_platforms", args.platform)
     return {"train": _cmd_train, "predict": _cmd_predict, "info": _cmd_info}[
         args.command
     ](args)

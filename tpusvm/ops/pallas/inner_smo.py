@@ -53,13 +53,12 @@ from tpusvm.status import Status
 
 LANE = 128
 
+# the only statuses this kernel can end with (shrinking replaces the
+# INFEASIBLE_UV / NONPOS_ETA / STALLED bail-outs — see module docstring)
 _RUNNING = int(Status.RUNNING)
 _CONVERGED = int(Status.CONVERGED)
 _NO_WS = int(Status.NO_WORKING_SET)
-_INFEASIBLE = int(Status.INFEASIBLE_UV)
-_NONPOS_ETA = int(Status.NONPOS_ETA)
 _MAX_ITER = int(Status.MAX_ITER)
-_STALLED = int(Status.STALLED)
 
 
 def _make_kernel(q: int, max_inner: int):
