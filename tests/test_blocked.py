@@ -107,3 +107,47 @@ def test_blocked_surfaces_nonpos_eta():
     Yd = np.array([1, -1, 1, -1], np.int32)
     r = blocked_smo_solve(jnp.asarray(Xd), jnp.asarray(Yd), C=1.0, gamma=0.5, q=4)
     assert int(r.status) == Status.NONPOS_ETA
+
+
+def test_blocked_refine_drift_control():
+    """refine mode re-validates convergence on a reconstructed f and still
+    terminates (bounded by max_refines), landing at the same solution."""
+    rng = np.random.default_rng(7)
+    n, d = 512, 16
+    X = jnp.asarray(rng.random((n, d)), jnp.float32)
+    Y = jnp.asarray(np.where(rng.random(n) < 0.5, 1, -1), jnp.int32)
+    kw = dict(C=10.0, gamma=1.0, tau=1e-5, q=128, max_inner=256,
+              max_outer=2000, accum_dtype=jnp.float64)
+    r0 = blocked_smo_solve(X, Y, **kw)
+    r1 = blocked_smo_solve(X, Y, refine=n, max_refines=2, **kw)
+    assert int(r0.status) == Status.CONVERGED
+    assert int(r1.status) == Status.CONVERGED
+    # the refine path actually fired (at least one f reconstruction ran)
+    assert r0.n_refines is None or int(r0.n_refines) == 0
+    assert int(r1.n_refines) >= 1
+    # same optimum within the f32 kernel-evaluation noise band
+    np.testing.assert_allclose(float(r1.b), float(r0.b), atol=1e-3)
+    np.testing.assert_allclose(np.asarray(r1.alpha), np.asarray(r0.alpha),
+                               atol=5e-3)
+
+
+def test_blocked_refine_overcap_skips_reconstruction():
+    """More live alphas than the cap: reconstruction must be skipped, not
+    computed from a truncated coefficient set (which would corrupt f and
+    derail the solve to a different b)."""
+    rng = np.random.default_rng(7)
+    n, d = 512, 16
+    # random labels on uniform points -> nearly every alpha ends up at a
+    # bound, so live alphas far exceed a cap of 128
+    X = jnp.asarray(rng.random((n, d)), jnp.float32)
+    Y = jnp.asarray(np.where(rng.random(n) < 0.5, 1, -1), jnp.int32)
+    kw = dict(C=10.0, gamma=1.0, tau=1e-5, q=128, max_inner=256,
+              max_outer=2000, accum_dtype=jnp.float64)
+    r0 = blocked_smo_solve(X, Y, **kw)
+    r1 = blocked_smo_solve(X, Y, refine=128, max_refines=2, **kw)
+    assert int(np.asarray(jnp.sum(r1.alpha > 0))) > 128
+    assert int(r1.status) == Status.CONVERGED
+    assert int(r1.n_refines) == 0  # over-cap: reconstruction never ran
+    np.testing.assert_allclose(float(r1.b), float(r0.b), atol=1e-6)
+    np.testing.assert_allclose(np.asarray(r1.alpha), np.asarray(r0.alpha),
+                               atol=1e-6)

@@ -71,6 +71,8 @@ class SMOResult(NamedTuple):
     status: jax.Array
     # blocked solver only: number of outer (working-set) iterations
     n_outer: Optional[jax.Array] = None
+    # blocked solver only: f reconstructions done by refine mode
+    n_refines: Optional[jax.Array] = None
 
 
 def _body(state: SMOState, X, Y, valid, sn, C, gamma, eps, tau, max_iter):
