@@ -76,19 +76,19 @@ def main():
     ).compile()
     log(f"compile: {time.perf_counter() - t0:.1f}s")
 
-    # Force the H2D transfer of X/Y to COMPLETE before the timed region.
-    # device_put on this tunneled runtime is lazy: the 188MB X upload
-    # otherwise lands inside the first executable invocation and adds ~6.5s
-    # to the measurement. That 6.5s is the development SSH tunnel, not TPU
-    # DMA (188MB over a real TPU host's PCIe/DMA is ~10ms). The reference's
-    # timer DOES include its own H->D copies (cudaEventRecord at
-    # gpu_svm_main3.cu:524 precedes the memcpys at :543-547) — but those are
-    # ~1.2GB over local PCIe, ~0.1s of its 58.57s, a negligible fraction it
-    # pays and we don't; noted here rather than hidden. Excluding the tunnel
-    # keeps the measurement about the framework, not the dev harness.
-    # block_until_ready is not a barrier on axon; materialise reductions.
-    np.asarray(jnp.sum(Xd))
-    np.asarray(jnp.sum(Yd))
+    # Force the H2D transfer of X/Y to COMPLETE before the timed region
+    # (benchmarks.common.h2d_sync). The 188MB X upload otherwise lands
+    # inside the first executable invocation and adds ~6.5s of development
+    # SSH tunnel — not TPU DMA (188MB over a real TPU host's PCIe/DMA is
+    # ~10ms). The reference's timer DOES include its own H->D copies
+    # (cudaEventRecord at gpu_svm_main3.cu:524 precedes the memcpys at
+    # :543-547) — but those are ~1.2GB over local PCIe, ~0.1s of its
+    # 58.57s, a negligible fraction it pays and we don't; noted here rather
+    # than hidden. Excluding the tunnel keeps the measurement about the
+    # framework, not the dev harness.
+    from benchmarks.common import h2d_sync
+
+    h2d_sync(Xd, Yd)
 
     log("training (timed region)...")
     # NOTE: jax.block_until_ready returns early on this environment's

@@ -52,3 +52,19 @@ def make_workload(n: int, d: int = 784, seed: int = 587):
 
 def emit(record: dict) -> None:
     print(json.dumps(record), flush=True)
+
+
+def h2d_sync(*arrays) -> None:
+    """Force pending H2D uploads of `arrays` to COMPLETE before returning.
+
+    device_put on the tunneled axon runtime is lazy, and
+    jax.block_until_ready returns early there (it is not a completion
+    barrier — see .claude/skills/verify/SKILL.md), so benchmark timers
+    started after a bare device_put would absorb the upload. Materialising
+    a reduction on the host is the reliable barrier.
+    """
+    import jax.numpy as jnp
+    import numpy as np
+
+    for a in arrays:
+        np.asarray(jnp.sum(a))

@@ -33,9 +33,9 @@ solve = jax.jit(
     )
 )
 lowered = solve.lower(Xd, Yd).compile()
-# force the H2D transfer of X/Y to complete before the timed region
-# (block_until_ready is not a barrier on axon; materialise a reduction)
-float(np.asarray(jnp.sum(Xd))), int(np.asarray(jnp.sum(Yd)))
+from benchmarks.common import h2d_sync  # noqa: E402
+
+h2d_sync(Xd, Yd)
 t0 = time.perf_counter()
 r = lowered(Xd, Yd)
 out = (int(np.asarray(r.n_outer)), int(np.asarray(r.n_iter)) - 1,

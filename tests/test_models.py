@@ -116,3 +116,24 @@ def test_fit_warns_on_non_convergence():
                       dtype=jnp.float64, solver="pair").fit(X, Y)
     assert m.status_ == Status.MAX_ITER
     assert any("MAX_ITER" in str(r.message) for r in rec)
+
+
+def test_ovr_blocked_solver_matches_pair():
+    X, labels = _four_class_data(n=240, seed=2)
+    cfg = SVMConfig(C=10.0, gamma=2.0)
+    # f64 accumulators: the mixed-precision mode (pure-f32 blocked solves
+    # can stall near convergence, which BinarySVC/OneVsRestSVC surface as a
+    # RuntimeWarning with exactly this suggestion)
+    mp = OneVsRestSVC(cfg, dtype=jnp.float32, solver="pair",
+                      accum_dtype=jnp.float64).fit(X, labels)
+    mb = OneVsRestSVC(cfg, dtype=jnp.float32, solver="blocked",
+                      accum_dtype=jnp.float64).fit(X, labels)
+    assert (mb.statuses_ == Status.CONVERGED).all()
+    # different trajectories, same optimum (solution-level parity)
+    np.testing.assert_allclose(mb.b_, mp.b_, atol=2e-3)
+    assert mb.score(X, labels) > 0.97
+
+
+def test_ovr_rejects_bad_solver():
+    with pytest.raises(ValueError, match="solver must be"):
+        OneVsRestSVC(solver="cuda")

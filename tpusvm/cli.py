@@ -81,10 +81,11 @@ def _build_parser() -> argparse.ArgumentParser:
     )
     mode.add_argument(
         "--solver", choices=["blocked", "pair"], default=None,
-        help="on-device solver, for both --mode single and each cascade "
-        "shard: blocked working-set (TPU-first, default) or pair "
-        "(reference-faithful one-pair-per-iteration); ignored by "
-        "--multiclass, which uses its batched vmapped solver",
+        help="on-device solver for --mode single, each cascade shard, and "
+        "each --multiclass class: blocked working-set (TPU-first, default "
+        "for single/cascade) or pair (reference-faithful "
+        "one-pair-per-iteration; vmapped over classes with --multiclass, "
+        "its default there)",
     )
     mode.add_argument("--topology", choices=["tree", "star"], default="tree",
                       help="cascade merge topology (tree = mpi_svm_main3, "
@@ -225,11 +226,9 @@ def _cmd_train(args) -> int:
     if args.multiclass:
         if args.mode != "single":
             raise SystemExit("--multiclass currently supports --mode single")
-        if args.solver is not None:
-            log.info("note: --solver is ignored with --multiclass "
-                     "(batched vmapped solver)")
         model = OneVsRestSVC(config=cfg, dtype=dtype, scale=not args.no_scale,
-                             accum_dtype=accum_dtype)
+                             accum_dtype=accum_dtype,
+                             solver=args.solver or "pair")
         with timer.phase("training"), trace(args.profile):
             model.fit(X, Y)
         log.info("classes = %s", list(model.classes_))
