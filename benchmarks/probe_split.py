@@ -19,6 +19,7 @@ from tpusvm.data import MinMaxScaler, mnist_like  # noqa: E402
 from tpusvm.solver.blocked import blocked_smo_solve  # noqa: E402
 
 q, max_inner, max_outer = (int(a) for a in sys.argv[1:4])
+wss = int(sys.argv[4]) if len(sys.argv) > 4 else 1
 
 X, Y = mnist_like(n=60000, d=784, seed=0, noise=30, label_noise=0.005)
 Xs = MinMaxScaler().fit_transform(X)
@@ -28,7 +29,7 @@ Yd = jnp.asarray(Y, jnp.int32)
 solve = jax.jit(
     lambda X, Y: blocked_smo_solve(
         X, Y, C=10.0, gamma=0.00125, tau=1e-5, max_iter=10**9,
-        q=q, max_inner=max_inner, max_outer=max_outer,
+        q=q, max_inner=max_inner, max_outer=max_outer, wss=wss,
         accum_dtype=jnp.float64,
     )
 )
@@ -41,6 +42,6 @@ r = lowered(Xd, Yd)
 out = (int(np.asarray(r.n_outer)), int(np.asarray(r.n_iter)) - 1,
        int(np.asarray(r.status)))
 t1 = time.perf_counter()
-print(json.dumps({"q": q, "max_inner": max_inner, "outers": out[0],
+print(json.dumps({"q": q, "max_inner": max_inner, "wss": wss, "outers": out[0],
                   "updates": out[1], "status": out[2],
                   "time_s": round(t1 - t0, 4)}))

@@ -151,3 +151,10 @@ def test_blocked_refine_overcap_skips_reconstruction():
     np.testing.assert_allclose(float(r1.b), float(r0.b), atol=1e-6)
     np.testing.assert_allclose(np.asarray(r1.alpha), np.asarray(r0.alpha),
                                atol=1e-6)
+
+
+def test_blocked_rejects_bad_wss():
+    X = jnp.zeros((16, 4), jnp.float32)
+    Y = jnp.asarray([1, -1] * 8, jnp.int32)
+    with pytest.raises(ValueError, match="wss must be"):
+        blocked_smo_solve(X, Y, inner="xla", wss=7)

@@ -61,7 +61,7 @@ _NO_WS = int(Status.NO_WORKING_SET)
 _MAX_ITER = int(Status.MAX_ITER)
 
 
-def _make_kernel(q: int, max_inner: int):
+def _make_kernel(q: int, max_inner: int, wss: int):
     def kernel(scal_ref, K_ref, diag_ref, y_ref, a0_ref, f0_ref, act_ref,
                aout_ref, stat_ref):
         iota = lax.broadcasted_iota(jnp.int32, (1, q), 1)
@@ -112,17 +112,37 @@ def _make_kernel(q: int, max_inner: int):
             i_l = jnp.minimum(i_l, jnp.int32(q - 1))
 
             row_h = K_ref[pl.ds(i_h, 1), :]   # (1, q)
-            row_l = K_ref[pl.ds(i_l, 1), :]
             K11 = pick(diag, i_h)
+
+            if wss == 2:
+                # second-order partner choice (the maximal-gain heuristic of
+                # LIBSVM's WSS2, free here because row_h is already in
+                # VMEM): among violating I_low members, maximise
+                # (f_j - b_h)^2 / eta_j. The Keerthi STOP check above stays
+                # on the global (b_h, b_l) pair regardless.
+                eta_vec = jnp.maximum(K11 + diag - 2.0 * row_h, 1e-12)
+                viol = m_l & (f > b_h)
+                vg = jnp.where(viol, (f - b_h) ** 2 / eta_vec, -jnp.inf)
+                g = jnp.max(vg)
+                i_l2 = jnp.min(jnp.where(vg == g, iota, jnp.int32(q)))
+                # no violating partner (only at/past convergence): keep the
+                # first-order pick so the update path stays well-defined
+                i_l = jnp.where(g > -jnp.inf,
+                                jnp.minimum(i_l2, jnp.int32(q - 1)), i_l)
+
+            row_l = K_ref[pl.ds(i_l, 1), :]
             K22 = pick(diag, i_l)
             K12 = pick(row_h, i_l)
             y_h = pick(y, i_h)
             y_l = pick(y, i_l)
             a_h = pick(a, i_h)
             a_l = pick(a, i_l)
+            # the 2-variable step uses the SELECTED pair's f values; with
+            # first-order selection f[i_l] == b_l exactly
+            b_l_pair = pick(f, i_l) if wss == 2 else b_l
 
-            upd = pair_update(K11, K22, K12, y_h, y_l, a_h, a_l, b_h, b_l,
-                              C, eps, proceed)
+            upd = pair_update(K11, K22, K12, y_h, y_l, a_h, a_l, b_h,
+                              b_l_pair, C, eps, proceed)
 
             f = f + upd.da_h * y_h * row_h + upd.da_l * y_l * row_l
             a = (a + jnp.where(iota == i_h, upd.da_h, 0.0)
@@ -173,16 +193,22 @@ def _make_kernel(q: int, max_inner: int):
     return kernel
 
 
-@functools.partial(jax.jit, static_argnames=("max_inner", "interpret"))
+@functools.partial(jax.jit, static_argnames=("max_inner", "interpret", "wss"))
 def inner_smo_pallas(K_BB, y_B, a_B, f_B, active_B, C, eps, tau, *,
-                     max_inner: int, interpret: bool = False):
+                     max_inner: int, interpret: bool = False, wss: int = 1):
     """Run the inner working-set SMO subproblem as one fused TPU kernel.
 
     Same contract as solver/blocked.py `_inner_smo`: returns
     (a_B_new, n_updates, made_progress, end_reason). Inputs may be any float
     dtype; compute is float32 (see module docstring), and a_B_new comes back
     in a_B's dtype.
+
+    wss=1 selects i_low by first-order Keerthi argmax-f (the reference's
+    heuristic, main3.cpp:124-142); wss=2 selects the maximal-gain partner
+    (second-order) while keeping the reference's stopping rule.
     """
+    if wss not in (1, 2):
+        raise ValueError(f"wss must be 1 or 2, got {wss}")
     q = y_B.shape[0]
     if q % LANE:
         raise ValueError(f"inner_smo_pallas needs q % {LANE} == 0, got {q}")
@@ -193,7 +219,7 @@ def inner_smo_pallas(K_BB, y_B, a_B, f_B, active_B, C, eps, tau, *,
     ])
     K32 = K_BB.astype(jnp.float32)
     aout, stat = pl.pallas_call(
-        _make_kernel(q, max_inner),
+        _make_kernel(q, max_inner, wss),
         in_specs=[
             pl.BlockSpec(memory_space=pltpu.SMEM),
             pl.BlockSpec(memory_space=pltpu.VMEM),

@@ -146,7 +146,7 @@ def _inner_smo(K_BB, y_B, a_B, f_B, active_B, C, eps, tau, max_inner):
 @functools.partial(
     jax.jit,
     static_argnames=("q", "max_outer", "max_inner", "warm_start",
-                     "accum_dtype", "inner", "refine", "max_refines"),
+                     "accum_dtype", "inner", "refine", "max_refines", "wss"),
 )
 def blocked_smo_solve(
     X: jax.Array,
@@ -167,6 +167,7 @@ def blocked_smo_solve(
     inner: str = "auto",
     refine: int = 0,
     max_refines: int = 2,
+    wss: int = 1,
 ) -> SMOResult:
     """Train to the reference's stopping criterion with blocked working sets.
 
@@ -185,6 +186,11 @@ def blocked_smo_solve(
     "pallas" = the fused single-launch kernel (ops/pallas/inner_smo.py,
     float32 subproblem, interpreted off-TPU); "auto" = pallas on TPU when
     q is lane-aligned, xla otherwise.
+
+    wss (pallas engine only; the XLA engine is always first-order,
+    reference-faithful): 1 = Keerthi argmax-f partner selection, 2 =
+    maximal-gain second-order partner selection (LIBSVM WSS2 style) —
+    fewer updates to the same optimum; the stopping rule is unchanged.
 
     refine (static): 0 = judge convergence on the per-round ACCUMULATED
     error vector, like the reference's GPU build accumulates f on device.
@@ -214,6 +220,8 @@ def blocked_smo_solve(
 
     if inner not in ("auto", "xla", "pallas"):
         raise ValueError(f"inner must be auto|xla|pallas, got {inner!r}")
+    if wss not in (1, 2):
+        raise ValueError(f"wss must be 1 or 2, got {wss}")
     if inner == "auto":
         inner = ("pallas" if jax.default_backend() == "tpu"
                  and q % _PALLAS_LANE == 0 else "xla")
@@ -312,6 +320,7 @@ def blocked_smo_solve(
                     K_BB, y_B, a_B, f_B, active_B, C, eps, tau,
                     max_inner=max_inner,
                     interpret=jax.default_backend() != "tpu",
+                    wss=wss,
                 )
                 da_B = a_B_new - a_B_q
                 # f32 rescue hatch: if the fused kernel's float32 subproblem
