@@ -7,8 +7,17 @@ reports per-size train and predict seconds (report Table 2). This harness
 reproduces that sweep on one TPU chip with the blocked working-set solver
 and the on-device predictor, emitting one JSON line per size:
 
-  {"n": ..., "train_s": ..., "predict_s": ..., "vs_gpu_train": ...,
-   "vs_gpu_predict": ..., "status": ..., "n_sv": ...}
+  {"n": ..., "train_s": ..., "predict_s": ..., "predict_all_n_s": ...,
+   "vs_gpu_train": ..., "vs_gpu_predict_sv": ..., "vs_gpu_predict_all_n":
+   ..., "status": ..., "n_sv": ...}
+
+Predict-speedup methodology: predict_s times the SV-compacted serving path
+(C15 semantics — sum over the n_sv support vectors only), while the
+reference's per-size predict numbers come from its GPU all-n-train-points
+kernel (C16) — algebraically identical scores but ~n/n_sv more FLOPs. So
+vs_gpu_predict_sv mixes framework speed with an ~n/n_sv algorithmic factor;
+vs_gpu_predict_all_n divides by predict_all_n_s (the same all-n semantics
+on TPU) and is the like-for-like framework comparison.
 
 Usage:
   python benchmarks/sweep_n.py                    # reference sizes
@@ -83,16 +92,36 @@ def run_size(n, Xs, Y, Xt, Yt, solver_opts, gamma):
     yp = np.asarray(pred_exe(Xtd, Xsv, Ysv, asv))
     predict_s = time.perf_counter() - t0
 
+    # like-for-like timing vs the reference's GPU predict (C16): sum over
+    # ALL n train points, zeros included — same FLOP count as the baseline
+    ad = jax.device_put(jnp.asarray(alpha, Xd.dtype))
+    pred_all_exe = pred_fn.lower(Xtd, Xd, Yd, ad).compile()
+    h2d_sync(ad)
+    t0 = time.perf_counter()
+    yp_all = np.asarray(pred_all_exe(Xtd, Xd, Yd, ad))
+    predict_all_n_s = time.perf_counter() - t0
+    # the two paths are algebraically identical but reduce in different
+    # orders/sizes, so near-boundary points may flip sign within f32 noise
+    mismatch = int((yp_all != yp).sum())
+    if mismatch:
+        log(f"note: {mismatch} test points flip sign between SV-compacted "
+            "and all-n predict (f32 accumulation-order noise)")
+
     return {
         "n": n,
         "train_s": round(train_s, 4),
         "predict_s": round(predict_s, 4),
+        "predict_all_n_s": round(predict_all_n_s, 4),
         "accuracy": float((yp == Yt).mean()),
         "n_sv": int(len(get_sv_indices(alpha))),
         "iterations": int(res.n_iter),
         "status": Status(int(res.status)).name,
         "vs_gpu_train": round(GPU_TRAIN_S[n] / train_s, 2) if n in GPU_TRAIN_S else None,
-        "vs_gpu_predict": round(GPU_PREDICT_S[n] / predict_s, 2) if n in GPU_PREDICT_S else None,
+        # SV-compacted serving path vs the reference's all-n GPU kernel:
+        # includes an ~n/n_sv fewer-FLOPs factor on top of framework speed
+        "vs_gpu_predict_sv": round(GPU_PREDICT_S[n] / predict_s, 2) if n in GPU_PREDICT_S else None,
+        # same all-n semantics as the baseline: the framework comparison
+        "vs_gpu_predict_all_n": round(GPU_PREDICT_S[n] / predict_all_n_s, 2) if n in GPU_PREDICT_S else None,
     }
 
 

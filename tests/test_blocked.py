@@ -158,3 +158,24 @@ def test_blocked_rejects_bad_wss():
     Y = jnp.asarray([1, -1] * 8, jnp.int32)
     with pytest.raises(ValueError, match="wss must be"):
         blocked_smo_solve(X, Y, inner="xla", wss=7)
+
+
+def test_blocked_wss2_rejects_explicit_xla():
+    # the XLA engine is first-order only: wss=2 must not silently degrade
+    X = jnp.zeros((16, 4), jnp.float32)
+    Y = jnp.asarray([1, -1] * 8, jnp.int32)
+    with pytest.raises(ValueError, match="first-order"):
+        blocked_smo_solve(X, Y, inner="xla", wss=2)
+
+
+def test_blocked_wss2_warns_on_auto_xla_fallback():
+    # q=32 is below the 128-lane pallas alignment, so inner='auto' resolves
+    # to xla on every backend: warn that the requested second-order
+    # selection is falling back to first-order
+    Xs, Y = _data(blobs, n=64, seed=1)
+    with pytest.warns(RuntimeWarning, match="first-order"):
+        r = blocked_smo_solve(
+            jnp.asarray(Xs), jnp.asarray(Y), C=1.0, gamma=0.125, q=32,
+            inner="auto", wss=2,
+        )
+    assert int(r.status) == Status.CONVERGED

@@ -1,0 +1,70 @@
+"""Config helpers: accum-dtype sentinel resolution and preset integrity."""
+
+import jax.numpy as jnp
+import pytest
+
+from tpusvm.config import SVMConfig, preset, resolve_accum_dtype
+
+
+def test_resolve_accum_dtype_auto_is_f64():
+    # the library default must resolve to the documented-good mixed-precision
+    # configuration (f64 accumulators), matching the CLI's --accum default
+    import jax
+
+    assert resolve_accum_dtype("auto") == jnp.float64
+    assert jax.config.jax_enable_x64
+
+
+def test_resolve_accum_dtype_auto_flips_x64_with_warning():
+    # conftest pre-enables x64 for the suite, so the actual flip branch
+    # (enable + one-time UserWarning) needs a fresh interpreter
+    import os
+    import subprocess
+    import sys
+
+    code = (
+        "import warnings\n"
+        "from tpusvm.config import resolve_accum_dtype\n"
+        "import jax\n"
+        "assert not jax.config.jax_enable_x64\n"
+        "with warnings.catch_warnings(record=True) as w:\n"
+        "    warnings.simplefilter('always')\n"
+        "    dt = resolve_accum_dtype('auto')\n"
+        "    dt2 = resolve_accum_dtype('auto')\n"
+        "assert jax.config.jax_enable_x64\n"
+        "import jax.numpy as jnp\n"
+        "assert dt == jnp.float64 and dt2 == jnp.float64\n"
+        "x64w = [x for x in w if 'x64' in str(x.message)]\n"
+        "assert len(x64w) == 1, x64w  # warns exactly at the flip\n"
+        "print('OK')\n"
+    )
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    env.pop("JAX_ENABLE_X64", None)
+    out = subprocess.run(
+        [sys.executable, "-c", code],
+        env=env,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        capture_output=True,
+        text=True,
+    )
+    assert out.returncode == 0, out.stderr
+    assert "OK" in out.stdout
+
+
+def test_resolve_accum_dtype_passthrough():
+    assert resolve_accum_dtype(None) is None
+    assert resolve_accum_dtype(jnp.float64) == jnp.float64
+    assert resolve_accum_dtype(jnp.float32) == jnp.float32
+
+
+def test_resolve_accum_dtype_rejects_unknown_string():
+    with pytest.raises(ValueError, match="auto"):
+        resolve_accum_dtype("float64")
+
+
+def test_preset_reference_constants():
+    cfg = preset("mnist")
+    assert (cfg.C, cfg.gamma) == (10.0, 0.00125)
+    assert cfg == SVMConfig()  # zero-flag run is a parity run
+    with pytest.raises(ValueError, match="unknown preset"):
+        preset("cifar")

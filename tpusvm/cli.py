@@ -186,14 +186,27 @@ def _cmd_train(args) -> int:
     import jax
     import jax.numpy as jnp
 
-    from tpusvm.config import CascadeConfig, SVMConfig, preset
+    from tpusvm.config import (
+        CascadeConfig,
+        SVMConfig,
+        preset,
+        resolve_accum_dtype,
+    )
     from tpusvm.models import BinarySVC, OneVsRestSVC
     from tpusvm.utils import PhaseTimer, RunLogger, trace
 
-    accum_dtype = None
-    if args.accum == "float64":
-        jax.config.update("jax_enable_x64", True)
-        accum_dtype = jnp.float64
+    # "float64" (the default) = the library's "auto" resolution: f64
+    # accumulators + x64 enabled — one source of truth for that rule. The
+    # library's enabling-x64 warning is suppressed here: its remediation
+    # (accum_dtype=None) is Python-API advice, and the CLI has its own
+    # explicit knob for this (--accum none).
+    import warnings
+
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore", UserWarning)
+        accum_dtype = resolve_accum_dtype(
+            "auto" if args.accum == "float64" else None
+        )
     dtype = getattr(jnp, args.dtype)
     if args.dtype == "float64":
         jax.config.update("jax_enable_x64", True)

@@ -70,6 +70,46 @@ class CascadeConfig:
             )
 
 
+def resolve_accum_dtype(accum_dtype):
+    """Resolve the accumulator-dtype sentinel used by the model/cascade APIs.
+
+    "auto" (the library default) = float64 accumulators, enabling jax x64
+    mode on first use. This makes the zero-config path the documented-good
+    mixed-precision configuration — f32 features/kernel rows (full
+    HBM-bandwidth win) with f64 O(n) accumulators — matching the all-double
+    reference (main3.cpp uses double throughout) and the CLI's --accum
+    default. float32 accumulators alone can livelock SMO near convergence
+    (STALLED: updates below f32 resolution). Pass None for same-as-features
+    accumulators, or an explicit dtype.
+    """
+    if isinstance(accum_dtype, str):
+        if accum_dtype != "auto":
+            raise ValueError(
+                f"accum_dtype must be 'auto', None, or a dtype; "
+                f"got {accum_dtype!r}"
+            )
+        import jax
+        import jax.numpy as jnp
+
+        if not jax.config.jax_enable_x64:
+            import warnings
+
+            # the flip is process-global and affects unrelated JAX code
+            # (default dtypes become 64-bit); make it discoverable at the
+            # one call that actually performs it
+            warnings.warn(
+                "tpusvm: enabling jax x64 mode for float64 solver "
+                "accumulators (the default, matching the all-double "
+                "reference); pass accum_dtype=None to keep f32 "
+                "accumulators and leave jax_enable_x64 untouched",
+                UserWarning,
+                stacklevel=3,
+            )
+            jax.config.update("jax_enable_x64", True)
+        return jnp.float64
+    return accum_dtype
+
+
 # Named dataset presets mirroring the reference's edit-in-place dataset switch
 # (main3.cpp:308-313): each maps to (C, gamma).
 DATASET_PRESETS = {

@@ -30,7 +30,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from tpusvm.config import SVMConfig
+from tpusvm.config import SVMConfig, resolve_accum_dtype
 from tpusvm.data.scaler import MinMaxScaler
 from tpusvm.models.serialization import load_model, save_model
 from tpusvm.ops.rbf import rbf_cross, sq_norms
@@ -53,7 +53,7 @@ class OneVsRestSVC:
         dtype=jnp.float32,
         scale: bool = True,
         batched: Optional[bool] = None,
-        accum_dtype=None,
+        accum_dtype="auto",
         solver: str = "pair",
     ):
         if solver not in ("pair", "blocked"):
@@ -84,6 +84,8 @@ class OneVsRestSVC:
     def fit(self, X: np.ndarray, labels: np.ndarray) -> "OneVsRestSVC":
         cfg = self.config
         t0 = time.perf_counter()
+        # "auto" -> f64 accumulators (enables x64); see config.resolve_accum_dtype
+        accum_dtype = resolve_accum_dtype(self.accum_dtype)
         X = np.asarray(X)
         labels = np.asarray(labels)
         self.classes_ = np.unique(labels)
@@ -111,13 +113,13 @@ class OneVsRestSVC:
                 return blocked_smo_solve(
                     Xd, y, C=cfg.C, gamma=cfg.gamma, eps=cfg.eps,
                     tau=cfg.tau, max_iter=cfg.max_iter,
-                    accum_dtype=self.accum_dtype,
+                    accum_dtype=accum_dtype,
                 )
         else:
             def solve_one(y):
                 return smo_solve(
                     Xd, y, C=cfg.C, gamma=cfg.gamma, eps=cfg.eps, tau=cfg.tau,
-                    max_iter=cfg.max_iter, accum_dtype=self.accum_dtype,
+                    max_iter=cfg.max_iter, accum_dtype=accum_dtype,
                 )
 
         if self.batched and self.solver == "pair":

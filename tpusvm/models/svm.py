@@ -23,7 +23,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from tpusvm.config import CascadeConfig, SVMConfig
+from tpusvm.config import CascadeConfig, SVMConfig, resolve_accum_dtype
 from tpusvm.data.scaler import MinMaxScaler
 from tpusvm.models.serialization import load_model, save_model
 from tpusvm.oracle.smo import get_sv_indices
@@ -46,14 +46,16 @@ class BinarySVC:
         config: SVMConfig = SVMConfig(),
         dtype=jnp.float32,
         scale: bool = True,
-        accum_dtype=None,
+        accum_dtype="auto",
         solver: str = "blocked",
         solver_opts: Optional[dict] = None,
     ):
-        """accum_dtype: solver accumulator dtype (see smo_solve) — pass
-        jnp.float64 with f32 features for the mixed-precision mode that
-        matches the f64 reference's convergence behaviour at f32 speed
-        (requires jax x64).
+        """accum_dtype: solver accumulator dtype (see smo_solve). The
+        default "auto" resolves to float64 at fit time (enabling jax x64
+        mode if needed) — the mixed-precision mode that matches the f64
+        reference's convergence behaviour at f32 speed, and the same
+        default as the CLI's --accum. Pass None for same-as-features
+        accumulators (f32 alone can STALL near convergence).
 
         solver: "blocked" (default — the TPU-first working-set solver,
         solver/blocked.py) or "pair" (the reference-faithful one-pair-per-
@@ -103,7 +105,7 @@ class BinarySVC:
             eps=cfg.eps,
             tau=cfg.tau,
             max_iter=cfg.max_iter,
-            accum_dtype=self.accum_dtype,
+            accum_dtype=resolve_accum_dtype(self.accum_dtype),
             **self.solver_opts,
         )
         alpha = np.asarray(res.alpha)  # device->host copy = completion barrier
@@ -151,6 +153,7 @@ class BinarySVC:
         Xs = self._scale_fit(np.asarray(X))
         res = cascade_fit(
             Xs, Y, self.config, cascade_config, mesh=mesh, dtype=self.dtype,
+            # cascade_fit resolves the "auto" sentinel itself
             accum_dtype=self.accum_dtype, verbose=verbose,
             checkpoint_path=checkpoint_path, resume=resume,
             solver=self.solver, solver_opts=self.solver_opts,

@@ -49,7 +49,7 @@ import numpy as np
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
-from tpusvm.config import CascadeConfig, SVMConfig
+from tpusvm.config import CascadeConfig, SVMConfig, resolve_accum_dtype
 from tpusvm.data.partition import partition as make_partition
 from tpusvm.parallel.mesh import CASCADE_AXIS, make_mesh
 from tpusvm.parallel.svbuffer import SVBuffer, empty, extract_svs, merge_dedup
@@ -281,7 +281,7 @@ def cascade_fit(
     cascade_config: CascadeConfig = CascadeConfig(),
     mesh=None,
     dtype=jnp.float32,
-    accum_dtype=None,
+    accum_dtype="auto",
     verbose: bool = False,
     checkpoint_path: Optional[str] = None,
     resume: bool = False,
@@ -292,8 +292,9 @@ def cascade_fit(
 
     X must already be scaled (the reference scales with global min/max before
     scattering, mpi_svm_main3.cpp:529-539 — use data.MinMaxScaler on the full
-    array first). accum_dtype: see smo_solve (pass jnp.float64 with f32
-    features for the mixed-precision mode; needs jax x64 enabled).
+    array first). accum_dtype: see smo_solve; the default "auto" resolves to
+    f64 accumulators (enabling jax x64) — the mixed-precision mode matching
+    the all-double reference; pass None for same-as-features accumulators.
 
     checkpoint_path: if set, the inter-round state (global SV buffer +
     previous-round ID set) is written there after every round;
@@ -312,6 +313,7 @@ def cascade_fit(
     """
     if solver not in ("pair", "blocked"):
         raise ValueError(f"unknown solver {solver!r}")
+    accum_dtype = resolve_accum_dtype(accum_dtype)
     cc = cascade_config
     n_shards = cc.n_shards
     if mesh is None:

@@ -222,6 +222,7 @@ def blocked_smo_solve(
         raise ValueError(f"inner must be auto|xla|pallas, got {inner!r}")
     if wss not in (1, 2):
         raise ValueError(f"wss must be 1 or 2, got {wss}")
+    requested_inner = inner
     if inner == "auto":
         inner = ("pallas" if jax.default_backend() == "tpu"
                  and q % _PALLAS_LANE == 0 else "xla")
@@ -231,6 +232,23 @@ def blocked_smo_solve(
             f"{_PALLAS_LANE}, but q={q} after clamping to the n={n} training "
             f"rows; use inner='auto' to fall back to the XLA engine on "
             f"small/unaligned problems"
+        )
+    if wss == 2 and inner == "xla":
+        # the XLA engine is always first-order (reference-faithful); don't
+        # let wss=2 silently degrade to it
+        if requested_inner == "xla":
+            raise ValueError(
+                "wss=2 (second-order partner selection) is implemented only "
+                "by the pallas inner engine; inner='xla' is first-order"
+            )
+        import warnings
+
+        warnings.warn(
+            f"wss=2 requested but inner='auto' resolved to the first-order "
+            f"XLA engine (backend={jax.default_backend()!r}, q={q}); "
+            "falling back to Keerthi first-order selection",
+            RuntimeWarning,
+            stacklevel=2,
         )
 
     if valid is None:
@@ -324,12 +342,21 @@ def blocked_smo_solve(
                 )
                 da_B = a_B_new - a_B_q
                 # f32 rescue hatch: if the fused kernel's float32 subproblem
-                # made zero progress (every selected violator box-pinned at
-                # f32 resolution), retry the round with the accum-dtype XLA
-                # engine before letting the outer loop declare a stall. The
-                # slow path compiles into the graph but executes only on
+                # made zero progress, retry the round with the accum-dtype
+                # XLA engine before letting the outer loop declare a stall.
+                # The slow path compiles into the graph but executes only on
                 # zero-progress rounds (rare: none on the converged MNIST-60k
-                # runs, but q=1536 runs hit it mid-solve).
+                # runs, but q=1536 runs hit it mid-solve). Deliberately NOT
+                # gated on the kernel's end reason: the kernel can only end
+                # CONVERGED / NO_WORKING_SET / MAX_ITER (it shrinks
+                # box-pinned pairs instead of bailing out), so a
+                # zero-progress NO_WORKING_SET is precisely the
+                # all-violators-stalled-at-f32-resolution signature the
+                # rescue exists for, and a zero-progress CONVERGED is an
+                # f32-rounding borderline of the 2*tau criterion where the
+                # accum-dtype engine can still make progress. B is built
+                # from global violator masks, so neither can mean "nothing
+                # to do at entry".
                 da_B, upd, progress, inner_reason = lax.cond(
                     progress,
                     lambda: (da_B, upd, progress, inner_reason),
