@@ -53,7 +53,10 @@ def main(argv=None) -> int:
 
     log(f"devices: {jax.devices()}")
     total = args.n + args.n_test
-    X, labels = mnist_like_multiclass(n=total, d=args.d, noise=30.0)
+    from tpusvm.data.synthetic import BENCH_NOISE_MULTICLASS
+
+    X, labels = mnist_like_multiclass(n=total, d=args.d,
+                                      noise=BENCH_NOISE_MULTICLASS)
     Xtr, ytr = X[: args.n], labels[: args.n]
     Xte, yte = X[args.n :], labels[args.n :]
 

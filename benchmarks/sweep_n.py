@@ -150,8 +150,10 @@ def main(argv=None) -> int:
     log(f"devices: {jax.devices()}")
     n_max = max(args.sizes)
     log(f"generating workload (n={n_max + args.n_test}, d={args.d})...")
+    from tpusvm.data.synthetic import BENCH_LABEL_NOISE, BENCH_NOISE
+
     X, Y = mnist_like(n=n_max + args.n_test, d=args.d,
-                      noise=30.0, label_noise=0.005)
+                      noise=BENCH_NOISE, label_noise=BENCH_LABEL_NOISE)
     sc = MinMaxScaler().fit(X[:n_max])  # reference: scale with TRAIN min/max
     Xs = sc.transform(X[:n_max]).astype(np.float32)
     Xt = sc.transform(X[n_max:]).astype(np.float32)

@@ -14,7 +14,16 @@ the wall-clock scaling benchmark.
 
 One JSON line per (topology, P):
   {"topology": ..., "P": ..., "train_s": ..., "rounds": ..., "n_sv": ...,
-   "vs_cascade_ref": ..., "vs_serial_ref": ...}
+   "accuracy": ..., "round1_sv_fraction": ..., "sv_set_match_vs_first": ...,
+   "sv_jaccard_vs_first": ..., "per_round": [{"round", "sv_count",
+   "time_s"}...], "vs_cascade_ref": ..., "vs_serial_ref": ...}
+
+round1_sv_fraction is the reference's Fig. 6 statistic: the fraction of the
+FINAL SV set already present after round 1 (|ids_1 ∩ ids_final| /
+|ids_final| — the report claims ~97%). sv_set_match_vs_first /
+sv_jaccard_vs_first carry the reference's cross-P parity claim ("all runs
+achieve the same accuracy ... with 1548 SVs"): every config's final SV-ID
+set is compared against the sweep's first completed run.
 
 Usage:
   python benchmarks/sweep_p.py --n 8192 --d 256 --shards 2 4 8
@@ -34,11 +43,17 @@ sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--n", type=int, default=60000)
+    ap.add_argument("--n-test", type=int, default=10000)
     ap.add_argument("--d", type=int, default=784)
     ap.add_argument("--shards", type=int, nargs="+", default=[4, 8])
     ap.add_argument("--topologies", nargs="+", default=["tree", "star"],
                     choices=["tree", "star"])
     ap.add_argument("--sv-capacity", type=int, default=4096)
+    ap.add_argument("--solver", choices=["pair", "blocked"], default="blocked",
+                    help="per-shard solver; blocked (default) keeps the "
+                    "simulated-mesh sweep tractable and is the production "
+                    "accelerated-solver-per-shard hybrid; both converge to "
+                    "the same stopping criterion (SURVEY.md §4 parity)")
     ap.add_argument("--gamma", type=float, default=0.00125,
                     help="RBF width (reference MNIST value); ~1/d in --smoke")
     ap.add_argument("--platform", choices=["cpu", "native"], default="cpu",
@@ -49,8 +64,11 @@ def main(argv=None) -> int:
 
     if args.smoke:
         args.n, args.d, args.shards = 2048, 64, [2, 4]
+        args.n_test = 512
         args.sv_capacity = 1024
         args.gamma = 1.0 / args.d  # keep gamma*d ~ constant at small d
+    if args.n_test <= 0:
+        ap.error("--n-test must be >= 1 (the sweep reports held-out accuracy)")
 
     max_p = max(args.shards)
     if args.platform == "cpu":
@@ -80,11 +98,16 @@ def main(argv=None) -> int:
     from tpusvm.config import CascadeConfig, SVMConfig
     from tpusvm.parallel import cascade_fit, make_mesh
 
+    import numpy as np
+
+    from tpusvm.solver.predict import predict as device_predict
+
     log(f"devices: {len(jax.devices())} x {jax.devices()[0].platform}")
-    log(f"workload: n={args.n} d={args.d}")
-    Xs, Y = make_workload(args.n, args.d)
+    log(f"workload: n={args.n} d={args.d} n_test={args.n_test}")
+    Xs, Y, Xt, Yt = make_workload(args.n, args.d, n_test=args.n_test)
     cfg = SVMConfig(gamma=args.gamma)  # other constants = reference
 
+    first_ids = None  # cross-P SV-set parity baseline (first completed run)
     for topology in args.topologies:
         for p in args.shards:
             if topology == "tree" and (p & (p - 1)) != 0:
@@ -96,20 +119,44 @@ def main(argv=None) -> int:
                 Xs, Y, cfg,
                 CascadeConfig(n_shards=p, sv_capacity=args.sv_capacity,
                               topology=topology),
-                mesh=mesh, accum_dtype=jnp.float64,
+                mesh=mesh, accum_dtype=jnp.float64, solver=args.solver,
             )
             train_s = time.perf_counter() - t0
-            round1_sv = res.history[0]["sv_count"] if res.history else 0
+
+            final_ids = set(res.sv_ids.tolist())
+            # the Fig. 6 statistic: final SVs already present after round 1
+            ids_r1 = set(res.history[0]["sv_ids"].tolist()) if res.history else set()
+            round1_frac = len(ids_r1 & final_ids) / max(len(final_ids), 1)
+
+            if first_ids is None:
+                first_ids = final_ids
+            jac = (len(final_ids & first_ids)
+                   / max(len(final_ids | first_ids), 1))
+
+            yp = np.asarray(device_predict(
+                jnp.asarray(Xt, jnp.float32), jnp.asarray(res.sv_X, jnp.float32),
+                jnp.asarray(res.sv_Y), jnp.asarray(res.sv_alpha, jnp.float32),
+                jnp.asarray(res.b, jnp.float32), gamma=cfg.gamma,
+            ))
             ref = CASCADE_TRAIN_S.get((topology, p))
             emit({
                 "topology": topology,
                 "P": p,
+                "solver": args.solver,
                 "train_s": round(train_s, 3),
                 "rounds": res.rounds,
                 "converged": res.converged,
                 "n_sv": len(res.sv_ids),
                 "b": res.b,
-                "round1_sv_fraction": round(round1_sv / max(len(res.sv_ids), 1), 4),
+                "accuracy": float((yp == Yt).mean()),
+                "round1_sv_fraction": round(round1_frac, 4),
+                "sv_set_match_vs_first": final_ids == first_ids,
+                "sv_jaccard_vs_first": round(jac, 4),
+                "per_round": [
+                    {"round": h["round"], "sv_count": h["sv_count"],
+                     "time_s": round(h["time_s"], 3)}
+                    for h in res.history
+                ],
                 "vs_cascade_ref": round(ref / train_s, 2) if ref else None,
                 "vs_serial_ref": round(SERIAL_TRAIN_S / train_s, 2),
                 "platform": jax.devices()[0].platform,

@@ -12,8 +12,12 @@
 # (--platform cpu, because site configuration may override JAX_PLATFORMS):
 #   XLA_FLAGS=--xla_force_host_platform_device_count=8 \
 #     SHARDS=8 scripts/run_cascade_tree.sh --platform cpu
-# Multi-host pods need no mpirun equivalent: launch the same command on
-# every host (jax.distributed discovers peers from the TPU metadata).
+# Multi-host pods need no mpirun equivalent: launch the same command WITH
+# --distributed on every host — the CLI then calls
+# jax.distributed.initialize() (the MPI_Init equivalent) and the hosts form
+# one global mesh (TPU metadata supplies the geometry; off-TPU pass
+# --coordinator-address/--num-processes/--process-id):
+#   SHARDS=8 scripts/run_cascade_tree.sh --distributed
 set -euo pipefail
 cd "$(dirname "$0")/.."
 

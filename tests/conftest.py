@@ -10,6 +10,10 @@ Note: this environment's sitecustomize registers the experimental `axon` TPU
 platform at interpreter startup and programmatically sets jax_platforms, so
 an env-var JAX_PLATFORMS=cpu is ignored; the jax.config.update below is what
 actually selects CPU (backends are not yet initialised at conftest time).
+
+TPU-gated tests (tests/test_pallas_tpu.py): run with
+TPUSVM_TEST_PLATFORM=native to keep the real backend instead of forcing
+CPU — those tests skip themselves when the backend is not a TPU.
 """
 
 import os
@@ -23,7 +27,8 @@ if "xla_force_host_platform_device_count" not in flags:
 
 import jax  # noqa: E402
 
-jax.config.update("jax_platforms", "cpu")
+if os.environ.get("TPUSVM_TEST_PLATFORM", "cpu") == "cpu":
+    jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", True)
 
 

@@ -122,3 +122,42 @@ def test_cli_n_limit_caps_synthetic(capsys):
     assert "n = 100," in out
     # the cap must not leak the cut training rows into the test set
     assert "/40)" in out
+
+
+def test_cli_distributed_flag_plumbs_through(capsys, monkeypatch):
+    """--distributed must call jax.distributed.initialize (the MPI_Init
+    equivalent) before command dispatch, passing explicit geometry."""
+    import jax
+
+    calls = []
+    monkeypatch.setattr(
+        jax.distributed, "initialize", lambda **kw: calls.append(kw)
+    )
+    rc = main([
+        "--distributed", "--coordinator-address", "127.0.0.1:8476",
+        "--num-processes", "1", "--process-id", "0", "info",
+    ])
+    assert rc == 0
+    assert calls == [{
+        "coordinator_address": "127.0.0.1:8476",
+        "num_processes": 1,
+        "process_id": 0,
+    }]
+    # flags accepted after the subcommand too (launcher scripts append
+    # user flags there), with TPU-pod auto-detection (no explicit geometry)
+    calls.clear()
+    assert main(["info", "--distributed"]) == 0
+    assert calls == [{}]
+    capsys.readouterr()
+
+
+def test_cli_not_distributed_by_default(capsys, monkeypatch):
+    import jax
+
+    calls = []
+    monkeypatch.setattr(
+        jax.distributed, "initialize", lambda **kw: calls.append(kw)
+    )
+    assert main(["info"]) == 0
+    assert calls == []
+    capsys.readouterr()

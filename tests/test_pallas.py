@@ -4,29 +4,10 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
-from tpusvm.ops.pallas import rbf_two_rows
 from tpusvm.ops.pallas.inner_smo import inner_smo_pallas
-from tpusvm.ops.rbf import rbf_cross, rbf_rows_at
+from tpusvm.ops.rbf import rbf_cross
 from tpusvm.solver.blocked import _inner_smo, blocked_smo_solve
 from tpusvm.status import Status
-
-
-def test_two_rows_matches_xla():
-    rng = np.random.default_rng(0)
-    n, d = 1024, 256
-    X = jnp.asarray(rng.random((n, d)), jnp.float32)
-    idx = jnp.asarray([3, 777], jnp.int32)
-    out = rbf_two_rows(X, X[idx], 0.5, interpret=True)
-    ref = rbf_rows_at(X, idx, 0.5)
-    np.testing.assert_allclose(
-        np.asarray(out.T), np.asarray(ref), atol=2e-6
-    )
-
-
-def test_two_rows_rejects_unaligned():
-    X = jnp.zeros((1000, 256), jnp.float32)  # n not a TILE_N multiple
-    with pytest.raises(ValueError, match="pad first"):
-        rbf_two_rows(X, X[:2], 0.5, interpret=True)
 
 
 def _subproblem(q=128, seed=0, d=8, gamma=0.5):

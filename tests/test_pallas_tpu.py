@@ -1,0 +1,122 @@
+"""Compiled-Mosaic tests for the fused inner-SMO kernel — TPU only.
+
+tests/test_pallas.py exercises inner_smo_pallas in INTERPRET mode on CPU;
+until now the compiled-Mosaic lowering was validated only as a side effect
+of bench.py. These tests assert compiled-kernel vs XLA inner-loop agreement
+on a genuine mid-solve working set, so a Mosaic lowering regression is
+caught before it can silently corrupt the benchmark headline.
+
+Run with the real backend kept (tests/conftest.py forces CPU otherwise):
+
+    TPUSVM_TEST_PLATFORM=native python -m pytest tests/test_pallas_tpu.py -v
+
+Skips when the backend is not a TPU.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpusvm.data import MinMaxScaler, rings
+from tpusvm.ops.rbf import rbf_cross
+from tpusvm.ops.selection import i_high_mask, i_low_mask
+from tpusvm.solver.blocked import _inner_smo, blocked_smo_solve
+from tpusvm.status import Status
+
+pytestmark = pytest.mark.skipif(
+    jax.default_backend() != "tpu",
+    reason="compiled-Mosaic test; run with TPUSVM_TEST_PLATFORM=native on "
+    "a TPU host",
+)
+
+C, GAMMA, EPS, TAU = 10.0, 10.0, 1e-12, 1e-5
+Q = 128  # = n: the whole problem is the subproblem (lane-aligned)
+
+
+@pytest.fixture(scope="module")
+def midsolve_subproblem():
+    """A genuine mid-solve state: run the blocked solver to a small update
+    budget, then rebuild the exact f for the resulting alpha."""
+    X, Y = rings(n=Q, seed=3)
+    Xs = MinMaxScaler().fit_transform(X).astype(np.float32)
+    Xd = jnp.asarray(Xs)
+    Yd = jnp.asarray(Y)
+    r = blocked_smo_solve(
+        Xd, Yd, C=C, gamma=GAMMA, eps=EPS, tau=TAU,
+        max_iter=40, q=Q, max_inner=8, inner="xla",
+    )
+    assert int(r.status) == Status.MAX_ITER  # genuinely mid-solve
+    a = np.asarray(r.alpha, np.float32)
+    assert 0 < (a > 0).sum() < Q
+
+    K = np.asarray(rbf_cross(Xd, Xd, GAMMA), np.float32)
+    y = np.asarray(Y, np.float32)
+    f = K @ (a * y) - y
+    active = np.asarray(
+        i_high_mask(jnp.asarray(a), Yd, C, EPS)
+        | i_low_mask(jnp.asarray(a), Yd, C, EPS)
+    )
+    assert active.any()
+    return (
+        jnp.asarray(K),
+        jnp.asarray(y),
+        jnp.asarray(a),
+        jnp.asarray(f, jnp.float32),
+        jnp.asarray(active),
+    )
+
+
+def _solve_pallas(args, wss):
+    from tpusvm.ops.pallas.inner_smo import inner_smo_pallas
+
+    K, y, a, f, active = args
+    a_new, n_upd, progress, reason = inner_smo_pallas(
+        K, y, a, f, active, C, EPS, TAU, max_inner=4096,
+        interpret=False, wss=wss,  # compiled Mosaic, not interpret
+    )
+    return np.asarray(a_new), int(n_upd), bool(progress), int(reason)
+
+
+def test_compiled_wss1_matches_xla_inner(midsolve_subproblem):
+    K, y, a, f, active = midsolve_subproblem
+    a_x, n_x, prog_x, reason_x = _inner_smo(
+        K, y, a, f, active, C, EPS, TAU, 4096
+    )
+    a_x = np.asarray(a_x)
+    a_p, n_p, prog_p, reason_p = _solve_pallas(midsolve_subproblem, wss=1)
+
+    assert prog_p and prog_x
+    assert reason_p == Status.CONVERGED
+    assert int(reason_x) == Status.CONVERGED
+    # same selection rule, same shared pair_update, both f32: the
+    # trajectories should agree to accumulation noise
+    np.testing.assert_allclose(a_p, a_x, atol=1e-3)
+    # identical optima imply near-identical update counts
+    assert abs(n_p - int(n_x)) <= max(5, int(n_x) // 10)
+
+
+def test_compiled_wss2_reaches_same_optimum(midsolve_subproblem):
+    K, y, a, f, active = midsolve_subproblem
+    a_x, n_x, _, _ = _inner_smo(K, y, a, f, active, C, EPS, TAU, 4096)
+    a_x = np.asarray(a_x)
+    a_p, n_p, prog_p, reason_p = _solve_pallas(midsolve_subproblem, wss=2)
+
+    assert prog_p
+    assert reason_p == Status.CONVERGED
+    assert n_p > 0
+    # second-order partner selection: different trajectory, same convex
+    # optimum (within the f32 noise band)
+    np.testing.assert_allclose(a_p, a_x, atol=5e-3)
+
+
+def test_compiled_box_constraints_and_padding(midsolve_subproblem):
+    K, y, a, f, active = midsolve_subproblem
+    a_p, _, _, _ = _solve_pallas(midsolve_subproblem, wss=1)
+    assert (a_p >= -1e-6).all() and (a_p <= C + 1e-6).all()
+    inactive = ~np.asarray(active)
+    if inactive.any():
+        # lanes outside the active set must come back untouched
+        np.testing.assert_array_equal(
+            a_p[inactive], np.asarray(a, np.float32)[inactive]
+        )

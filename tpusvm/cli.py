@@ -39,15 +39,43 @@ def _build_parser() -> argparse.ArgumentParser:
         "combine with XLA_FLAGS=--xla_force_host_platform_device_count=N "
         "for an N-device simulated CPU mesh"
     )
+    distributed_help = (
+        "initialise jax.distributed before backend use (the reference's "
+        "MPI_Init, mpi_svm_main3.cpp:416-419): launch the same command on "
+        "every host of a multi-host pod/cluster to form one global mesh. "
+        "On TPU pods coordinator/process geometry is discovered from the "
+        "TPU metadata; elsewhere pass --coordinator-address / "
+        "--num-processes / --process-id explicitly"
+    )
+    def add_shared(parser, suppress):
+        """One definition of the pre/post-subcommand flags. The subparser
+        copies default to SUPPRESS so an absent flag there never overwrites
+        a value the root parser already captured."""
+        d = argparse.SUPPRESS if suppress else None
+        parser.add_argument("--platform", choices=["cpu", "tpu"],
+                            default=d, help=platform_help)
+        parser.add_argument(
+            "--distributed", action="store_true",
+            default=argparse.SUPPRESS if suppress else False,
+            help=distributed_help,
+        )
+        parser.add_argument("--coordinator-address", default=d,
+                            metavar="HOST:PORT",
+                            help="with --distributed off-TPU: coordinator "
+                            "endpoint")
+        parser.add_argument("--num-processes", type=int, default=d,
+                            help="with --distributed off-TPU: world size")
+        parser.add_argument("--process-id", type=int, default=d,
+                            help="with --distributed off-TPU: this "
+                            "process's rank")
+
     common = argparse.ArgumentParser(add_help=False)
-    common.add_argument("--platform", choices=["cpu", "tpu"],
-                        default=argparse.SUPPRESS, help=platform_help)
+    add_shared(common, suppress=True)
     p = argparse.ArgumentParser(
         prog="tpusvm",
         description="TPU-native parallel SVM training (JAX/XLA/Pallas).",
     )
-    p.add_argument("--platform", choices=["cpu", "tpu"], default=None,
-                   help=platform_help)
+    add_shared(p, suppress=False)
     sub = p.add_subparsers(dest="command", required=True)
 
     tr = sub.add_parser("train", parents=[common],
@@ -166,10 +194,16 @@ def _load_train_data(args) -> Tuple[np.ndarray, np.ndarray, Optional[np.ndarray]
     n_total = args.n + args.n_test
     if args.synthetic == "mnist-like":
         if args.multiclass:
-            X, Y = mnist_like_multiclass(n=n_total, d=args.d, seed=args.seed)
+            from tpusvm.data.synthetic import BENCH_NOISE_MULTICLASS
+
+            X, Y = mnist_like_multiclass(n=n_total, d=args.d, seed=args.seed,
+                                         noise=BENCH_NOISE_MULTICLASS)
         else:
+            from tpusvm.data.synthetic import BENCH_LABEL_NOISE, BENCH_NOISE
+
             X, Y = mnist_like(n=n_total, d=args.d, seed=args.seed,
-                              noise=30.0, label_noise=0.005)
+                              noise=BENCH_NOISE,
+                              label_noise=BENCH_LABEL_NOISE)
     elif args.synthetic == "blobs":
         X, Y = blobs(n=n_total, d=args.d, seed=args.seed)
     else:
@@ -356,6 +390,21 @@ def main(argv=None) -> int:
         import jax
 
         jax.config.update("jax_platforms", args.platform)
+    if args.distributed:
+        # The MPI_Init equivalent (mpi_svm_main3.cpp:416-419): must run
+        # before any backend use so every host joins one global mesh and
+        # jax.devices() spans the pod. On TPU the geometry is auto-detected
+        # from the TPU metadata; the explicit flags cover other clusters.
+        import jax
+
+        kw = {}
+        if args.coordinator_address:
+            kw["coordinator_address"] = args.coordinator_address
+        if args.num_processes is not None:
+            kw["num_processes"] = args.num_processes
+        if args.process_id is not None:
+            kw["process_id"] = args.process_id
+        jax.distributed.initialize(**kw)
     return {"train": _cmd_train, "predict": _cmd_predict, "info": _cmd_info}[
         args.command
     ](args)

@@ -23,6 +23,23 @@ from typing import Tuple
 import numpy as np
 
 
+# Benchmark difficulty calibration. Gaussian pixel noise 330 with NO label
+# flips makes held-out accuracy land off the 1.0 ceiling and rise with n the
+# way real MNIST does (measured on the TPU chip, one-vs-rest digit 1, C=10,
+# gamma=0.00125: n=6k -> 0.9865, 12k -> 0.9922, 30k -> 0.9928,
+# 60k -> 0.9955 with 2172 SVs / 43.7k iterations; real MNIST-60k: 0.9969 /
+# 1548 SVs), so benchmark accuracy columns carry information about the
+# learning problem. The previous recipe (noise=30, label_noise=0.005) pinned
+# accuracy at the label-flip ceiling — flat 0.9932 at every n.
+BENCH_NOISE = 330.0
+BENCH_LABEL_NOISE = 0.0
+# 10-class variant: all classes overlap each other, so the same noise is
+# harsher under an argmax decision; 300 lands held-out 10-class accuracy at
+# 0.987 (measured, n=8k train) — the band real-MNIST 10-class RBF SVMs
+# occupy (~0.984) — instead of the old recipe's uninformative 1.0.
+BENCH_NOISE_MULTICLASS = 300.0
+
+
 def blobs(
     n: int = 200, d: int = 2, sep: float = 3.0, seed: int = 0
 ) -> Tuple[np.ndarray, np.ndarray]:

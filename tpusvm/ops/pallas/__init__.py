@@ -1,3 +1,3 @@
-from tpusvm.ops.pallas.rows import rbf_two_rows
+from tpusvm.ops.pallas.inner_smo import inner_smo_pallas
 
-__all__ = ["rbf_two_rows"]
+__all__ = ["inner_smo_pallas"]

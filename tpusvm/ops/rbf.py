@@ -1,7 +1,7 @@
 """RBF kernel primitives as XLA-friendly JAX ops.
 
 TPU-native replacements for the reference's CUDA kernel computations:
-  - `rbf_row` / `rbf_two_rows` <- calc_kernel_matrix with n1=1
+  - `rbf_row` / `rbf_rows_at` <- calc_kernel_matrix with n1=1
     (gpu_svm_main3.cu:137-147, launched per SMO iteration at :400/:409);
   - `rbf_cross` <- the general K(X1, X2) tile kernel, used for prediction
     (gpu_svm_main3.cu:277-296) — expressed as one big matmul so XLA tiles it

@@ -407,10 +407,12 @@ def cascade_fit(
                 f"{sv_cap}; increase sv_capacity"
             )
 
-        ids_now = set(np.asarray(new_global.ids)[np.asarray(new_global.valid)].tolist())
+        ids_arr = np.asarray(new_global.ids)[np.asarray(new_global.valid)]
+        ids_now = set(ids_arr.tolist())
         entry = {
             "round": rnd,
             "sv_count": len(ids_now),
+            "sv_ids": np.sort(ids_arr),
             "b": b,
             "time_s": dt,
             "iters": diag["iters"],
