@@ -119,11 +119,16 @@ def main():
     n, d = Xd.shape
     hbm_bytes = (n_outer + 1) * n * d * 4  # +1: the sq_norms pass
     hbm_gbps = hbm_bytes / train_s / 1e9
+    # the 819 GB/s roofline is v5e-specific: report the fraction only when
+    # actually running on a TPU so non-TPU result files aren't misleading
+    on_tpu = jax.devices()[0].platform == "tpu"
+    peak_note = (
+        f" ({hbm_gbps / V5E_PEAK_HBM_GBPS:.0%} of v5e peak)" if on_tpu else ""
+    )
     log(
         f"status={status.name} updates={n_iter} outers={n_outer} "
         f"SVs={n_sv} b={float(res.b):.6f} train={train_s:.3f}s "
-        f"~{hbm_gbps:.0f}GB/s streamed "
-        f"({hbm_gbps / V5E_PEAK_HBM_GBPS:.0%} of v5e peak)"
+        f"~{hbm_gbps:.0f}GB/s streamed{peak_note}"
     )
     if status != Status.CONVERGED:
         log("WARNING: solver did not converge; reporting anyway")
@@ -142,11 +147,12 @@ def main():
                     "n_outer": n_outer,
                     "n_sv": n_sv,
                     # floor estimate: one X stream per outer round (see
-                    # comment above); peak = 819 GB/s (TPU v5e HBM)
+                    # comment above); peak = 819 GB/s (TPU v5e HBM),
+                    # reported only when running on a TPU
                     "hbm_gbps_est": round(hbm_gbps, 1),
                     "hbm_peak_fraction_est": round(
                         hbm_gbps / V5E_PEAK_HBM_GBPS, 3
-                    ),
+                    ) if on_tpu else None,
                     "platform": jax.devices()[0].platform,
                 },
             }

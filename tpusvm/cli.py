@@ -385,7 +385,19 @@ def _cmd_info(args) -> int:
 
 
 def main(argv=None) -> int:
-    args = _build_parser().parse_args(argv)
+    parser = _build_parser()
+    args = parser.parse_args(argv)
+    if not args.distributed and (
+        args.coordinator_address
+        or args.num_processes is not None
+        or args.process_id is not None
+    ):
+        # geometry without --distributed would silently train standalone
+        # on each host instead of joining the mesh
+        parser.error(
+            "--coordinator-address/--num-processes/--process-id require "
+            "--distributed"
+        )
     if args.platform:
         import jax
 

@@ -63,12 +63,18 @@ _MAX_ITER = int(Status.MAX_ITER)
 
 def _make_kernel(q: int, max_inner: int, wss: int):
     def kernel(scal_ref, K_ref, diag_ref, y_ref, a0_ref, f0_ref, act_ref,
-               aout_ref, stat_ref):
+               diag_s_ref, y_s_ref, a0_s_ref, aout_ref, stat_ref, a_s_ref):
         iota = lax.broadcasted_iota(jnp.int32, (1, q), 1)
 
         def pick(v, i):
             """v[0, i] for a traced scalar i, as a masked reduction (no
-            dynamic scalar addressing into loop-carried values on the VPU)."""
+            dynamic scalar addressing into loop-carried values on the VPU).
+            Used only where the value lives in vector registers (a freshly
+            loaded K row, the current f); everything with a static home
+            (y, diag) or a maintained mirror (alpha) reads from SMEM in
+            O(1) instead — each pick is a full cross-lane reduction,
+            ~0.25us at q=2048 (measured via the wss=1 vs wss=2 bench
+            delta), and they dominated the original kernel's 8.2us/update."""
             return jnp.sum(jnp.where(iota == i, v, 0.0))
 
         C = scal_ref[0]
@@ -77,6 +83,17 @@ def _make_kernel(q: int, max_inner: int, wss: int):
         y = y_ref[:]                      # (1, q) float32, +/-1 (0 on pads)
         diag = diag_ref[:]                # (1, q) K_BB diagonal
         pos = y > 0.0
+
+        # SMEM alpha mirror: scalar reads (a[i_h], a[i_l]) and the two
+        # per-iteration writes are O(1) on the scalar core, replacing
+        # masked-sum reductions over the whole working set. The vector
+        # alpha stays loop-carried for the mask computations; both are
+        # updated with the same f32 deltas, so they cannot drift.
+        def copy(i, _):
+            a_s_ref[i] = a0_s_ref[i]
+            return 0
+
+        lax.fori_loop(0, q, copy, 0)
 
         def cond(st):
             return st[5] == _RUNNING
@@ -112,7 +129,7 @@ def _make_kernel(q: int, max_inner: int, wss: int):
             i_l = jnp.minimum(i_l, jnp.int32(q - 1))
 
             row_h = K_ref[pl.ds(i_h, 1), :]   # (1, q)
-            K11 = pick(diag, i_h)
+            K11 = diag_s_ref[i_h]
 
             if wss == 2:
                 # second-order partner choice (the maximal-gain heuristic of
@@ -131,12 +148,12 @@ def _make_kernel(q: int, max_inner: int, wss: int):
                                 jnp.minimum(i_l2, jnp.int32(q - 1)), i_l)
 
             row_l = K_ref[pl.ds(i_l, 1), :]
-            K22 = pick(diag, i_l)
-            K12 = pick(row_h, i_l)
-            y_h = pick(y, i_h)
-            y_l = pick(y, i_l)
-            a_h = pick(a, i_h)
-            a_l = pick(a, i_l)
+            K22 = diag_s_ref[i_l]
+            K12 = pick(row_h, i_l)   # row_h is in vector registers
+            y_h = y_s_ref[i_h]
+            y_l = y_s_ref[i_l]
+            a_h = a_s_ref[i_h]
+            a_l = a_s_ref[i_l]
             # the 2-variable step uses the SELECTED pair's f values; with
             # first-order selection f[i_l] == b_l exactly
             b_l_pair = pick(f, i_l) if wss == 2 else b_l
@@ -147,6 +164,11 @@ def _make_kernel(q: int, max_inner: int, wss: int):
             f = f + upd.da_h * y_h * row_h + upd.da_l * y_l * row_l
             a = (a + jnp.where(iota == i_h, upd.da_h, 0.0)
                    + jnp.where(iota == i_l, upd.da_l, 0.0))
+            # keep the SMEM mirror in lockstep (deltas are 0 when the
+            # iteration did not update, so the stores are always safe; an
+            # i_h == i_l coincidence implies eta == 0 -> zero deltas)
+            a_s_ref[i_h] = a_h + upd.da_h
+            a_s_ref[i_l] = a_l + upd.da_l
             ok = upd.do_update & ~upd.stalled
             n_upd = n_upd + ok.astype(jnp.int32)
             progress = jnp.maximum(progress, ok.astype(jnp.int32))
@@ -218,6 +240,9 @@ def inner_smo_pallas(K_BB, y_B, a_B, f_B, active_B, C, eps, tau, *,
         jnp.asarray(tau, jnp.float32),
     ])
     K32 = K_BB.astype(jnp.float32)
+    diag32 = jnp.diagonal(K32)
+    y32 = y_B.astype(jnp.float32)
+    a32 = a_B.astype(jnp.float32)
     aout, stat = pl.pallas_call(
         _make_kernel(q, max_inner, wss),
         in_specs=[
@@ -228,6 +253,11 @@ def inner_smo_pallas(K_BB, y_B, a_B, f_B, active_B, C, eps, tau, *,
             pl.BlockSpec(memory_space=pltpu.VMEM),
             pl.BlockSpec(memory_space=pltpu.VMEM),
             pl.BlockSpec(memory_space=pltpu.VMEM),
+            # (q,) SMEM copies of diag / y / a0 for O(1) scalar reads in
+            # the hot loop (the VMEM copies above serve the vector math)
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
         ],
         out_specs=[
             pl.BlockSpec(memory_space=pltpu.VMEM),
@@ -237,14 +267,18 @@ def inner_smo_pallas(K_BB, y_B, a_B, f_B, active_B, C, eps, tau, *,
             jax.ShapeDtypeStruct((1, q), jnp.float32),
             jax.ShapeDtypeStruct((3,), jnp.int32),
         ],
+        scratch_shapes=[pltpu.SMEM((q,), jnp.float32)],  # alpha mirror
         interpret=interpret,
     )(
         scal,
         K32,
-        jnp.diagonal(K32)[None, :],
-        y_B.astype(jnp.float32)[None, :],
-        a_B.astype(jnp.float32)[None, :],
+        diag32[None, :],
+        y32[None, :],
+        a32[None, :],
         f_B.astype(jnp.float32)[None, :],
         active_B.astype(jnp.float32)[None, :],
+        diag32,
+        y32,
+        a32,
     )
     return (aout[0].astype(a_B.dtype), stat[0], stat[1] > 0, stat[2])
