@@ -122,6 +122,35 @@ def test_sv_capacity_overflow_raises():
         )
 
 
+def test_star_merge_capacity_overflow_raises():
+    # a layer-2 retrain buffer too small for the worker-SV union must fail
+    # loudly, not silently truncate the merged problem
+    Xs, Y = _ring_data()
+    with pytest.raises(RuntimeError, match="star merged-retrain overflow"):
+        cascade_fit(
+            Xs, Y, CFG,
+            CascadeConfig(n_shards=2, sv_capacity=256, topology="star",
+                          star_merge_capacity=2),
+            dtype=jnp.float64,
+        )
+
+
+def test_star_merge_capacity_default_matches_wide_buffer():
+    # the compacted default layer-2 capacity must not change the cascade's
+    # outcome vs an explicit concatenation-sized buffer (padding is masked
+    # out of the solve either way)
+    Xs, Y = _ring_data()
+    cc = dict(n_shards=2, sv_capacity=256, topology="star")
+    r_tight = cascade_fit(Xs, Y, CFG, CascadeConfig(**cc), dtype=jnp.float64)
+    r_wide = cascade_fit(
+        Xs, Y, CFG, CascadeConfig(**cc, star_merge_capacity=512),
+        dtype=jnp.float64,
+    )
+    assert set(r_tight.sv_ids.tolist()) == set(r_wide.sv_ids.tolist())
+    np.testing.assert_allclose(r_tight.b, r_wide.b, atol=1e-9)
+    assert r_tight.rounds == r_wide.rounds
+
+
 def test_history_diagnostics():
     Xs, Y = _ring_data()
     res = cascade_fit(
@@ -134,6 +163,15 @@ def test_history_diagnostics():
     assert h0["round"] == 1 and h0["sv_count"] > 0 and h0["time_s"] > 0
     # per-device, per-step solver iteration counts are recorded
     assert h0["iters"].shape[0] == 2
+    # per-round SV-ID snapshots (sorted, consistent with the count) power
+    # the Fig. 6 round-1-fraction statistic in benchmarks/sweep_p.py
+    for h in res.history:
+        assert len(h["sv_ids"]) == h["sv_count"]
+        assert (np.diff(h["sv_ids"]) > 0).all()
+    # the last round's snapshot IS the final model's SV-ID set
+    np.testing.assert_array_equal(
+        res.history[-1]["sv_ids"], np.sort(res.sv_ids)
+    )
 
 
 def test_label_sorted_data_raises_not_nan():

@@ -54,11 +54,20 @@ class CascadeConfig:
         and reported at runtime.
       topology: "tree" = classical binary-reduction cascade (mpi_svm_main3.cpp),
         "star" = modified two-layer cascade (mpi_svm_main2.cpp).
+      star_merge_capacity: buffer capacity of the star topology's layer-2
+        merged retrain (the rank-0 solve over the union of all worker SV
+        sets). The union is deduped and compacted before the solve, so this
+        only needs to hold the union's VALID rows — not the concatenation —
+        and the solver's cost scales with the padded size, so a tight value
+        here is a large speedup at high P. None (default) =
+        min(2 * sv_capacity, n_shards * sv_capacity); overflow is detected
+        and raises at runtime.
     """
 
     n_shards: int = 8
     sv_capacity: int = 4096
     topology: str = "tree"
+    star_merge_capacity: Optional[int] = None
 
     def __post_init__(self):
         if self.topology not in ("tree", "star"):
@@ -68,6 +77,16 @@ class CascadeConfig:
             raise ValueError(
                 f"tree cascade requires a power-of-two shard count, got {self.n_shards}"
             )
+        if self.star_merge_capacity is not None and self.star_merge_capacity < 1:
+            raise ValueError(
+                f"star_merge_capacity must be >= 1, got {self.star_merge_capacity}"
+            )
+
+    def resolved_star_merge_capacity(self) -> int:
+        cap = self.star_merge_capacity
+        if cap is None:
+            cap = min(2 * self.sv_capacity, self.n_shards * self.sv_capacity)
+        return cap
 
 
 def resolve_accum_dtype(accum_dtype):

@@ -324,7 +324,12 @@ def cascade_fit(
     chunk = part.X.shape[1]
     d = part.X.shape[2]
     train_cap = chunk + sv_cap
-    merged_cap = n_shards * sv_cap
+    # star layer-2 retrain buffer: the worker-SV union is deduped/compacted
+    # before the solve, so its capacity only needs to hold the union's valid
+    # rows — a tight cap keeps the replicated rank-0-equivalent solve from
+    # paying for n_shards*sv_cap of padding (solver cost scales with the
+    # padded size); overflow is checked per round below
+    merged_cap = cc.resolved_star_merge_capacity()
 
     part_bufs = SVBuffer(
         X=jnp.asarray(part.X, dtype),
@@ -394,12 +399,19 @@ def cascade_fit(
                     f" > capacity {train_cap}; increase sv_capacity"
                 )
         else:
-            # (the star layer-2 merge concatenates exactly n_shards*sv_cap
-            # rows = merged_cap, so only layer 1 can overflow)
             if diag["merged_count"][:, 0].max() > train_cap:
                 raise RuntimeError(
                     f"cascade train buffer overflow: "
                     f"{diag['merged_count'][:, 0].max()} > capacity {train_cap}"
+                )
+            # layer 2: the deduped worker-SV union must fit the compacted
+            # retrain buffer
+            if diag["merged_count"][:, 1].max() > merged_cap:
+                raise RuntimeError(
+                    f"star merged-retrain overflow: worker-SV union of "
+                    f"{diag['merged_count'][:, 1].max()} rows > capacity "
+                    f"{merged_cap}; increase sv_capacity or "
+                    "star_merge_capacity"
                 )
         if diag["sv_count"].max() > sv_cap:
             raise RuntimeError(
