@@ -5,8 +5,11 @@ Prints one JSON line {q, max_inner, outers, updates, time_s}. One heavy
 measurement per process (axon runtime faults on repeats — see verify skill).
 """
 import json
+import os
 import sys
 import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
 import jax
 

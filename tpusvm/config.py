@@ -60,8 +60,13 @@ class CascadeConfig:
         only needs to hold the union's VALID rows — not the concatenation —
         and the solver's cost scales with the padded size, so a tight value
         here is a large speedup at high P. None (default) =
-        min(2 * sv_capacity, n_shards * sv_capacity); overflow is detected
-        and raises at runtime.
+        min(2 * sv_capacity, n_shards * sv_capacity); if a round's union
+        overflows the tight buffer, the fit transparently widens to the
+        full concatenation capacity (with a RuntimeWarning and one
+        recompile), re-runs the round, and stays at full width for the
+        remaining rounds (the union grows with the global SV set, so a
+        later shrink would just re-overflow). Only meaningful for
+        topology="star"; setting it with "tree" raises.
     """
 
     n_shards: int = 8
@@ -77,10 +82,17 @@ class CascadeConfig:
             raise ValueError(
                 f"tree cascade requires a power-of-two shard count, got {self.n_shards}"
             )
-        if self.star_merge_capacity is not None and self.star_merge_capacity < 1:
-            raise ValueError(
-                f"star_merge_capacity must be >= 1, got {self.star_merge_capacity}"
-            )
+        if self.star_merge_capacity is not None:
+            if self.topology != "star":
+                raise ValueError(
+                    "star_merge_capacity only applies to the star topology; "
+                    f"got topology={self.topology!r}"
+                )
+            if self.star_merge_capacity < 1:
+                raise ValueError(
+                    f"star_merge_capacity must be >= 1, "
+                    f"got {self.star_merge_capacity}"
+                )
 
     def resolved_star_merge_capacity(self) -> int:
         cap = self.star_merge_capacity

@@ -382,12 +382,54 @@ def cascade_fit(
     # fallback result if the loop body never runs (resumed past max_rounds)
     new_global = jax.tree.map(np.asarray, global_sv)
 
+    full_merged_cap = n_shards * sv_cap  # star layer-2 concatenation bound
+
     for rnd in range(start_round, svm_config.max_rounds + 1):
         t0 = time.perf_counter()
-        out_global, b_all, diag = round_fn(part_bufs, global_sv)
+        while True:
+            out_global, b_all, diag = round_fn(part_bufs, global_sv)
+            diag = {k: np.asarray(v) for k, v in diag.items()}
+            if (
+                cc.topology == "star"
+                and diag["merged_count"][:, 1].max() > merged_cap
+            ):
+                # The deduped worker-SV union overflowed the tight layer-2
+                # retrain buffer, so this round's merged solve saw a
+                # truncated union — its result is invalid. The
+                # concatenation bound n_shards*sv_cap always fits, so
+                # transparently rebuild at that capacity, re-run the round
+                # (the inter-round state is untouched until the check
+                # passes), and keep the widened round_fn for the remaining
+                # rounds — the union grows with the global SV set, so a
+                # tight retry would just re-overflow. Raise only if even
+                # the full buffer overflowed, which the sv_count check
+                # below would catch anyway.
+                if merged_cap >= full_merged_cap:
+                    raise RuntimeError(
+                        f"star merged-retrain overflow: worker-SV union of "
+                        f"{diag['merged_count'][:, 1].max()} rows > capacity "
+                        f"{merged_cap}; increase sv_capacity"
+                    )
+                warnings.warn(
+                    f"cascade round {rnd}: worker-SV union of "
+                    f"{diag['merged_count'][:, 1].max()} rows overflowed the "
+                    f"star merge buffer ({merged_cap}); retrying the round "
+                    f"with the full concatenation capacity "
+                    f"{full_merged_cap} (set star_merge_capacity to avoid "
+                    "the recompile)",
+                    RuntimeWarning,
+                    stacklevel=2,
+                )
+                merged_cap = full_merged_cap
+                round_fn = _build_round_fn(
+                    mesh, cc.topology, n_shards, train_cap, merged_cap,
+                    sv_cap, svm_config, accum_dtype, solver,
+                    dict(solver_opts or {}),
+                )
+                continue
+            break
         new_global = jax.tree.map(lambda x: np.asarray(x[0]), out_global)
         b = float(np.asarray(b_all)[0])
-        diag = {k: np.asarray(v) for k, v in diag.items()}
         dt = time.perf_counter() - t0
         rounds = rnd
 
@@ -403,15 +445,6 @@ def cascade_fit(
                 raise RuntimeError(
                     f"cascade train buffer overflow: "
                     f"{diag['merged_count'][:, 0].max()} > capacity {train_cap}"
-                )
-            # layer 2: the deduped worker-SV union must fit the compacted
-            # retrain buffer
-            if diag["merged_count"][:, 1].max() > merged_cap:
-                raise RuntimeError(
-                    f"star merged-retrain overflow: worker-SV union of "
-                    f"{diag['merged_count'][:, 1].max()} rows > capacity "
-                    f"{merged_cap}; increase sv_capacity or "
-                    "star_merge_capacity"
                 )
         if diag["sv_count"].max() > sv_cap:
             raise RuntimeError(
