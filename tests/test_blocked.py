@@ -153,6 +153,35 @@ def test_blocked_refine_overcap_skips_reconstruction():
                                atol=1e-6)
 
 
+def test_blocked_fast_matmul_requires_refine():
+    X = jnp.zeros((8, 2), jnp.float32)
+    Y = jnp.asarray([1, -1] * 4, jnp.int32)
+    with pytest.raises(ValueError, match="refine"):
+        blocked_smo_solve(X, Y, matmul_precision="default")
+    with pytest.raises(ValueError, match="matmul_precision"):
+        blocked_smo_solve(X, Y, matmul_precision="bf16")
+
+
+def test_blocked_fast_matmul_matches_baseline():
+    """matmul_precision='default' + refine lands at the same optimum as the
+    full-precision run (on CPU the knob is a no-op numerically — true f32
+    either way — so this pins the plumbing and the refine pairing; the
+    bf16-vs-f32 trajectory itself is exercised on TPU by bench/probes)."""
+    Xs, Y = _data(rings, n=512, seed=5)
+    kw = dict(C=10.0, gamma=10.0, tau=1e-5, q=128, max_inner=256,
+              max_outer=2000, accum_dtype=jnp.float64)
+    r0 = blocked_smo_solve(jnp.asarray(Xs), jnp.asarray(Y), **kw)
+    r1 = blocked_smo_solve(jnp.asarray(Xs), jnp.asarray(Y),
+                           matmul_precision="default", refine=512,
+                           max_refines=2, **kw)
+    assert int(r0.status) == Status.CONVERGED
+    assert int(r1.status) == Status.CONVERGED
+    sv0 = set(np.flatnonzero(np.asarray(r0.alpha) > 1e-8).tolist())
+    sv1 = set(np.flatnonzero(np.asarray(r1.alpha) > 1e-8).tolist())
+    assert sv0 == sv1
+    np.testing.assert_allclose(float(r1.b), float(r0.b), atol=1e-3)
+
+
 def test_blocked_rejects_bad_wss():
     X = jnp.zeros((16, 4), jnp.float32)
     Y = jnp.asarray([1, -1] * 8, jnp.int32)

@@ -146,7 +146,8 @@ def _inner_smo(K_BB, y_B, a_B, f_B, active_B, C, eps, tau, max_inner):
 @functools.partial(
     jax.jit,
     static_argnames=("q", "max_outer", "max_inner", "warm_start",
-                     "accum_dtype", "inner", "refine", "max_refines", "wss"),
+                     "accum_dtype", "inner", "refine", "max_refines", "wss",
+                     "matmul_precision"),
 )
 def blocked_smo_solve(
     X: jax.Array,
@@ -168,6 +169,7 @@ def blocked_smo_solve(
     refine: int = 0,
     max_refines: int = 2,
     wss: int = 1,
+    matmul_precision: Optional[str] = None,
 ) -> SMOResult:
     """Train to the reference's stopping criterion with blocked working sets.
 
@@ -211,6 +213,22 @@ def blocked_smo_solve(
     more alphas are live than cap, the reconstruction is skipped (the
     claim is accepted as-is) rather than computed from a truncated
     coefficient set, which would corrupt f.
+
+    matmul_precision (static): MXU precision for the in-loop O(n*d*q)
+    error-vector contraction — the solver's dominant cost. None keeps the
+    ops-layer default ("float32": full-f32-equivalent multi-pass MXU
+    matmuls, ops/rbf.py DEFAULT_PRECISION). "default" uses raw single-pass
+    bf16 MXU matmuls (~3x the matmul throughput) for the in-loop f DELTAS
+    only: working-set selection then sees a slightly noisier f, which can
+    change which pairs are optimised but not what optimum they converge
+    to, and every trust anchor stays full precision — K_BB (the analytic
+    updates), the refine reconstructions, and the stopping decision made
+    on the reconstructed f. Pair with refine > 0 and max_refines >= 1 (so
+    convergence claims are re-validated on a full-precision rebuild) —
+    requested fast mode without a refine budget raises. Note the refine
+    cap semantics above still apply: if more alphas are live than the cap,
+    the rebuild is skipped and the claim is accepted on the drifted f —
+    in fast mode size the cap generously above the expected SV count.
     """
     n = Y.shape[0]
     dtype = X.dtype
@@ -222,6 +240,18 @@ def blocked_smo_solve(
         raise ValueError(f"inner must be auto|xla|pallas, got {inner!r}")
     if wss not in (1, 2):
         raise ValueError(f"wss must be 1 or 2, got {wss}")
+    if matmul_precision not in (None, "float32", "default", "highest"):
+        raise ValueError(
+            f"matmul_precision must be None, 'float32', 'default' or "
+            f"'highest', got {matmul_precision!r}"
+        )
+    if matmul_precision == "default" and (refine <= 0 or max_refines < 1):
+        raise ValueError(
+            "matmul_precision='default' (raw bf16 MXU passes) accumulates "
+            "f drift and must be paired with refine > 0 and max_refines "
+            ">= 1 so convergence claims are re-validated on a "
+            "full-precision reconstruction"
+        )
     requested_inner = inner
     if inner == "auto":
         inner = ("pallas" if jax.default_backend() == "tpu"
@@ -372,7 +402,8 @@ def blocked_smo_solve(
                 da_B = a_B_new - a_B
 
             dcoef = da_B * y_B.astype(adt)
-            df = rbf_cross_matvec(X, X_B, dcoef, gamma, sn).astype(adt)
+            df = rbf_cross_matvec(X, X_B, dcoef, gamma, sn,
+                                  precision=matmul_precision).astype(adt)
             # .add, not .set: inactive duplicate rows carry a zero delta, so
             # double-indexed scatter stays correct
             return (alpha.at[B].add(da_B), f + df, upd, progress,
