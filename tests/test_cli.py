@@ -79,6 +79,47 @@ def test_cli_train_single_and_predict(tmp_path, capsys):
     assert "accuracy = " in capsys.readouterr().out
 
 
+def test_cli_solver_opt_passthrough(capsys):
+    # KEY=VALUE knobs reach the blocked solver: q=64 on a 200-point problem
+    # still converges; a bogus knob must fail loudly (TypeError from the
+    # solve call), proving the values are actually forwarded
+    rc = main([
+        "train", "--synthetic", "rings", "--n", "200", "--n-test", "60",
+        "--C", "10", "--gamma", "10",
+        "--solver-opt", "q=64", "--solver-opt", "max_inner=128",
+    ])
+    assert rc == 0
+    assert "accuracy = " in capsys.readouterr().out
+
+    # unknown knobs fail BEFORE the data load, with the valid names listed
+    with pytest.raises(SystemExit, match="bogus_knob"):
+        main([
+            "train", "--synthetic", "rings", "--n", "100", "--n-test", "30",
+            "--C", "10", "--gamma", "10", "--solver-opt", "bogus_knob=1",
+        ])
+
+    # hyperparameters with dedicated flags are rejected (would collide
+    # with the explicit fit kwargs)
+    with pytest.raises(SystemExit, match="dedicated flags"):
+        main([
+            "train", "--synthetic", "rings", "--n", "100", "--n-test", "30",
+            "--solver-opt", "gamma=10",
+        ])
+
+    with pytest.raises(SystemExit, match="KEY=VALUE"):
+        main([
+            "train", "--synthetic", "rings", "--n", "100", "--n-test", "30",
+            "--solver-opt", "novalue",
+        ])
+
+    # the oracle has no static solver knobs; silent no-op would mislead
+    with pytest.raises(SystemExit, match="oracle"):
+        main([
+            "train", "--synthetic", "rings", "--n", "100", "--n-test", "30",
+            "--mode", "oracle", "--solver-opt", "q=64",
+        ])
+
+
 def test_cli_train_oracle(capsys):
     rc = main([
         "train", "--synthetic", "rings", "--n", "120", "--n-test", "40",

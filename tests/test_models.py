@@ -137,3 +137,17 @@ def test_ovr_blocked_solver_matches_pair():
 def test_ovr_rejects_bad_solver():
     with pytest.raises(ValueError, match="solver must be"):
         OneVsRestSVC(solver="cuda")
+
+
+def test_ovr_solver_opts_forwarded():
+    X, labels = _four_class_data(n=240, seed=2)
+    cfg = SVMConfig(C=10.0, gamma=2.0)
+    m = OneVsRestSVC(cfg, dtype=jnp.float32, solver="blocked",
+                     accum_dtype=jnp.float64,
+                     solver_opts={"q": 64, "max_inner": 128}).fit(X, labels)
+    assert (m.statuses_ == Status.CONVERGED).all()
+    assert m.score(X, labels) > 0.97
+    # a bogus knob must raise from the solve call, proving forwarding
+    with pytest.raises(TypeError):
+        OneVsRestSVC(cfg, solver="blocked",
+                     solver_opts={"bogus": 1}).fit(X, labels)

@@ -108,6 +108,13 @@ def _build_parser() -> argparse.ArgumentParser:
         "oracle = serial NumPy SMO (main3.cpp capability)",
     )
     mode.add_argument(
+        "--solver-opt", action="append", default=[], metavar="KEY=VALUE",
+        help="extra static solver knob, repeatable (blocked solver: q, "
+        "max_outer, max_inner, wss, refine, max_refines, inner, "
+        "matmul_precision — e.g. --solver-opt q=2048 "
+        "--solver-opt matmul_precision=default --solver-opt refine=4096); "
+        "integer values are auto-converted")
+    mode.add_argument(
         "--solver", choices=["blocked", "pair"], default=None,
         help="on-device solver for --mode single, each cascade shard, and "
         "each --multiclass class: blocked working-set (TPU-first, default "
@@ -254,7 +261,47 @@ def _cmd_train(args) -> int:
                         eps=args.eps, sv_tol=args.sv_tol,
                         max_iter=args.max_iter, max_rounds=args.max_rounds)
 
+    solver_opts = {}
+    for item in args.solver_opt:
+        key, sep, value = item.partition("=")
+        if not sep or not key:
+            raise SystemExit(
+                f"--solver-opt expects KEY=VALUE, got {item!r}"
+            )
+        try:
+            solver_opts[key] = int(value)
+        except ValueError:
+            solver_opts[key] = value
+
     # pure flag-consistency checks, before the (possibly long) data load
+    if solver_opts:
+        if args.mode == "oracle":
+            raise SystemExit(
+                "--solver-opt has no effect on --mode oracle (the NumPy "
+                "oracle has no static solver knobs)"
+            )
+        # validate knob names against the selected solver's signature now,
+        # not minutes later from inside fit
+        import inspect
+
+        from tpusvm.solver import smo_solve
+        from tpusvm.solver.blocked import blocked_smo_solve
+
+        solver_name = args.solver or ("pair" if args.multiclass else "blocked")
+        fn = blocked_smo_solve if solver_name == "blocked" else smo_solve
+        # arrays and the hyperparameters with dedicated CLI flags are not
+        # --solver-opt material (passing them twice would TypeError in fit)
+        reserved = {"X", "Y", "valid", "alpha0",
+                    "C", "gamma", "eps", "tau", "max_iter", "accum_dtype"}
+        known = set(inspect.signature(fn).parameters) - reserved
+        bad = sorted(set(solver_opts) - known)
+        if bad:
+            hint = [k for k in bad if k in reserved]
+            raise SystemExit(
+                f"--solver-opt: unknown {solver_name!r}-solver knob(s) "
+                f"{bad}; known: {sorted(known)}"
+                + (f" (use the dedicated flags for {hint})" if hint else "")
+            )
     if args.resume and not args.checkpoint:
         raise SystemExit("--resume requires --checkpoint")
     if args.checkpoint and args.mode != "cascade":
@@ -275,7 +322,8 @@ def _cmd_train(args) -> int:
             raise SystemExit("--multiclass currently supports --mode single")
         model = OneVsRestSVC(config=cfg, dtype=dtype, scale=not args.no_scale,
                              accum_dtype=accum_dtype,
-                             solver=args.solver or "pair")
+                             solver=args.solver or "pair",
+                             solver_opts=solver_opts)
         with timer.phase("training"), trace(args.profile):
             model.fit(X, Y)
         log.info("classes = %s", list(model.classes_))
@@ -284,7 +332,8 @@ def _cmd_train(args) -> int:
     else:
         model = BinarySVC(config=cfg, dtype=dtype, scale=not args.no_scale,
                           accum_dtype=accum_dtype,
-                          solver=args.solver or "blocked")
+                          solver=args.solver or "blocked",
+                          solver_opts=solver_opts)
         with timer.phase("training"), trace(args.profile):
             if args.mode == "cascade":
                 shards = args.shards or len(jax.devices())

@@ -55,6 +55,7 @@ class OneVsRestSVC:
         batched: Optional[bool] = None,
         accum_dtype="auto",
         solver: str = "pair",
+        solver_opts: Optional[dict] = None,
     ):
         if solver not in ("pair", "blocked"):
             raise ValueError(f"solver must be pair|blocked, got {solver!r}")
@@ -72,6 +73,9 @@ class OneVsRestSVC:
         self.batched = batched if batched is not None else (solver == "pair")
         self.accum_dtype = accum_dtype
         self.solver = solver
+        # extra static solver knobs forwarded to the per-class solve calls
+        # (blocked: q, max_outer, max_inner, wss, refine, matmul_precision)
+        self.solver_opts = dict(solver_opts or {})
         self.scaler_: Optional[MinMaxScaler] = None
         self.classes_: Optional[np.ndarray] = None
         self.X_sv_: Optional[np.ndarray] = None   # union of SVs across classes
@@ -113,13 +117,14 @@ class OneVsRestSVC:
                 return blocked_smo_solve(
                     Xd, y, C=cfg.C, gamma=cfg.gamma, eps=cfg.eps,
                     tau=cfg.tau, max_iter=cfg.max_iter,
-                    accum_dtype=accum_dtype,
+                    accum_dtype=accum_dtype, **self.solver_opts,
                 )
         else:
             def solve_one(y):
                 return smo_solve(
                     Xd, y, C=cfg.C, gamma=cfg.gamma, eps=cfg.eps, tau=cfg.tau,
                     max_iter=cfg.max_iter, accum_dtype=accum_dtype,
+                    **self.solver_opts,
                 )
 
         if self.batched and self.solver == "pair":
