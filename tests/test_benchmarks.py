@@ -43,6 +43,40 @@ def test_sweep_p_smoke_schema_and_convergence(capsys):
     assert recs[0]["sv_jaccard_vs_first"] == 1.0
 
 
+def test_sweep_n_smoke_schema(capsys):
+    from benchmarks import sweep_n
+
+    rc = sweep_n.main(["--sizes", "384", "--n-test", "128", "--d", "32",
+                       "--gamma", "0.03125", "--q", "128",
+                       "--max-inner", "128"])
+    assert rc == 0
+    recs = _records(capsys)
+    assert len(recs) == 1
+    r = recs[0]
+    assert r["n"] == 384
+    assert r["train_s"] > 0 and r["predict_s"] > 0
+    assert r["predict_all_n_s"] > 0  # the like-for-like C16 semantics time
+    assert 0.0 <= r["accuracy"] <= 1.0
+    assert r["n_sv"] > 0
+    # sizes outside the reference's table carry no vs_gpu_* ratios
+    assert r["vs_gpu_train"] is None
+
+
+def test_ovr_10class_smoke_schema(capsys):
+    from benchmarks import ovr_10class
+
+    rc = ovr_10class.main(["--n", "400", "--n-test", "100", "--d", "32",
+                           "--gamma", "0.03125"])
+    assert rc == 0
+    recs = _records(capsys)
+    assert len(recs) == 1
+    r = recs[0]
+    assert r["classes"] == 10
+    assert 0.0 <= r["accuracy"] <= 1.0
+    assert r["train_s"] > 0
+    assert r["n_sv_union"] > 0
+
+
 def test_sweep_p_tree_skips_non_power_of_two(capsys):
     from benchmarks import sweep_p
 

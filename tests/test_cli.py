@@ -87,9 +87,17 @@ def test_cli_solver_opt_passthrough(capsys):
         "train", "--synthetic", "rings", "--n", "200", "--n-test", "60",
         "--C", "10", "--gamma", "10",
         "--solver-opt", "q=64", "--solver-opt", "max_inner=128",
+        "--solver-opt", "warm_start=false",
     ])
     assert rc == 0
     assert "accuracy = " in capsys.readouterr().out
+
+    from tpusvm.cli import _parse_solver_opts  # value typing, in isolation
+
+    assert _parse_solver_opts([
+        "q=64", "warm_start=false", "refine=1e4", "matmul_precision=default",
+    ]) == {"q": 64, "warm_start": False, "refine": 10000.0,
+           "matmul_precision": "default"}
 
     # unknown knobs fail BEFORE the data load, with the valid names listed
     with pytest.raises(SystemExit, match="bogus_knob"):

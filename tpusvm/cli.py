@@ -223,6 +223,34 @@ def _load_train_data(args) -> Tuple[np.ndarray, np.ndarray, Optional[np.ndarray]
             X[n_total - args.n_test :], Y[n_total - args.n_test :])
 
 
+def _parse_solver_opts(items) -> dict:
+    """KEY=VALUE --solver-opt strings -> typed knob dict.
+
+    Values convert bool -> int -> float -> string in that order, so
+    warm_start=false is a real False (not a truthy str) and refine=1e4 a
+    number, while knobs like matmul_precision=default stay strings.
+    """
+    opts = {}
+    for item in items:
+        key, sep, value = item.partition("=")
+        if not sep or not key:
+            raise SystemExit(
+                f"--solver-opt expects KEY=VALUE, got {item!r}"
+            )
+        if value.lower() in ("true", "false"):
+            opts[key] = value.lower() == "true"
+            continue
+        for conv in (int, float):
+            try:
+                opts[key] = conv(value)
+                break
+            except ValueError:
+                continue
+        else:
+            opts[key] = value
+    return opts
+
+
 def _cmd_train(args) -> int:
     import jax
     import jax.numpy as jnp
@@ -261,17 +289,7 @@ def _cmd_train(args) -> int:
                         eps=args.eps, sv_tol=args.sv_tol,
                         max_iter=args.max_iter, max_rounds=args.max_rounds)
 
-    solver_opts = {}
-    for item in args.solver_opt:
-        key, sep, value = item.partition("=")
-        if not sep or not key:
-            raise SystemExit(
-                f"--solver-opt expects KEY=VALUE, got {item!r}"
-            )
-        try:
-            solver_opts[key] = int(value)
-        except ValueError:
-            solver_opts[key] = value
+    solver_opts = _parse_solver_opts(args.solver_opt)
 
     # pure flag-consistency checks, before the (possibly long) data load
     if solver_opts:
@@ -291,12 +309,12 @@ def _cmd_train(args) -> int:
         fn = blocked_smo_solve if solver_name == "blocked" else smo_solve
         # arrays and the hyperparameters with dedicated CLI flags are not
         # --solver-opt material (passing them twice would TypeError in fit)
-        reserved = {"X", "Y", "valid", "alpha0",
-                    "C", "gamma", "eps", "tau", "max_iter", "accum_dtype"}
+        flagged = {"C", "gamma", "eps", "tau", "max_iter", "accum_dtype"}
+        reserved = {"X", "Y", "valid", "alpha0"} | flagged
         known = set(inspect.signature(fn).parameters) - reserved
         bad = sorted(set(solver_opts) - known)
         if bad:
-            hint = [k for k in bad if k in reserved]
+            hint = [k for k in bad if k in flagged]
             raise SystemExit(
                 f"--solver-opt: unknown {solver_name!r}-solver knob(s) "
                 f"{bad}; known: {sorted(known)}"
