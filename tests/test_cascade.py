@@ -176,6 +176,23 @@ def test_star_merge_capacity_default_matches_wide_buffer():
     np.testing.assert_allclose(r_tight.b, r_wide.b, atol=1e-4)
 
 
+@pytest.mark.parametrize("topology", ["tree", "star"])
+def test_single_shard_cascade_degenerates_cleanly(oracle_rings, topology):
+    # P=1 is the single-accelerator edge (one real chip, no partner to
+    # merge with): both topologies must run their collective machinery
+    # over the 1-member mesh and converge to the plain solve's SV set in
+    # the minimum 2 rounds (solve, then ID-set-stable confirmation)
+    Xs, Y, o = oracle_rings
+    r = cascade_fit(
+        Xs, Y, CFG,
+        CascadeConfig(n_shards=1, sv_capacity=256, topology=topology),
+        dtype=jnp.float64,
+    )
+    assert r.converged and r.rounds == 2
+    assert set(r.sv_ids.tolist()) == set(get_sv_indices(o.alpha).tolist())
+    np.testing.assert_allclose(r.b, o.b, atol=1e-4)
+
+
 def test_history_diagnostics():
     Xs, Y = _ring_data()
     res = cascade_fit(
