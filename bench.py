@@ -72,8 +72,20 @@ def main():
     traced_kwargs = dict(C=10.0, gamma=0.00125, eps=1e-12, tau=1e-5)
     # q/max_inner/wss tuned with benchmarks/probe_split.py on this workload;
     # wss=2 = second-order partner selection in the fused inner kernel
-    # (same stopping rule, ~25% fewer updates than first-order)
-    static_kwargs = dict(q=2048, max_outer=5000, max_inner=2048, wss=2,
+    # (same stopping rule, ~25% fewer updates than first-order).
+    # max_inner=4096 (deeper subproblems per K-block) measured ~11% faster
+    # than 2048 — fewer O(n*d*q) outer passes buy more cheap VMEM updates;
+    # 8192 was flat vs 4096 (over-optimising stale subproblems). Grid +
+    # pick rationale: benchmarks/results/probe_split_tpu_v5e.jsonl and its
+    # README row (q=1536 probed 3% faster once but with 21% more inner
+    # updates — inside noise, more latency exposure; not adopted).
+    # matmul_precision="default" (bf16 MXU passes) was evaluated and NOT
+    # adopted: a CPU-emulated drift study (bf16-quantised inputs) converged
+    # to the identical SV set but needed ~1.8x the outer rounds + all its
+    # refine budget — roughly a wash net of the ~3x matmul speedup, with a
+    # weaker convergence guarantee. It remains an opt-in
+    # (tpusvm/solver/blocked.py matmul_precision).
+    static_kwargs = dict(q=2048, max_outer=5000, max_inner=4096, wss=2,
                          accum_dtype=jnp.float64)
     log("compiling solver (AOT)...")
     t0 = time.perf_counter()
