@@ -1,7 +1,7 @@
 """One-shot probe: time the blocked solver at a given (q, max_inner, max_outer).
 
 Usage: python benchmarks/probe_split.py <q> <max_inner> <max_outer> \
-           [wss] [matmul_precision] [refine]
+           [wss] [matmul_precision] [refine] [selection]
 Prints one JSON line {q, max_inner, ..., n_sv, b, time_s}. One heavy
 measurement per process (axon runtime faults on repeats — see verify skill).
 """
@@ -26,6 +26,7 @@ q, max_inner, max_outer = (int(a) for a in sys.argv[1:4])
 wss = int(sys.argv[4]) if len(sys.argv) > 4 else 1
 precision = sys.argv[5] if len(sys.argv) > 5 else None
 refine = int(sys.argv[6]) if len(sys.argv) > 6 else 0
+selection = sys.argv[7] if len(sys.argv) > 7 else "auto"
 
 X, Y = mnist_like(n=60000, d=784, seed=0, noise=30, label_noise=0.005)
 Xs = MinMaxScaler().fit_transform(X)
@@ -37,7 +38,7 @@ solve = jax.jit(
         X, Y, C=10.0, gamma=0.00125, tau=1e-5, max_iter=10**9,
         q=q, max_inner=max_inner, max_outer=max_outer, wss=wss,
         accum_dtype=jnp.float64, matmul_precision=precision,
-        refine=refine, max_refines=4,
+        refine=refine, max_refines=4, selection=selection,
     )
 )
 lowered = solve.lower(Xd, Yd).compile()
@@ -52,6 +53,7 @@ t1 = time.perf_counter()
 n_sv = int((np.asarray(r.alpha) > 1e-8).sum())
 print(json.dumps({"q": q, "max_inner": max_inner, "wss": wss,
                   "precision": precision, "refine": refine,
+                  "selection": selection,
                   "outers": out[0], "updates": out[1], "status": out[2],
                   "n_sv": n_sv, "b": float(np.asarray(r.b)),
                   "time_s": round(t1 - t0, 4)}))

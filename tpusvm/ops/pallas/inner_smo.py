@@ -8,8 +8,8 @@ TPU runtime (measured with benchmarks/probe_split.py: 84k updates = 3.4s of
 a 4.1s MNIST-60k solve). This kernel fuses the whole subproblem — working
 -set selection, analytic pair update, f/alpha updates, and the termination
 cascade — into ONE kernel launch with K_BB resident in VMEM, so each inner
-iteration is a handful of VPU ops on (1, q) vectors instead of a dispatched
-XLA op graph.
+iteration is a handful of VPU ops on sublane-packed (q//128, 128) vectors
+instead of a dispatched XLA op graph.
 
 This is the TPU-native analogue of how GPU SVM solvers run the subproblem in
 a single thread block against shared-memory K (the design the reference's
@@ -62,26 +62,36 @@ _MAX_ITER = int(Status.MAX_ITER)
 
 
 def _make_kernel(q: int, max_inner: int, wss: int):
+    # Working vectors are laid out (R, LANE) rather than (1, q): a (1, q)
+    # f32 vector occupies q/LANE vregs but uses only 1 of 8 sublanes in
+    # each, so every elementwise op wastes 7/8 of VPU throughput. The
+    # (R, LANE) row-major layout packs the same q lanes into ceil(R/8)
+    # full vregs; the global index of element (r, c) is r*LANE + c, which
+    # preserves the (1, q) ordering, so first-occurrence tie-breaks (and
+    # hence the whole iteration trajectory) are unchanged.
+    R = q // LANE
+
     def kernel(scal_ref, K_ref, diag_ref, y_ref, a0_ref, f0_ref, act_ref,
                diag_s_ref, y_s_ref, a0_s_ref, aout_ref, stat_ref, a_s_ref):
-        iota = lax.broadcasted_iota(jnp.int32, (1, q), 1)
+        iota = (lax.broadcasted_iota(jnp.int32, (R, LANE), 0) * LANE
+                + lax.broadcasted_iota(jnp.int32, (R, LANE), 1))
 
         def pick(v, i):
-            """v[0, i] for a traced scalar i, as a masked reduction (no
-            dynamic scalar addressing into loop-carried values on the VPU).
-            Used only where the value lives in vector registers (a freshly
-            loaded K row, the current f); everything with a static home
-            (y, diag) or a maintained mirror (alpha) reads from SMEM in
-            O(1) instead — each pick is a full cross-lane reduction,
-            ~0.25us at q=2048 (measured via the wss=1 vs wss=2 bench
-            delta), and they dominated the original kernel's 8.2us/update."""
+            """v at global index i for a traced scalar i, as a masked
+            reduction (no dynamic scalar addressing into loop-carried
+            values on the VPU). Used only where the value lives in vector
+            registers (a freshly loaded K row, the current f); everything
+            with a static home (y, diag) or a maintained mirror (alpha)
+            reads from SMEM in O(1) instead — each pick is a full
+            cross-lane reduction, and they dominated the original
+            kernel's 8.2us/update."""
             return jnp.sum(jnp.where(iota == i, v, 0.0))
 
         C = scal_ref[0]
         eps = scal_ref[1]
         tau = scal_ref[2]
-        y = y_ref[:]                      # (1, q) float32, +/-1 (0 on pads)
-        diag = diag_ref[:]                # (1, q) K_BB diagonal
+        y = y_ref[:]                      # (R, LANE) float32, +/-1 (0 on pads)
+        diag = diag_ref[:]                # (R, LANE) K_BB diagonal
         pos = y > 0.0
 
         # SMEM alpha mirror: scalar reads (a[i_h], a[i_l]) and the two
@@ -115,7 +125,9 @@ def _make_kernel(q: int, max_inner: int, wss: int):
             i_h = jnp.min(jnp.where(vh == b_h, iota, jnp.int32(q)))
             vl = jnp.where(m_l, f, -jnp.inf)
             b_l = jnp.max(vl)
-            i_l = jnp.min(jnp.where(vl == b_l, iota, jnp.int32(q)))
+            if wss == 1:
+                i_l = jnp.min(jnp.where(vl == b_l, iota, jnp.int32(q)))
+                i_l = jnp.minimum(i_l, jnp.int32(q - 1))
 
             # emptiness check without jnp.any (whose Mosaic lowering goes
             # through an f64 squeeze under x64): masked-out lanes are +/-inf,
@@ -126,9 +138,8 @@ def _make_kernel(q: int, max_inner: int, wss: int):
 
             # clamp so the row loads stay in bounds when not found (i == q)
             i_h = jnp.minimum(i_h, jnp.int32(q - 1))
-            i_l = jnp.minimum(i_l, jnp.int32(q - 1))
 
-            row_h = K_ref[pl.ds(i_h, 1), :]   # (1, q)
+            row_h = K_ref[pl.ds(i_h, 1)].reshape(R, LANE)
             K11 = diag_s_ref[i_h]
 
             if wss == 2:
@@ -142,12 +153,16 @@ def _make_kernel(q: int, max_inner: int, wss: int):
                 vg = jnp.where(viol, (f - b_h) ** 2 / eta_vec, -jnp.inf)
                 g = jnp.max(vg)
                 i_l2 = jnp.min(jnp.where(vg == g, iota, jnp.int32(q)))
-                # no violating partner (only at/past convergence): keep the
-                # first-order pick so the update path stays well-defined
-                i_l = jnp.where(g > -jnp.inf,
-                                jnp.minimum(i_l2, jnp.int32(q - 1)), i_l)
+                # the second-order pick IS the i_low (no first-order
+                # fallback reduction): whenever this iteration proceeds, a
+                # violating partner exists — viol empty means no f in I_low
+                # exceeds b_h, so b_l <= b_h < b_h + 2*tau and the
+                # iteration exits as converged (or not-found) with zero
+                # deltas, so the i_l=0 index that an all-(-inf) vg yields
+                # is used only for in-bounds loads and zero-delta stores
+                i_l = jnp.minimum(i_l2, jnp.int32(q - 1))
 
-            row_l = K_ref[pl.ds(i_l, 1), :]
+            row_l = K_ref[pl.ds(i_l, 1)].reshape(R, LANE)
             K22 = diag_s_ref[i_l]
             K12 = pick(row_h, i_l)   # row_h is in vector registers
             y_h = y_s_ref[i_h]
@@ -234,6 +249,7 @@ def inner_smo_pallas(K_BB, y_B, a_B, f_B, active_B, C, eps, tau, *,
     q = y_B.shape[0]
     if q % LANE:
         raise ValueError(f"inner_smo_pallas needs q % {LANE} == 0, got {q}")
+    R = q // LANE
     scal = jnp.stack([
         jnp.asarray(C, jnp.float32),
         jnp.asarray(eps, jnp.float32),
@@ -264,21 +280,21 @@ def inner_smo_pallas(K_BB, y_B, a_B, f_B, active_B, C, eps, tau, *,
             pl.BlockSpec(memory_space=pltpu.SMEM),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((1, q), jnp.float32),
+            jax.ShapeDtypeStruct((R, LANE), jnp.float32),
             jax.ShapeDtypeStruct((3,), jnp.int32),
         ],
         scratch_shapes=[pltpu.SMEM((q,), jnp.float32)],  # alpha mirror
         interpret=interpret,
     )(
         scal,
-        K32,
-        diag32[None, :],
-        y32[None, :],
-        a32[None, :],
-        f_B.astype(jnp.float32)[None, :],
-        active_B.astype(jnp.float32)[None, :],
+        K32.reshape(q, R, LANE),
+        diag32.reshape(R, LANE),
+        y32.reshape(R, LANE),
+        a32.reshape(R, LANE),
+        f_B.astype(jnp.float32).reshape(R, LANE),
+        active_B.astype(jnp.float32).reshape(R, LANE),
         diag32,
         y32,
         a32,
     )
-    return (aout[0].astype(a_B.dtype), stat[0], stat[1] > 0, stat[2])
+    return (aout.reshape(q).astype(a_B.dtype), stat[0], stat[1] > 0, stat[2])
