@@ -42,9 +42,14 @@ def test_inner_smo_pallas_invariants():
     )
 
 
-def test_inner_smo_pallas_matches_xla_before_bailout():
-    """With no numerical bail-outs, the f32 trajectories are identical."""
-    K, y, a0, f0, act = _subproblem(seed=3)
+@pytest.mark.parametrize("q", [128, 256])
+def test_inner_smo_pallas_matches_xla_before_bailout(q):
+    """With no numerical bail-outs, the f32 trajectories are identical.
+
+    q=128 is the degenerate single-row layout (R=1); q=256 exercises the
+    multi-row (R, 128) sublane-packed layout, whose row-major index
+    mapping must preserve the (1, q) first-occurrence tie-breaks."""
+    K, y, a0, f0, act = _subproblem(q=q, seed=3)
     a_x, n_x, _, r_x = _inner_smo(K, y, a0, f0, act, 10.0, 1e-12, 1e-5, 200)
     a_p, n_p, _, r_p = inner_smo_pallas(
         K, y, a0, f0, act, 10.0, 1e-12, 1e-5, max_inner=200, interpret=True
