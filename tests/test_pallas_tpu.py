@@ -120,3 +120,29 @@ def test_compiled_box_constraints_and_padding(midsolve_subproblem):
         np.testing.assert_array_equal(
             a_p[inactive], np.asarray(a, np.float32)[inactive]
         )
+
+
+def test_compiled_multirow_layout_matches_xla():
+    """R > 1 (q=256) through compiled Mosaic: the sublane-packed (R, 128)
+    layout must produce the same trajectory as the XLA inner loop. The
+    module fixture's Q=128 is the degenerate single-row case; the bench
+    configuration runs q=2048 (R=16), so a multi-row lowering regression
+    would otherwise surface only in bench.py."""
+    q = 256
+    X, Y = rings(n=q, seed=11)
+    Xs = MinMaxScaler().fit_transform(X).astype(np.float32)
+    Xd = jnp.asarray(Xs)
+    K = rbf_cross(Xd, Xd, GAMMA)
+    y = jnp.asarray(np.asarray(Y, np.float32))
+    a0 = jnp.zeros(q, jnp.float32)
+    f0 = -y
+    act = jnp.ones(q, bool)
+    from tpusvm.ops.pallas.inner_smo import inner_smo_pallas
+
+    a_x, n_x, _, _ = _inner_smo(K, y, a0, f0, act, C, EPS, TAU, 300)
+    a_p, n_p, _, _ = inner_smo_pallas(
+        K, y, a0, f0, act, C, EPS, TAU, max_inner=300, interpret=False
+    )
+    assert int(n_p) > 0
+    np.testing.assert_allclose(np.asarray(a_p), np.asarray(a_x), atol=1e-3)
+    assert abs(int(n_p) - int(n_x)) <= max(5, int(n_x) // 10)

@@ -143,9 +143,14 @@ def rbf_cross_matvec(
     )
     _, chunks = jax.lax.scan(step, None, starts)
 
-    idx = starts[:, None] + jnp.arange(block, dtype=jnp.int32)[None, :]
-    out = jnp.zeros((n,), X.dtype)
-    return out.at[idx.reshape(-1)].set(chunks.reshape(-1).astype(X.dtype))
+    # Reassemble with static slices, not an (n,)-sized scatter (scatters
+    # lower poorly on TPU and this runs once per outer solver round): every
+    # block but the last is contiguous at start i*block; the clamped last
+    # block covers [n-block, n), whose first nb*block-n rows duplicate
+    # values already written by the body and are dropped.
+    body = chunks[:-1].reshape(-1)
+    tail = chunks[-1, (nb * block - n):]
+    return jnp.concatenate([body, tail]).astype(X.dtype)
 
 
 def rbf_matvec(X: jax.Array, coef: jax.Array, gamma, block: int = 1024,

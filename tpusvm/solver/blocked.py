@@ -364,10 +364,15 @@ def blocked_smo_solve(
 
             # B can contain one sample twice (an idx_up filler re-picked by
             # idx_low); keep only the first occurrence active — two live
-            # copies of one dual variable would corrupt the f update
-            pos = jnp.arange(q, dtype=jnp.int32)
-            first_pos = jnp.full((n,), q, jnp.int32).at[B].min(pos)
-            is_first = first_pos[B] == pos
+            # copies of one dual variable would corrupt the f update. Each
+            # half's indices are distinct (top-k picks distinct positions),
+            # so duplicates are only cross-half and first-occurrence means
+            # the up-half copy wins: a (q/2)^2 membership test, not an
+            # (n,)-sized scatter-min (scatters lower poorly on TPU)
+            dup_low = (idx_low[:, None] == idx_up[None, :]).any(axis=1)
+            is_first = jnp.concatenate(
+                [jnp.ones((half,), bool), ~dup_low]
+            )
 
             X_B = X[B]
             y_B = Y[B]
