@@ -170,8 +170,19 @@ def _make_kernel(q: int, max_inner: int, wss: int):
             a_h = a_s_ref[i_h]
             a_l = a_s_ref[i_l]
             # the 2-variable step uses the SELECTED pair's f values; with
-            # first-order selection f[i_l] == b_l exactly
-            b_l_pair = pick(f, i_l) if wss == 2 else b_l
+            # first-order selection f[i_l] == b_l exactly. For wss=2,
+            # f[i_l] is reconstructed from the selected gain instead of a
+            # cross-lane pick: g = (f[i_l]-b_h)^2/eta_clamped at exactly
+            # this lane (eta_clamped recomputed below from the same K11/
+            # K22/K12 scalars), so sqrt(g*eta_clamped) recovers
+            # f[i_l]-b_h (> 0 for violators) to f32 rounding. When no
+            # violator exists g==-inf, but then the iteration exits with
+            # zero deltas, so the maximum(g, 0) placeholder is unused.
+            if wss == 2:
+                eta_l = jnp.maximum(K11 + K22 - 2.0 * K12, 1e-12)
+                b_l_pair = b_h + jnp.sqrt(jnp.maximum(g, 0.0) * eta_l)
+            else:
+                b_l_pair = b_l
 
             upd = pair_update(K11, K22, K12, y_h, y_l, a_h, a_l, b_h,
                               b_l_pair, C, eps, proceed)
