@@ -56,6 +56,9 @@ from tpusvm.status import Status  # noqa: E402
 
 
 def run_size(n, Xs, Y, Xt, Yt, solver_opts, gamma):
+    q_eff = min(solver_opts["q"], n if n % 2 == 0 else n - 1) if n >= 2 else 2
+    engine = ("pallas" if jax.default_backend() == "tpu"
+              and q_eff % 128 == 0 else "xla")
     Xd = jax.device_put(jnp.asarray(Xs[:n]))
     Yd = jax.device_put(jnp.asarray(Y[:n]))
     traced = dict(C=10.0, gamma=gamma, eps=1e-12, tau=1e-5)
@@ -116,6 +119,17 @@ def run_size(n, Xs, Y, Xt, Yt, solver_opts, gamma):
         "n_sv": int(len(get_sv_indices(alpha))),
         "iterations": int(res.n_iter),
         "status": Status(int(res.status)).name,
+        # effective solver config, mirroring blocked_smo_solve's own
+        # resolution (q clamps to n; the pallas engine needs TPU + 128-lane
+        # alignment; wss=2 exists only in the pallas engine; selection=auto
+        # resolves by backend) — so a row can't silently claim a config it
+        # didn't run
+        "q": q_eff,
+        "inner_engine": engine,
+        "wss": solver_opts["wss"] if engine == "pallas" else 1,
+        "selection": ("approx" if jax.default_backend() == "tpu"
+                      else "exact") if solver_opts["selection"] == "auto"
+                     else solver_opts["selection"],
         "vs_gpu_train": round(GPU_TRAIN_S[n] / train_s, 2) if n in GPU_TRAIN_S else None,
         # SV-compacted serving path vs the reference's all-n GPU kernel:
         # includes an ~n/n_sv fewer-FLOPs factor on top of framework speed
@@ -137,6 +151,12 @@ def main(argv=None) -> int:
     ap.add_argument("--gamma", type=float, default=0.00125,
                     help="RBF width (reference MNIST value); scaled to ~1/d in --smoke")
     ap.add_argument("--max-inner", type=int, default=1024)
+    ap.add_argument("--wss", type=int, default=1, choices=(1, 2),
+                    help="inner partner selection (2 = second-order, "
+                    "pallas engine only — bench.py's tuned value)")
+    ap.add_argument("--selection", default="auto",
+                    choices=("auto", "exact", "approx"),
+                    help="outer working-set selection engine")
     args = ap.parse_args(argv)
 
     if args.smoke:
@@ -161,7 +181,8 @@ def main(argv=None) -> int:
 
     # q is clamped to n inside blocked_smo_solve
     solver_opts = dict(q=args.q, max_outer=5000, max_inner=args.max_inner,
-                       accum_dtype=jnp.float64)
+                       accum_dtype=jnp.float64, wss=args.wss,
+                       selection=args.selection)
     for n in args.sizes:
         log(f"--- n = {n} ---")
         emit(run_size(n, Xs, Y[:n_max], Xt, Yt, solver_opts, args.gamma))

@@ -26,3 +26,25 @@ def test_rbf_matvec_matches_dense():
     got = rbf_matvec(X, coef, 0.25, block=64)
     K = rbf_cross(X, X, 0.25)
     np.testing.assert_allclose(np.asarray(got), np.asarray(K @ coef), atol=1e-10)
+
+
+def test_rbf_cross_matvec_matches_dense():
+    """The blocked f-update contraction vs the dense kernel matvec, across
+    block geometries including the clamped-overlapping-tail reassembly
+    (n % block != 0), single-block (n <= block) and exact-fit cases."""
+    from tpusvm.ops import rbf_cross_matvec
+
+    rng = np.random.default_rng(2)
+
+    for n, block in [(257, 64), (256, 64), (63, 64), (64, 64), (1, 8),
+                     (130, 64)]:
+        X = jnp.asarray(rng.random((n, 9)), jnp.float32)
+        XB = jnp.asarray(rng.random((16, 9)), jnp.float32)
+        coef = jnp.asarray(rng.standard_normal(16), jnp.float32)
+        got = rbf_cross_matvec(X, XB, coef, 0.25, block=block)
+        want = rbf_cross(X, XB, 0.25) @ coef
+        assert got.shape == (n,)
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), atol=1e-5,
+            err_msg=f"n={n} block={block}",
+        )

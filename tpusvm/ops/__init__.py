@@ -1,5 +1,6 @@
 from tpusvm.ops.rbf import (
     rbf_cross,
+    rbf_cross_matvec,
     rbf_matvec,
     rbf_row,
     rbf_rows_at,
@@ -15,6 +16,7 @@ from tpusvm.ops.selection import (
 
 __all__ = [
     "rbf_cross",
+    "rbf_cross_matvec",
     "rbf_matvec",
     "rbf_row",
     "rbf_rows_at",
