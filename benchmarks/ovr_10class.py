@@ -38,6 +38,11 @@ def main(argv=None) -> int:
     ap.add_argument("--d", type=int, default=784)
     ap.add_argument("--gamma", type=float, default=0.00125)
     ap.add_argument("--solver", choices=["blocked", "pair"], default="blocked")
+    ap.add_argument("--q", type=int, default=1024)
+    ap.add_argument("--max-inner", type=int, default=1024)
+    ap.add_argument("--wss", type=int, default=1, choices=(1, 2))
+    ap.add_argument("--selection", default="auto",
+                    choices=("auto", "exact", "approx"))
     ap.add_argument("--smoke", action="store_true")
     args = ap.parse_args(argv)
     if args.smoke:
@@ -60,10 +65,19 @@ def main(argv=None) -> int:
     Xtr, ytr = X[: args.n], labels[: args.n]
     Xte, yte = X[args.n :], labels[args.n :]
 
+    solver_opts = {}
+    if args.solver == "blocked":
+        solver_opts = dict(q=args.q, max_inner=args.max_inner, wss=args.wss,
+                           selection=args.selection)
+    elif (args.q, args.max_inner, args.wss, args.selection) != \
+            (1024, 1024, 1, "auto"):
+        log("WARNING: --q/--max-inner/--wss/--selection are blocked-solver "
+            "knobs; --solver pair ignores them")
     model = OneVsRestSVC(
         config=SVMConfig(gamma=args.gamma),  # other constants = reference
         accum_dtype=jnp.float64,
         solver=args.solver,
+        solver_opts=solver_opts,
     )
     log("training 10 one-vs-rest SVMs...")
     # NOTE train_s comes from fit(), which times the whole training phase
@@ -86,6 +100,10 @@ def main(argv=None) -> int:
         "d": args.d,
         "classes": len(model.classes_),
         "solver": args.solver,
+        # requested blocked-solver knobs ({} for pair); the solver resolves
+        # wss/selection by backend and alignment at run time — see
+        # sweep_n.py's effective-config fields for the resolution rules
+        "solver_opts": solver_opts,
         "train_s": round(train_s, 3),
         "predict_s": round(predict_s, 3),
         "accuracy": round(float((yp == yte).mean()), 4),
