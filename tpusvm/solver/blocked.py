@@ -225,7 +225,13 @@ def blocked_smo_solve(
     SMO already makes freely; the Keerthi stopping decision stays on
     exact global min/max reductions, so the converged optimum and its
     certificate are unchanged. A missed violator is simply picked up in
-    a later round once it ranks higher.
+    a later round once it ranks higher. Progress per round is also
+    unaffected: the bucketed reduction loses an element only to a
+    BETTER one in its bucket (aggregate_to_topk then keeps the best
+    across buckets), so the extreme elements — the globally maximal
+    violating pair (b_high, b_low) — always survive selection, and any
+    round that would progress under exact selection progresses under
+    approx too (no spurious STALLED terminations).
 
     matmul_precision (static): MXU precision for the in-loop O(n*d*q)
     error-vector contraction — the solver's dominant cost. None keeps the
