@@ -118,3 +118,36 @@ def test_predict_parity():
         gamma=CFG.gamma,
     )
     np.testing.assert_array_equal(po, np.asarray(pj))
+
+
+@pytest.mark.parametrize("seed", [21, 22, 23, 24])
+def test_cross_engine_parity_random(seed):
+    """Every engine combination agrees with the f64 oracle on randomized
+    data: blocked/XLA (exact selection), blocked/pallas-interpret (wss=2,
+    approx selection). One shape across seeds so jit compiles once.
+
+    This is the breadth complement to the targeted cases above — the same
+    solution-level criterion (SV set, b) over varied geometry, exercising
+    the duplicate-pick dedup, shrinking, and approx-selection paths."""
+    from tpusvm.solver.blocked import blocked_smo_solve
+
+    cfg = SVMConfig(C=10.0, gamma=2.0)
+    Xs, Y = _data(blobs, n=128, d=6, seed=seed)
+    o = smo_train(Xs, Y, cfg)
+    assert o.status == Status.CONVERGED
+    sv_o = set(get_sv_indices(o.alpha))
+
+    common = dict(C=cfg.C, gamma=cfg.gamma, eps=cfg.eps, tau=cfg.tau,
+                  accum_dtype=jnp.float64)
+    r_x = blocked_smo_solve(jnp.asarray(Xs, jnp.float32), jnp.asarray(Y),
+                            q=64, max_inner=128, inner="xla",
+                            selection="exact", **common)
+    r_p = blocked_smo_solve(jnp.asarray(Xs, jnp.float32), jnp.asarray(Y),
+                            q=128, max_inner=256, inner="pallas", wss=2,
+                            selection="approx", **common)
+    for r in (r_x, r_p):
+        assert int(r.status) == Status.CONVERGED
+        sv = set(get_sv_indices(np.asarray(r.alpha)))
+        # f32 features vs the oracle's f64: tau-band boundary flips allowed
+        assert len(sv ^ sv_o) <= max(2, len(sv_o) // 25)
+        np.testing.assert_allclose(float(r.b), o.b, atol=2e-3)
