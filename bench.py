@@ -99,9 +99,12 @@ def main():
         # regression in the fused inner kernel must degrade the headline,
         # not lose it. The XLA inner engine is ~10x slower but always
         # compiles; the fallback is recorded loudly in the output.
-        fallback = f"{type(e).__name__}: {e}"
-        log(f"WARNING: tuned config failed to compile ({fallback}); "
-            "falling back to inner='xla', wss=1")
+        # first ~300 chars only: Mosaic failures embed whole IR dumps, and
+        # the output contract is ONE parseable JSON line (full text goes
+        # to stderr below)
+        fallback = f"{type(e).__name__}: {e}"[:300]
+        log(f"WARNING: tuned config failed to compile; falling back to "
+            f"inner='xla', wss=1. Full error:\n{type(e).__name__}: {e}")
         static_kwargs = dict(static_kwargs, inner="xla", wss=1)
         compiled = blocked_smo_solve.lower(
             Xd, Yd, **traced_kwargs, **static_kwargs

@@ -238,3 +238,28 @@ def test_blocked_rejects_bad_selection():
     Y = jnp.asarray([1, -1] * 8, jnp.int32)
     with pytest.raises(ValueError, match="selection must be"):
         blocked_smo_solve(X, Y, selection="topk")
+
+
+def test_blocked_fused_fupdate_same_optimum():
+    """The fused Pallas f-update (interpret off-TPU) reaches the same
+    optimum as the XLA contraction path."""
+    Xs, Y = _data(rings, n=256, seed=5)
+    kw = dict(C=10.0, gamma=10.0, tau=1e-5, q=64, max_inner=128,
+              accum_dtype=jnp.float64, selection="exact")
+    r0 = blocked_smo_solve(jnp.asarray(Xs, jnp.float32), jnp.asarray(Y), **kw)
+    r1 = blocked_smo_solve(jnp.asarray(Xs, jnp.float32), jnp.asarray(Y), **kw,
+                           fused_fupdate=True)
+    assert int(r0.status) == Status.CONVERGED
+    assert int(r1.status) == Status.CONVERGED
+    sv0 = set(np.flatnonzero(np.asarray(r0.alpha) > 1e-8))
+    sv1 = set(np.flatnonzero(np.asarray(r1.alpha) > 1e-8))
+    assert len(sv0 ^ sv1) <= max(2, len(sv0) // 25)
+    np.testing.assert_allclose(float(r1.b), float(r0.b), atol=1e-3)
+
+
+def test_blocked_fused_fupdate_rejects_reduced_precision():
+    X = jnp.zeros((16, 4), jnp.float32)
+    Y = jnp.asarray([1, -1] * 8, jnp.int32)
+    with pytest.raises(ValueError, match="fused_fupdate"):
+        blocked_smo_solve(X, Y, fused_fupdate=True,
+                          matmul_precision="default", refine=16)

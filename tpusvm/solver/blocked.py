@@ -147,7 +147,7 @@ def _inner_smo(K_BB, y_B, a_B, f_B, active_B, C, eps, tau, max_inner):
     jax.jit,
     static_argnames=("q", "max_outer", "max_inner", "warm_start",
                      "accum_dtype", "inner", "refine", "max_refines", "wss",
-                     "matmul_precision", "selection"),
+                     "matmul_precision", "selection", "fused_fupdate"),
 )
 def blocked_smo_solve(
     X: jax.Array,
@@ -171,6 +171,7 @@ def blocked_smo_solve(
     wss: int = 1,
     matmul_precision: Optional[str] = None,
     selection: str = "auto",
+    fused_fupdate: bool = False,
 ) -> SMOResult:
     """Train to the reference's stopping criterion with blocked working sets.
 
@@ -233,6 +234,16 @@ def blocked_smo_solve(
     round that would progress under exact selection progresses under
     approx too (no spurious STALLED terminations).
 
+    fused_fupdate (static, experimental): route the O(n*d*q) error-vector
+    contraction through the fused Pallas kernel
+    (ops/pallas/fused_fupdate.py) — distance matmul, exp, and coefficient
+    matvec in one VMEM pipeline, eliminating the (n, q) intermediate
+    slabs the XLA path materialises in HBM between its two matmuls. The
+    fused dot runs at precision=HIGHEST (the full-f32 trust-anchor tier);
+    combining with matmul_precision="default" (raw bf16) raises. Refine
+    reconstructions keep the XLA path either way (rare, off the hot
+    loop). Default off until measured faster on real hardware.
+
     matmul_precision (static): MXU precision for the in-loop O(n*d*q)
     error-vector contraction — the solver's dominant cost. None keeps the
     ops-layer default ("float32": full-f32-equivalent multi-pass MXU
@@ -270,6 +281,13 @@ def blocked_smo_solve(
         )
     if selection == "auto":
         selection = "approx" if jax.default_backend() == "tpu" else "exact"
+    if fused_fupdate and matmul_precision == "default":
+        raise ValueError(
+            "fused_fupdate runs the contraction at the full-f32 trust-"
+            "anchor tier (precision=HIGHEST) and cannot honour "
+            "matmul_precision='default' (raw bf16); use the XLA path for "
+            "reduced precision"
+        )
     if matmul_precision == "default" and (refine <= 0 or max_refines < 1):
         raise ValueError(
             "matmul_precision='default' (raw bf16 MXU passes) accumulates "
@@ -438,8 +456,18 @@ def blocked_smo_solve(
                 da_B = a_B_new - a_B
 
             dcoef = da_B * y_B.astype(adt)
-            df = rbf_cross_matvec(X, X_B, dcoef, gamma, sn,
-                                  precision=matmul_precision).astype(adt)
+            if fused_fupdate:
+                from tpusvm.ops.pallas.fused_fupdate import (
+                    rbf_cross_matvec_pallas,
+                )
+
+                df = rbf_cross_matvec_pallas(
+                    X, X_B, dcoef.astype(dtype), gamma, sn,
+                    interpret=jax.default_backend() != "tpu",
+                ).astype(adt)
+            else:
+                df = rbf_cross_matvec(X, X_B, dcoef, gamma, sn,
+                                      precision=matmul_precision).astype(adt)
             # .add, not .set: inactive duplicate rows carry a zero delta, so
             # double-indexed scatter stays correct
             return (alpha.at[B].add(da_B), f + df, upd, progress,
