@@ -1,7 +1,7 @@
 """One-shot probe: time the blocked solver at a given (q, max_inner, max_outer).
 
 Usage: python benchmarks/probe_split.py <q> <max_inner> <max_outer> \
-           [wss] [matmul_precision] [refine] [selection]
+           [wss] [matmul_precision] [refine] [selection] [fused]
 Prints one JSON line {q, max_inner, ..., n_sv, b, time_s}. One heavy
 measurement per process (axon runtime faults on repeats — see verify skill).
 """
@@ -27,6 +27,7 @@ wss = int(sys.argv[4]) if len(sys.argv) > 4 else 1
 precision = sys.argv[5] if len(sys.argv) > 5 else None
 refine = int(sys.argv[6]) if len(sys.argv) > 6 else 0
 selection = sys.argv[7] if len(sys.argv) > 7 else "auto"
+fused = len(sys.argv) > 8 and sys.argv[8] in ("1", "fused", "true")
 
 X, Y = mnist_like(n=60000, d=784, seed=0, noise=30, label_noise=0.005)
 Xs = MinMaxScaler().fit_transform(X)
@@ -39,6 +40,7 @@ solve = jax.jit(
         q=q, max_inner=max_inner, max_outer=max_outer, wss=wss,
         accum_dtype=jnp.float64, matmul_precision=precision,
         refine=refine, max_refines=4, selection=selection,
+        fused_fupdate=fused,
     )
 )
 lowered = solve.lower(Xd, Yd).compile()
@@ -53,7 +55,7 @@ t1 = time.perf_counter()
 n_sv = int((np.asarray(r.alpha) > 1e-8).sum())
 print(json.dumps({"q": q, "max_inner": max_inner, "wss": wss,
                   "precision": precision, "refine": refine,
-                  "selection": selection,
+                  "selection": selection, "fused": fused,
                   "outers": out[0], "updates": out[1], "status": out[2],
                   "n_sv": n_sv, "b": float(np.asarray(r.b)),
                   "time_s": round(t1 - t0, 4)}))

@@ -146,3 +146,20 @@ def test_compiled_multirow_layout_matches_xla():
     assert int(n_p) > 0
     np.testing.assert_allclose(np.asarray(a_p), np.asarray(a_x), atol=1e-3)
     assert abs(int(n_p) - int(n_x)) <= max(5, int(n_x) // 10)
+
+
+def test_compiled_fused_fupdate_matches_xla():
+    """Compiled-Mosaic fused f-update contraction vs the XLA path —
+    validates the MXU precision=HIGHEST distance dot and the VMEM-fused
+    exp/matvec epilogue on real hardware."""
+    from tpusvm.ops.pallas.fused_fupdate import rbf_cross_matvec_pallas
+    from tpusvm.ops.rbf import rbf_cross_matvec
+
+    rng = np.random.default_rng(7)
+    X = jnp.asarray(rng.random((1000, 784)), jnp.float32)
+    XB = jnp.asarray(rng.random((256, 784)), jnp.float32)
+    coef = jnp.asarray(rng.standard_normal(256), jnp.float32)
+    got = np.asarray(rbf_cross_matvec_pallas(X, XB, coef, 0.00125,
+                                             block=256, interpret=False))
+    want = np.asarray(rbf_cross_matvec(X, XB, coef, 0.00125))
+    np.testing.assert_allclose(got, want, atol=1e-4)

@@ -69,8 +69,13 @@ def rbf_cross_matvec_pallas(
 
     Drop-in for ops.rbf.rbf_cross_matvec at its default ("float32")
     precision. gamma may be traced (delivered to the kernel via SMEM).
-    X rows are processed in `block`-row grid steps; n is padded up to a
-    block multiple with zero rows whose outputs are dropped.
+    X rows are processed in `block`-row grid steps. n need not divide the
+    block: Pallas masks the out-of-bounds portion of the final block's
+    output write, and every output row depends only on its own input row,
+    so the unspecified out-of-bounds input lanes cannot contaminate real
+    rows — no padded copy of X is ever made (a per-call pad would re-read
+    and re-write all of X inside the solver's round body, giving back a
+    third of the HBM traffic this kernel exists to save).
     """
     from tpusvm.ops.rbf import sq_norms
 
@@ -82,9 +87,6 @@ def rbf_cross_matvec_pallas(
 
     block = min(block, max(n, 8))
     nb = -(-n // block)
-    pad = nb * block - n
-    Xp = jnp.pad(X.astype(jnp.float32), ((0, pad), (0, 0)))
-    snp = jnp.pad(sn.astype(jnp.float32), (0, pad))
 
     out = pl.pallas_call(
         _kernel,
@@ -100,14 +102,14 @@ def rbf_cross_matvec_pallas(
             pl.BlockSpec((q, 1), lambda i: (0, 0)),
         ],
         out_specs=pl.BlockSpec((block, 1), lambda i: (i, 0)),
-        out_shape=jax.ShapeDtypeStruct((nb * block, 1), jnp.float32),
+        out_shape=jax.ShapeDtypeStruct((n, 1), jnp.float32),
         interpret=interpret,
     )(
         jnp.asarray(gamma, jnp.float32).reshape(1),
-        Xp,
-        snp[:, None],
+        X.astype(jnp.float32),
+        sn.astype(jnp.float32)[:, None],
         XB.astype(jnp.float32).T,
         snB.astype(jnp.float32)[None, :],
         coef.astype(jnp.float32)[:, None],
     )
-    return out[:n, 0].astype(X.dtype)
+    return out[:, 0].astype(X.dtype)
