@@ -132,3 +132,27 @@ def test_inner_smo_rejects_bad_wss():
     with pytest.raises(ValueError, match="wss must be"):
         inner_smo_pallas(K, y, a0, f0, act, 10.0, 1e-12, 1e-5,
                          max_inner=64, interpret=True, wss=3)
+
+
+def test_inner_smo_layouts_bitwise_identical():
+    """The packed (q//128, 128) and flat (1, q) kernel layouts must follow
+    bitwise-identical trajectories — flat is the hardware-proven lowering
+    fallback, so any divergence would make a fallback silently change
+    results."""
+    K, y, a0, f0, act = _subproblem(q=256, seed=3)
+    for wss in (1, 2):
+        a_p, n_p, _, r_p = inner_smo_pallas(
+            K, y, a0, f0, act, 10.0, 1e-12, 1e-5, max_inner=300,
+            interpret=True, wss=wss, layout="packed")
+        a_f, n_f, _, r_f = inner_smo_pallas(
+            K, y, a0, f0, act, 10.0, 1e-12, 1e-5, max_inner=300,
+            interpret=True, wss=wss, layout="flat")
+        assert int(n_p) == int(n_f) and int(r_p) == int(r_f)
+        np.testing.assert_array_equal(np.asarray(a_p), np.asarray(a_f))
+
+
+def test_inner_smo_rejects_bad_layout():
+    K, y, a0, f0, act = _subproblem()
+    with pytest.raises(ValueError, match="layout must be"):
+        inner_smo_pallas(K, y, a0, f0, act, 10.0, 1e-12, 1e-5,
+                         max_inner=64, interpret=True, layout="ragged")

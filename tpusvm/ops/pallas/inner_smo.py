@@ -61,20 +61,21 @@ _NO_WS = int(Status.NO_WORKING_SET)
 _MAX_ITER = int(Status.MAX_ITER)
 
 
-def _make_kernel(q: int, max_inner: int, wss: int):
-    # Working vectors are laid out (R, LANE) rather than (1, q): a (1, q)
-    # f32 vector occupies q/LANE vregs but uses only 1 of 8 sublanes in
-    # each, so every elementwise op wastes 7/8 of VPU throughput. The
-    # (R, LANE) row-major layout packs the same q lanes into ceil(R/8)
-    # full vregs; the global index of element (r, c) is r*LANE + c, which
-    # preserves the (1, q) ordering, so first-occurrence tie-breaks (and
-    # hence the whole iteration trajectory) are unchanged.
-    R = q // LANE
+def _make_kernel(q: int, max_inner: int, wss: int, R: int, L: int):
+    # Working vectors are laid out (R, L): the "packed" layout uses
+    # (q//128, 128) so a vector occupies full 8-sublane vregs instead of
+    # 1 of 8 as the original "flat" (1, q) layout did — every elementwise
+    # op stops wasting 7/8 of VPU throughput. The row-major layout keeps
+    # the global index of element (r, c) at r*L + c, preserving the
+    # (1, q) ordering, so first-occurrence tie-breaks (and hence the
+    # whole iteration trajectory) are identical between layouts. The
+    # flat layout (R=1, L=q) is retained as the fallback lowering proven
+    # on hardware in round 1.
 
     def kernel(scal_ref, K_ref, diag_ref, y_ref, a0_ref, f0_ref, act_ref,
                diag_s_ref, y_s_ref, a0_s_ref, aout_ref, stat_ref, a_s_ref):
-        iota = (lax.broadcasted_iota(jnp.int32, (R, LANE), 0) * LANE
-                + lax.broadcasted_iota(jnp.int32, (R, LANE), 1))
+        iota = (lax.broadcasted_iota(jnp.int32, (R, L), 0) * L
+                + lax.broadcasted_iota(jnp.int32, (R, L), 1))
 
         def pick(v, i):
             """v at global index i for a traced scalar i, as a masked
@@ -139,7 +140,7 @@ def _make_kernel(q: int, max_inner: int, wss: int):
             # clamp so the row loads stay in bounds when not found (i == q)
             i_h = jnp.minimum(i_h, jnp.int32(q - 1))
 
-            row_h = K_ref[pl.ds(i_h, 1)].reshape(R, LANE)
+            row_h = K_ref[pl.ds(i_h, 1)].reshape(R, L)
             K11 = diag_s_ref[i_h]
 
             if wss == 2:
@@ -162,7 +163,7 @@ def _make_kernel(q: int, max_inner: int, wss: int):
                 # is used only for in-bounds loads and zero-delta stores
                 i_l = jnp.minimum(i_l2, jnp.int32(q - 1))
 
-            row_l = K_ref[pl.ds(i_l, 1)].reshape(R, LANE)
+            row_l = K_ref[pl.ds(i_l, 1)].reshape(R, L)
             K22 = diag_s_ref[i_l]
             K12 = pick(row_h, i_l)   # row_h is in vector registers
             y_h = y_s_ref[i_h]
@@ -241,9 +242,12 @@ def _make_kernel(q: int, max_inner: int, wss: int):
     return kernel
 
 
-@functools.partial(jax.jit, static_argnames=("max_inner", "interpret", "wss"))
+@functools.partial(
+    jax.jit, static_argnames=("max_inner", "interpret", "wss", "layout")
+)
 def inner_smo_pallas(K_BB, y_B, a_B, f_B, active_B, C, eps, tau, *,
-                     max_inner: int, interpret: bool = False, wss: int = 1):
+                     max_inner: int, interpret: bool = False, wss: int = 1,
+                     layout: str = "packed"):
     """Run the inner working-set SMO subproblem as one fused TPU kernel.
 
     Same contract as solver/blocked.py `_inner_smo`: returns
@@ -257,10 +261,14 @@ def inner_smo_pallas(K_BB, y_B, a_B, f_B, active_B, C, eps, tau, *,
     """
     if wss not in (1, 2):
         raise ValueError(f"wss must be 1 or 2, got {wss}")
+    if layout not in ("packed", "flat"):
+        raise ValueError(f"layout must be packed|flat, got {layout!r}")
     q = y_B.shape[0]
     if q % LANE:
         raise ValueError(f"inner_smo_pallas needs q % {LANE} == 0, got {q}")
-    R = q // LANE
+    # packed = full-vreg sublane utilisation; flat = the (1, q) layout
+    # proven on hardware in round 1 (kept as a lowering fallback)
+    R, L = (q // LANE, LANE) if layout == "packed" else (1, q)
     scal = jnp.stack([
         jnp.asarray(C, jnp.float32),
         jnp.asarray(eps, jnp.float32),
@@ -271,7 +279,7 @@ def inner_smo_pallas(K_BB, y_B, a_B, f_B, active_B, C, eps, tau, *,
     y32 = y_B.astype(jnp.float32)
     a32 = a_B.astype(jnp.float32)
     aout, stat = pl.pallas_call(
-        _make_kernel(q, max_inner, wss),
+        _make_kernel(q, max_inner, wss, R, L),
         in_specs=[
             pl.BlockSpec(memory_space=pltpu.SMEM),
             pl.BlockSpec(memory_space=pltpu.VMEM),
@@ -291,19 +299,19 @@ def inner_smo_pallas(K_BB, y_B, a_B, f_B, active_B, C, eps, tau, *,
             pl.BlockSpec(memory_space=pltpu.SMEM),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((R, LANE), jnp.float32),
+            jax.ShapeDtypeStruct((R, L), jnp.float32),
             jax.ShapeDtypeStruct((3,), jnp.int32),
         ],
         scratch_shapes=[pltpu.SMEM((q,), jnp.float32)],  # alpha mirror
         interpret=interpret,
     )(
         scal,
-        K32.reshape(q, R, LANE),
-        diag32.reshape(R, LANE),
-        y32.reshape(R, LANE),
-        a32.reshape(R, LANE),
-        f_B.astype(jnp.float32).reshape(R, LANE),
-        active_B.astype(jnp.float32).reshape(R, LANE),
+        K32.reshape(q, R, L),
+        diag32.reshape(R, L),
+        y32.reshape(R, L),
+        a32.reshape(R, L),
+        f_B.astype(jnp.float32).reshape(R, L),
+        active_B.astype(jnp.float32).reshape(R, L),
         diag32,
         y32,
         a32,

@@ -147,7 +147,8 @@ def _inner_smo(K_BB, y_B, a_B, f_B, active_B, C, eps, tau, max_inner):
     jax.jit,
     static_argnames=("q", "max_outer", "max_inner", "warm_start",
                      "accum_dtype", "inner", "refine", "max_refines", "wss",
-                     "matmul_precision", "selection", "fused_fupdate"),
+                     "matmul_precision", "selection", "fused_fupdate",
+                     "pallas_layout"),
 )
 def blocked_smo_solve(
     X: jax.Array,
@@ -172,6 +173,7 @@ def blocked_smo_solve(
     matmul_precision: Optional[str] = None,
     selection: str = "auto",
     fused_fupdate: bool = False,
+    pallas_layout: str = "packed",
 ) -> SMOResult:
     """Train to the reference's stopping criterion with blocked working sets.
 
@@ -244,6 +246,11 @@ def blocked_smo_solve(
     reconstructions keep the XLA path either way (rare, off the hot
     loop). Default off until measured faster on real hardware.
 
+    pallas_layout (static): vector layout inside the fused inner kernel —
+    "packed" = sublane-packed (q//128, 128) full-vreg layout, "flat" =
+    the (1, q) layout proven on hardware in round 1. Trajectories are
+    bitwise identical; flat exists as a lowering fallback.
+
     matmul_precision (static): MXU precision for the in-loop O(n*d*q)
     error-vector contraction — the solver's dominant cost. None keeps the
     ops-layer default ("float32": full-f32-equivalent multi-pass MXU
@@ -281,6 +288,10 @@ def blocked_smo_solve(
         )
     if selection == "auto":
         selection = "approx" if jax.default_backend() == "tpu" else "exact"
+    if pallas_layout not in ("packed", "flat"):
+        raise ValueError(
+            f"pallas_layout must be packed|flat, got {pallas_layout!r}"
+        )
     if fused_fupdate and matmul_precision == "default":
         raise ValueError(
             "fused_fupdate runs the contraction at the full-f32 trust-"
@@ -422,7 +433,7 @@ def blocked_smo_solve(
                     K_BB, y_B, a_B, f_B, active_B, C, eps, tau,
                     max_inner=max_inner,
                     interpret=jax.default_backend() != "tpu",
-                    wss=wss,
+                    wss=wss, layout=pallas_layout,
                 )
                 da_B = a_B_new - a_B_q
                 # f32 rescue hatch: if the fused kernel's float32 subproblem
