@@ -227,3 +227,25 @@ def test_label_sorted_data_raises_not_nan():
             CascadeConfig(n_shards=2, sv_capacity=64, topology="star"),
             dtype=jnp.float64,
         )
+
+
+@pytest.mark.parametrize("seed", [31, 32, 33])
+def test_cascade_randomized_geometry_recovers_oracle(seed):
+    """Breadth: random blob geometry through both topologies must land on
+    the oracle's SV-ID fixed point (the reference's every-P parity claim,
+    README.md:35-38), complementing the targeted rings cases above."""
+    cfg = SVMConfig(C=10.0, gamma=2.0)
+    X, Y = blobs(n=256, d=6, seed=seed)
+    Xs = MinMaxScaler().fit_transform(X)
+    o = smo_train(Xs, Y, cfg)
+    sv_o = set(get_sv_indices(o.alpha).tolist())
+    for topology, n_shards in (("tree", 4), ("star", 5)):
+        res = cascade_fit(
+            Xs, Y, cfg,
+            CascadeConfig(n_shards=n_shards, sv_capacity=192,
+                          topology=topology),
+            dtype=jnp.float64,
+        )
+        assert res.converged, (topology, seed)
+        assert set(res.sv_ids.tolist()) == sv_o, (topology, seed)
+        np.testing.assert_allclose(res.b, o.b, atol=1e-4)

@@ -111,7 +111,8 @@ def _build_parser() -> argparse.ArgumentParser:
         "--solver-opt", action="append", default=[], metavar="KEY=VALUE",
         help="extra static solver knob, repeatable (blocked solver: q, "
         "max_outer, max_inner, wss, refine, max_refines, inner, "
-        "matmul_precision, selection — e.g. --solver-opt q=2048 "
+        "matmul_precision, selection, fused_fupdate, pallas_layout — "
+        "e.g. --solver-opt q=2048 "
         "--solver-opt matmul_precision=default --solver-opt refine=4096); "
         "integer values are auto-converted")
     mode.add_argument(
