@@ -1,0 +1,69 @@
+"""Driver entry-point contract tests.
+
+The round driver compile-checks `entry()` single-chip and executes
+`dryrun_multichip(N)` on N virtual CPU devices. Pin both contracts in the
+suite so a regression is caught by pytest rather than by the unattended
+driver run. The dryrun spawns a fresh interpreter when backends are
+already initialised (as they are under pytest), which exercises the same
+self-provisioning path the driver hits.
+"""
+
+import os
+import subprocess
+import sys
+
+import jax
+import numpy as np
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+
+def test_entry_compiles_and_runs():
+    from __graft_entry__ import entry
+
+    fn, args = entry()
+    out = jax.jit(fn)(*args)
+    scores = np.asarray(out)
+    assert scores.shape == (args[0].shape[0],)
+    assert np.isfinite(scores).all()
+
+
+def test_dryrun_multichip_direct_provisioning():
+    # fresh interpreter, backends untouched: the dryrun provisions the
+    # virtual CPU mesh directly
+    code = (
+        f"import sys; sys.path.insert(0, {_REPO!r}); "
+        "from __graft_entry__ import dryrun_multichip; "
+        "dryrun_multichip(4)"
+    )
+    r = subprocess.run(
+        [sys.executable, "-c", code], capture_output=True, text=True,
+        timeout=300,
+    )
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "dryrun_multichip OK" in r.stdout
+
+
+def test_dryrun_multichip_after_backend_init():
+    # the driver runs entry() FIRST, so dryrun_multichip sees initialised
+    # backends with too few devices and must take its fresh-interpreter
+    # retry branch — initialise backends in the child (with XLA_FLAGS
+    # stripped so only 1 CPU device exists) to force exactly that path
+    code = (
+        f"import sys; sys.path.insert(0, {_REPO!r}); "
+        # select CPU via jax.config BEFORE backend init (the env var alone
+        # does not beat the force-registered accelerator plugin), then
+        # initialise backends so dryrun sees them already up
+        "import jax; jax.config.update('jax_platforms', 'cpu'); "
+        "assert len(jax.devices()) < 4; "
+        "from __graft_entry__ import dryrun_multichip; "
+        "dryrun_multichip(4)"
+    )
+    env = {k: v for k, v in os.environ.items() if k != "XLA_FLAGS"}
+    r = subprocess.run(
+        [sys.executable, "-c", code], capture_output=True, text=True,
+        env=env, timeout=300,
+    )
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "dryrun_multichip OK" in r.stdout
