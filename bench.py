@@ -87,9 +87,86 @@ def main():
     # (tpusvm/solver/blocked.py matmul_precision).
     static_kwargs = dict(q=2048, max_outer=5000, max_inner=4096, wss=2,
                          accum_dtype=jnp.float64)
+    on_tpu = jax.devices()[0].platform == "tpu"
+    # Tiny-shape kernel canary BEFORE the heavy compile (TPU only — off
+    # TPU the solver's inner='auto' resolves to the XLA engine and the
+    # canary could not affect the run): a Mosaic regression that compiles
+    # but miscomputes or faults at runtime would otherwise burn the
+    # unattended round's one heavy measurement. Each layout runs a q=128
+    # subproblem twice — wss=1 checked against the XLA inner loop's
+    # trajectory, and wss=2 (the mode the benchmark actually runs)
+    # checked against the subproblem invariants (box feasibility,
+    # sum(y*a)=0 conservation, dual ascent) since its trajectory
+    # legitimately differs. First layout passing both is used; none
+    # passing degrades to the XLA engine. The compile-failure chain below
+    # stays as the backstop for the full-size q=2048 lowering.
+    fallback = None
+    # off TPU the solver's inner='auto' resolves to the XLA engine
+    engine = "pallas-packed" if on_tpu else "xla"
+    if on_tpu:
+        try:
+            from tpusvm.ops.pallas.inner_smo import inner_smo_pallas
+            from tpusvm.ops.rbf import rbf_cross
+            from tpusvm.solver.blocked import _inner_smo
+
+            rngc = np.random.default_rng(0)
+            Xc = jnp.asarray(rngc.random((128, 8)), jnp.float32)
+            yc_np = np.where(rngc.random(128) < 0.5, 1, -1)
+            yc = jnp.asarray(yc_np, jnp.int32)
+            Kc = rbf_cross(Xc, Xc, 0.5)
+            a0c = jnp.zeros(128, jnp.float32)
+            f0c = -yc.astype(jnp.float32)
+            actc = jnp.ones(128, bool)
+            a_ref = np.asarray(_inner_smo(Kc, yc, a0c, f0c, actc, 10.0,
+                                          1e-12, 1e-5, 64)[0])
+            Qc = np.asarray(Kc) * np.outer(yc_np, yc_np)
+            picked = None
+            for layout in ("packed", "flat"):
+                try:
+                    a_k = np.asarray(inner_smo_pallas(
+                        Kc, yc, a0c, f0c, actc, 10.0, 1e-12, 1e-5,
+                        max_inner=64, interpret=False, wss=1,
+                        layout=layout,
+                    )[0])
+                    np.testing.assert_allclose(a_k, a_ref, atol=1e-3)
+                    a_k2 = np.asarray(inner_smo_pallas(
+                        Kc, yc, a0c, f0c, actc, 10.0, 1e-12, 1e-5,
+                        max_inner=64, interpret=False, wss=2,
+                        layout=layout,
+                    )[0])
+                    assert np.isfinite(a_k2).all()
+                    assert (a_k2 >= -1e-6).all() and (a_k2 <= 10.0 + 1e-6).all()
+                    assert abs(float(a_k2 @ yc_np)) < 1e-3
+                    assert a_k2.sum() - 0.5 * a_k2 @ Qc @ a_k2 > 0.0
+                    picked = layout
+                    break
+                except Exception as ce:  # noqa: BLE001 — any canary failure
+                    msg = f"{type(ce).__name__}: {ce}"[:300]
+                    log(f"WARNING: {layout}-layout kernel canary failed: "
+                        f"{msg}")
+                    fallback = (fallback + " | " if fallback else "") + \
+                        f"{layout} canary: {msg}"
+            if picked is None:
+                log("WARNING: no kernel layout passed the canary; using "
+                    "the XLA inner engine")
+                static_kwargs = dict(static_kwargs, inner="xla", wss=1)
+                engine = "xla"
+            elif picked != "packed":
+                static_kwargs = dict(static_kwargs, pallas_layout=picked)
+                engine = f"pallas-{picked}"
+        except Exception as ce:  # noqa: BLE001 — canary harness broke
+            log(f"WARNING: kernel canary harness failed; proceeding with "
+                f"the tuned config unvetted. Full error:\n"
+                f"{type(ce).__name__}: {ce}")
+            fallback = ("canary harness failed (kernel unvetted): "
+                        + f"{type(ce).__name__}: {ce}"[:300])
+
+    class _AlreadyFailed(Exception):
+        """Sentinel: the canary-selected flat layout failed at full size;
+        retrying it would recompile the identical failing config."""
+
     log("compiling solver (AOT)...")
     t0 = time.perf_counter()
-    fallback = None
     try:
         compiled = blocked_smo_solve.lower(
             Xd, Yd, **traced_kwargs, **static_kwargs
@@ -99,28 +176,36 @@ def main():
         # regression must degrade the headline, not lose it. Chain:
         # packed-layout kernel (tuned) -> flat-layout kernel (the round-1
         # hardware-proven lowering) -> XLA inner engine (always compiles,
-        # ~10x slower). The fallback taken is recorded loudly in the
-        # output (first ~300 chars only — Mosaic failures embed whole IR
-        # dumps, and the output contract is ONE parseable JSON line; the
-        # full text goes to stderr).
-        fallback = f"{type(e).__name__}: {e}"[:300]
-        log(f"WARNING: tuned config failed to compile; trying the flat-"
-            f"layout kernel. Full error:\n{type(e).__name__}: {e}")
+        # ~10x slower). The JSON record gets each failure truncated to
+        # ~300 chars (Mosaic failures embed whole IR dumps and the output
+        # contract is ONE parseable JSON line); the FULL text of every
+        # failure goes to stderr here.
+        e_full = f"{type(e).__name__}: {e}"
+        fallback = (fallback + " | " if fallback else "") + e_full[:300]
+        log(f"WARNING: the {engine} config failed to compile at full "
+            f"size. Full error:\n{e_full}")
+        if engine == "xla":
+            # the always-compilable engine itself failed: nothing lower
+            # to fall to — surface the error rather than loop
+            raise
         try:
+            if engine == "pallas-flat":
+                raise _AlreadyFailed from e
+            log("WARNING: trying the flat-layout kernel")
             static_kwargs = dict(static_kwargs, pallas_layout="flat")
             compiled = blocked_smo_solve.lower(
                 Xd, Yd, **traced_kwargs, **static_kwargs
             ).compile()
-            fallback = "flat-layout kernel after: " + fallback
+            engine = "pallas-flat"
         except Exception as e2:  # noqa: BLE001
-            log(f"WARNING: flat-layout kernel also failed "
-                f"({type(e2).__name__}); falling back to inner='xla', "
-                f"wss=1. Full error:\n{type(e2).__name__}: {e2}")
-            # truncate each component separately so the flat-kernel
-            # failure survives into the record
-            e2_txt = f"{type(e2).__name__}: {e2}"[:300]
-            fallback = f"xla engine after: {fallback} | {e2_txt}"
+            if not isinstance(e2, _AlreadyFailed):
+                e2_full = f"{type(e2).__name__}: {e2}"
+                log(f"WARNING: flat-layout kernel also failed. Full "
+                    f"error:\n{e2_full}")
+                fallback = f"{fallback} | {e2_full[:300]}"
+            log("WARNING: falling back to inner='xla', wss=1")
             static_kwargs = dict(static_kwargs, inner="xla", wss=1)
+            engine = "xla"
             compiled = blocked_smo_solve.lower(
                 Xd, Yd, **traced_kwargs, **static_kwargs
             ).compile()
@@ -165,7 +250,6 @@ def main():
     hbm_gbps = hbm_bytes / train_s / 1e9
     # the 819 GB/s roofline is v5e-specific: report the fraction only when
     # actually running on a TPU so non-TPU result files aren't misleading
-    on_tpu = jax.devices()[0].platform == "tpu"
     peak_note = (
         f" ({hbm_gbps / V5E_PEAK_HBM_GBPS:.0%} of v5e peak)" if on_tpu else ""
     )
@@ -198,9 +282,11 @@ def main():
                         hbm_gbps / V5E_PEAK_HBM_GBPS, 3
                     ) if on_tpu else None,
                     "platform": jax.devices()[0].platform,
-                    # non-null ONLY if the tuned config failed to compile;
-                    # says which fallback ran (flat-layout kernel, or the
-                    # XLA inner engine) and why
+                    # which inner engine actually ran: "pallas-packed"
+                    # (the tuned config), "pallas-flat", or "xla"
+                    "engine": engine,
+                    # non-null if any canary or compile fallback fired;
+                    # records each failure (separately truncated)
                     "compile_fallback": fallback,
                 },
             }
