@@ -91,8 +91,8 @@ def _make_kernel(q: int, max_inner: int, wss: int, R: int, L: int):
         C = scal_ref[0]
         eps = scal_ref[1]
         tau = scal_ref[2]
-        y = y_ref[:]                      # (R, LANE) float32, +/-1 (0 on pads)
-        diag = diag_ref[:]                # (R, LANE) K_BB diagonal
+        y = y_ref[:]                      # (R, L) float32, +/-1 (0 on pads)
+        diag = diag_ref[:]                # (R, L) K_BB diagonal
         pos = y > 0.0
 
         # SMEM alpha mirror: scalar reads (a[i_h], a[i_l]) and the two
