@@ -1,0 +1,119 @@
+"""bench.py headline-protection machinery, exercised with synthetic faults.
+
+The unattended round-end benchmark must never lose the headline to a
+kernel regression: a tiny-shape runtime canary picks a working kernel
+layout before the heavy compile, and a compile-failure chain degrades
+packed -> flat -> XLA engine. These tests drive bench.main() end-to-end
+on a shrunken workload with the kernel monkeypatched to fail in each
+way, asserting the emitted record says which engine ran and why.
+
+The TPU-only canary branch is exercised by faking the device platform;
+kernel calls are redirected to interpret mode (true math, no Mosaic).
+"""
+
+import contextlib
+import io
+import json
+import sys
+
+import numpy as np
+import pytest
+
+import tpusvm.ops.pallas.inner_smo as ism
+
+
+@pytest.fixture()
+def bench_mod(monkeypatch):
+    import bench
+
+    real_mnist = bench.mnist_like
+    monkeypatch.setattr(
+        bench, "mnist_like",
+        lambda **kw: real_mnist(n=512, d=32, noise=3.0, label_noise=0.005),
+    )
+    yield bench
+
+
+def _run(bench):
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        bench.main()
+    rec = json.loads(buf.getvalue().strip().splitlines()[-1])
+    assert np.isfinite(rec["value"])
+    return rec["detail"]
+
+
+class _FakeTPU:
+    platform = "tpu"
+
+    def __repr__(self):
+        return "FakeTPU"
+
+
+@pytest.fixture()
+def fake_tpu(monkeypatch, bench_mod):
+    real_devices = bench_mod.jax.devices
+
+    def devices(*args, **kw):
+        return [_FakeTPU()] if not args else real_devices(*args, **kw)
+
+    monkeypatch.setattr(bench_mod.jax, "devices", devices)
+    # the canary calls the kernel with interpret=False (real platform
+    # assumed); redirect to interpret mode since the actual backend is CPU
+    orig = ism.inner_smo_pallas
+
+    def interp_kernel(*a, **kw):
+        kw["interpret"] = True
+        return orig(*a, **kw)
+
+    monkeypatch.setattr(ism, "inner_smo_pallas", interp_kernel)
+    return orig
+
+
+@pytest.mark.filterwarnings(
+    # off TPU, bench's tuned wss=2 degrades to first-order on the XLA
+    # engine with this warning — the documented off-TPU behaviour
+    "ignore:wss=2 requested:RuntimeWarning"
+)
+def test_bench_plain_cpu_uses_xla_engine(bench_mod):
+    d = _run(bench_mod)
+    assert d["engine"] == "xla"
+    assert d["compile_fallback"] is None
+
+
+@pytest.mark.filterwarnings(
+    # the faked TPU platform makes the canary run while the real backend
+    # is CPU, so the heavy solve's inner='auto' resolves to the XLA
+    # engine and the requested wss=2 legitimately degrades with this
+    # warning — expected for this fault-injection setup only
+    "ignore:wss=2 requested:RuntimeWarning"
+)
+def test_bench_canary_packed_fault_selects_flat(bench_mod, fake_tpu,
+                                                monkeypatch):
+    orig = fake_tpu
+
+    def broken_packed(*a, **kw):
+        if kw.get("layout", "packed") == "packed":
+            raise RuntimeError("synthetic packed runtime fault")
+        kw["interpret"] = True
+        return orig(*a, **kw)
+
+    monkeypatch.setattr(ism, "inner_smo_pallas", broken_packed)
+    d = _run(bench_mod)
+    assert d["engine"] == "pallas-flat"
+    assert "packed canary" in d["compile_fallback"]
+
+
+@pytest.mark.filterwarnings(
+    "ignore:wss=2 requested:RuntimeWarning"  # see sibling test
+)
+def test_bench_canary_total_fault_degrades_to_xla(bench_mod, fake_tpu,
+                                                  monkeypatch):
+    def broken_all(*a, **kw):
+        raise RuntimeError("synthetic kernel runtime fault")
+
+    monkeypatch.setattr(ism, "inner_smo_pallas", broken_all)
+    d = _run(bench_mod)
+    assert d["engine"] == "xla"
+    assert "packed canary" in d["compile_fallback"]
+    assert "flat canary" in d["compile_fallback"]
