@@ -14,7 +14,6 @@ kernel calls are redirected to interpret mode (true math, no Mosaic).
 import contextlib
 import io
 import json
-import sys
 
 import numpy as np
 import pytest
@@ -58,16 +57,11 @@ def fake_tpu(monkeypatch, bench_mod):
         return [_FakeTPU()] if not args else real_devices(*args, **kw)
 
     monkeypatch.setattr(bench_mod.jax, "devices", devices)
-    # the canary calls the kernel with interpret=False (real platform
-    # assumed); redirect to interpret mode since the actual backend is CPU
-    orig = ism.inner_smo_pallas
-
-    def interp_kernel(*a, **kw):
-        kw["interpret"] = True
-        return orig(*a, **kw)
-
-    monkeypatch.setattr(ism, "inner_smo_pallas", interp_kernel)
-    return orig
+    # consumers monkeypatch ism.inner_smo_pallas themselves (their
+    # fault-injecting wrappers redirect surviving calls to interpret
+    # mode, since the canary assumes a real TPU and passes
+    # interpret=False while the actual backend is CPU)
+    return ism.inner_smo_pallas
 
 
 @pytest.mark.filterwarnings(
