@@ -10,10 +10,18 @@ to the reference's exact stopping criterion with the blocked working-set
 solver (tpusvm.solver.blocked — the TPU-first redesign whose FLOPs ride
 the MXU). Real MNIST CSVs are not available in this
 environment (zero egress), so the workload is a deterministic synthetic
-MNIST-shaped problem (tpusvm.data.mnist_like, noise=30, label_noise=0.005)
-tuned to the same difficulty band as real MNIST: ~57k SMO iterations and
-~2000 support vectors (vs. the reference's 1548 SVs; its iteration count is
-unpublished).
+MNIST-shaped problem (tpusvm.data.mnist_like, noise=30, label_noise=0.005).
+
+Workload recipe: DELIBERATELY FROZEN at the round-1 recipe (noise=30,
+label_noise=0.005) so the headline number stays comparable across rounds —
+every BENCH_r*.json measures the identical optimisation problem. The frozen
+recipe matches real MNIST's difficulty in the dimensions this benchmark
+measures — solver work (~57k SMO iterations, ~27 outer rounds) and model
+size (~2000 SVs vs the reference's 1548) — but NOT held-out accuracy, which
+the label flips pin at ~0.993 regardless of n (and which this benchmark does
+not measure or report). Runs where the accuracy column carries information
+(benchmarks/sweep_n.py) use the calibrated recipe instead
+(tpusvm.data.synthetic.BENCH_NOISE = 330, no label flips — see its comment).
 
 Baseline: the reference's GPU SMO trains MNIST-60k in 58.570 s on one GPU
 (report Table 1, BASELINE.md B2; 56.09x over its 3285.662 s serial run).
@@ -37,12 +45,25 @@ Measurement notes:
 """
 
 import json
+import os
+import subprocess
 import sys
 import time
 
 import jax
 
 jax.config.update("jax_enable_x64", True)
+
+# Set by _reexec_cpu on the fallback child: pin the CPU backend BEFORE any
+# backend initialises. The env-var JAX_PLATFORMS route does NOT work here —
+# this environment's sitecustomize registers the accelerator plugin at
+# interpreter startup and programmatically sets jax_platforms, overriding
+# the env var; only a later jax.config.update wins (same mechanism as
+# tests/conftest.py and __graft_entry__.py self-provisioning).
+_FORCE_CPU_ENV = "_TPUSVM_BENCH_FORCE_CPU"
+_INIT_ERR_ENV = "_TPUSVM_BENCH_INIT_ERROR"
+if os.environ.get(_FORCE_CPU_ENV) == "1":
+    jax.config.update("jax_platforms", "cpu")
 
 import jax.numpy as jnp  # noqa: E402
 import numpy as np  # noqa: E402
@@ -61,10 +82,190 @@ def log(msg):
     print(msg, file=sys.stderr, flush=True)
 
 
+# Backend-init insurance. Round 2's headline was LOST to exactly this:
+# the TPU backend was unavailable when the driver ran bench.py, jax.devices()
+# raised at the first line of main(), and rc=1 left NO json record — while
+# every later failure mode (kernel canary, compile fallback) was covered.
+# The observed init-failure modes are BOTH a fast raise (BENCH_r02.json:
+# "UNAVAILABLE: TPU backend setup/compile error") and an indefinite HANG
+# (a wedged TPU tunnel blocks xla_bridge.backends() without returning), so
+# an in-process try/except is not enough: the probe runs in a SUBPROCESS
+# with a timeout, and on failure/timeout/raise bench re-execs itself on the
+# CPU backend with the init error recorded in the json detail. The
+# reference's timing contract always reports (gpu_svm_main3.cu:516-694);
+# a wedged accelerator must yield a degraded record, not nothing.
+_PROBE_TIMEOUT_S = 240.0
+# supervised accelerator child: generous bound on the WHOLE measurement
+# (datagen ~1min + compile ~40s + train ~1s on the round-1 TPU capture,
+# plus tunnel slack) — a post-probe wedge costs this long, then degrades
+_ACCEL_TIMEOUT_S = 1800.0
+_ACCEL_CHILD_ENV = "_TPUSVM_BENCH_ACCEL_CHILD"
+
+
+def _has_record(out):
+    """True if some stdout line is a benchmark record (a JSON object with
+    a metric field — not just any parseable JSON, so a stray numeric line
+    can't count as one)."""
+    for line in (out or "").strip().splitlines():
+        try:
+            rec = json.loads(line)
+        except ValueError:
+            continue
+        if isinstance(rec, dict) and "metric" in rec:
+            return True
+    return False
+
+
+def _probe_backend():
+    """Initialise the default JAX backend in a throwaway subprocess.
+
+    Returns None when init succeeds, else a short string saying why not
+    (raise or hang). Run before the parent process touches jax.devices()
+    so a hanging init cannot wedge the benchmark itself.
+    """
+    code = "import jax; print(jax.devices()[0].platform)"
+    try:
+        p = subprocess.run(
+            [sys.executable, "-c", code],
+            capture_output=True, text=True, timeout=_PROBE_TIMEOUT_S,
+        )
+    except subprocess.TimeoutExpired:
+        return (f"backend init did not complete within "
+                f"{_PROBE_TIMEOUT_S:.0f}s (hang)")
+    if p.returncode != 0:
+        tail = (p.stderr or "").strip().splitlines()
+        detail = tail[-1] if tail else f"rc={p.returncode}"
+        return f"backend init failed: {detail}"[:300]
+    return None
+
+
+def _reexec_cpu(err):
+    """Re-run this benchmark on the CPU backend, recording why. Exits.
+
+    The child gets the CPU pin via _FORCE_CPU_ENV (config-update route, see
+    top of file) and the init error via _INIT_ERR_ENV so the record it
+    emits says the accelerator was unusable. If even the child produces no
+    json line, emit a last-resort record here — under no circumstances may
+    the driver see a run with no parseable record.
+    """
+    log(f"WARNING: accelerator backend unusable; re-running on CPU. ({err})")
+    env = {**os.environ, _FORCE_CPU_ENV: "1", _INIT_ERR_ENV: err}
+    try:
+        p = subprocess.run(
+            [sys.executable, os.path.abspath(__file__)],
+            env=env, stdout=subprocess.PIPE, text=True, timeout=5400,
+        )
+        out, rc = p.stdout or "", p.returncode
+    except subprocess.TimeoutExpired as te:
+        out, rc = (te.stdout.decode() if isinstance(te.stdout, bytes)
+                   else te.stdout) or "", -1
+    sys.stdout.write(out)
+    sys.stdout.flush()
+    if not _has_record(out):
+        print(json.dumps({
+            "metric": "mnist60k_smo_train_time",
+            "value": None,
+            "unit": "s",
+            "vs_baseline": None,
+            "detail": {
+                "error": "no backend produced a measurement",
+                "init_fallback": err,
+                "cpu_child_rc": rc,
+            },
+        }))
+    sys.exit(0)
+
+
+def _should_probe():
+    """Supervise only when this process could still touch an accelerator:
+    not the forced-CPU child, not the supervised accelerator child itself,
+    jax_platforms not already pinned to cpu (the test suite's conftest
+    pins it before calling main() in-process), and backends not already
+    initialised. Probing in the pinned/initialised cases would re-init
+    the accelerator plugin in a throwaway subprocess and hang for the
+    full timeout per call without affecting the run."""
+    from jax._src import xla_bridge
+
+    forced_cpu = os.environ.get(_FORCE_CPU_ENV) == "1"
+    accel_child = os.environ.get(_ACCEL_CHILD_ENV) == "1"
+    cpu_pinned = (getattr(jax.config, "jax_platforms", None) or "") == "cpu"
+    return (not forced_cpu and not accel_child and not cpu_pinned
+            and not xla_bridge.backends_are_initialized())
+
+
+def _run_supervised_accel():
+    """Run the real accelerator measurement as a supervised child. Exits.
+
+    The probe passing proves the backend was healthy moments ago, not that
+    it stays healthy: a tunnel that wedges AFTER the probe would hang an
+    in-process jax.devices()/compile/execute indefinitely — no exception
+    to catch, no record emitted (the residual window of the probe-only
+    design). Supervising the whole measurement in a child with a timeout
+    closes it: any hang anywhere in the accelerator path degrades to the
+    CPU re-exec instead of losing the headline.
+    """
+    env = {**os.environ, _ACCEL_CHILD_ENV: "1"}
+    try:
+        p = subprocess.run(
+            [sys.executable, os.path.abspath(__file__)],
+            env=env, stdout=subprocess.PIPE, text=True,
+            timeout=_ACCEL_TIMEOUT_S,
+        )
+        out, rc = p.stdout or "", p.returncode
+    except subprocess.TimeoutExpired as te:
+        out = (te.stdout.decode() if isinstance(te.stdout, bytes)
+               else te.stdout) or ""
+        rc = None
+    if rc == 0 and _has_record(out):
+        sys.stdout.write(out)
+        sys.stdout.flush()
+        sys.exit(0)
+    err = ("accelerator measurement hung "
+           f"(no result after {_ACCEL_TIMEOUT_S:.0f}s)" if rc is None
+           else f"accelerator measurement failed (rc={rc}, "
+                f"record={_has_record(out)})")
+    _reexec_cpu(err)  # exits
+
+
+def _devices_or_fallback():
+    """jax.devices() that degrades to a CPU re-exec instead of dying."""
+    if _should_probe():
+        err = _probe_backend()
+        if err is not None:
+            _reexec_cpu(err)  # exits
+        _run_supervised_accel()  # exits
+    try:
+        return jax.devices()
+    except Exception as e:  # noqa: BLE001 — init race after a good probe
+        if os.environ.get(_FORCE_CPU_ENV) == "1":
+            raise  # CPU itself broken: nothing lower; parent emits record
+        if os.environ.get(_ACCEL_CHILD_ENV) == "1":
+            # exit nonzero and let the SUPERVISING parent run the single
+            # CPU fallback: a _reexec_cpu from in here would start a
+            # full-size CPU measurement (timeout 5400s) inside the
+            # parent's 1800s supervision window — the parent would kill
+            # this child mid-measurement, orphan the CPU grandchild, and
+            # then run a second CPU measurement contending with it
+            raise
+        _reexec_cpu(f"{type(e).__name__}: {e}"[:300])
+
+
 def main():
-    log(f"devices: {jax.devices()}")
-    log("generating synthetic MNIST-60k workload...")
-    X, Y = mnist_like(n=60000, d=784, noise=30.0, label_noise=0.005)
+    devices = _devices_or_fallback()
+    log(f"devices: {devices}")
+    init_fallback = os.environ.get(_INIT_ERR_ENV)
+    if init_fallback:
+        log(f"NOTE: degraded run — accelerator init failed upstream: "
+            f"{init_fallback}")
+    if os.environ.get("_TPUSVM_BENCH_SMOKE") == "1":
+        # shrunken workload for fast end-to-end tests of the fallback
+        # machinery in a REAL child process (tests/test_bench_fallback.py;
+        # the in-process tests shrink by monkeypatching mnist_like instead)
+        log("smoke workload (n=512, d=32)")
+        X, Y = mnist_like(n=512, d=32, noise=3.0, label_noise=0.005)
+    else:
+        log("generating synthetic MNIST-60k workload...")
+        X, Y = mnist_like(n=60000, d=784, noise=30.0, label_noise=0.005)
     Xs = MinMaxScaler().fit_transform(X).astype(np.float32)
     Xd = jax.device_put(jnp.asarray(Xs))
     Yd = jax.device_put(jnp.asarray(Y))
@@ -87,20 +288,30 @@ def main():
     # (tpusvm/solver/blocked.py matmul_precision).
     static_kwargs = dict(q=2048, max_outer=5000, max_inner=4096, wss=2,
                          accum_dtype=jnp.float64)
-    on_tpu = jax.devices()[0].platform == "tpu"
+    on_tpu = devices[0].platform == "tpu"
     # Tiny-shape kernel canary BEFORE the heavy compile (TPU only — off
     # TPU the solver's inner='auto' resolves to the XLA engine and the
     # canary could not affect the run): a Mosaic regression that compiles
     # but miscomputes or faults at runtime would otherwise burn the
-    # unattended round's one heavy measurement. Each layout runs a q=128
+    # unattended round's one heavy measurement. Each layout runs a q=256
     # subproblem twice — wss=1 checked against the XLA inner loop's
     # trajectory, and wss=2 (the mode the benchmark actually runs)
     # checked against the subproblem invariants (box feasibility,
     # sum(y*a)=0 conservation, dual ascent) since its trajectory
-    # legitimately differs. First layout passing both is used; none
-    # passing degrades to the XLA engine. The compile-failure chain below
-    # stays as the backstop for the full-size q=2048 lowering.
+    # legitimately differs. q=256 and not 128: at q=128 the packed layout
+    # degenerates to (R=1, L=128) — bitwise the flat layout — so a canary
+    # there would test the same lowering twice and wave through a
+    # multi-row regression (the exact class it exists to catch); 256 is
+    # the smallest q where packed (R=2) genuinely exercises the multi-row
+    # index mapping, row reshapes, and cross-sublane reductions. First
+    # layout passing both runs is used; none passing degrades to the XLA
+    # engine. The compile-failure chain below stays as the backstop for
+    # the full-size q=2048 lowering.
     fallback = None
+    # None = canary not applicable (non-TPU); True = the selected kernel
+    # layout passed; False = the canary harness itself failed, so the
+    # engine field describes an UNVETTED config
+    canary_passed = None
     # off TPU the solver's inner='auto' resolves to the XLA engine
     engine = "pallas-packed" if on_tpu else "xla"
     if on_tpu:
@@ -110,13 +321,13 @@ def main():
             from tpusvm.solver.blocked import _inner_smo
 
             rngc = np.random.default_rng(0)
-            Xc = jnp.asarray(rngc.random((128, 8)), jnp.float32)
-            yc_np = np.where(rngc.random(128) < 0.5, 1, -1)
+            Xc = jnp.asarray(rngc.random((256, 8)), jnp.float32)
+            yc_np = np.where(rngc.random(256) < 0.5, 1, -1)
             yc = jnp.asarray(yc_np, jnp.int32)
             Kc = rbf_cross(Xc, Xc, 0.5)
-            a0c = jnp.zeros(128, jnp.float32)
+            a0c = jnp.zeros(256, jnp.float32)
             f0c = -yc.astype(jnp.float32)
-            actc = jnp.ones(128, bool)
+            actc = jnp.ones(256, bool)
             a_ref = np.asarray(_inner_smo(Kc, yc, a0c, f0c, actc, 10.0,
                                           1e-12, 1e-5, 64)[0])
             Qc = np.asarray(Kc) * np.outer(yc_np, yc_np)
@@ -151,15 +362,19 @@ def main():
                     "the XLA inner engine")
                 static_kwargs = dict(static_kwargs, inner="xla", wss=1)
                 engine = "xla"
-            elif picked != "packed":
-                static_kwargs = dict(static_kwargs, pallas_layout=picked)
-                engine = f"pallas-{picked}"
+                canary_passed = True  # the engine that runs IS vetted
+            else:
+                canary_passed = True
+                if picked != "packed":
+                    static_kwargs = dict(static_kwargs, pallas_layout=picked)
+                    engine = f"pallas-{picked}"
         except Exception as ce:  # noqa: BLE001 — canary harness broke
             log(f"WARNING: kernel canary harness failed; proceeding with "
                 f"the tuned config unvetted. Full error:\n"
                 f"{type(ce).__name__}: {ce}")
             fallback = ("canary harness failed (kernel unvetted): "
                         + f"{type(ce).__name__}: {ce}"[:300])
+            canary_passed = False
 
     class _AlreadyFailed(Exception):
         """Sentinel: the canary-selected flat layout failed at full size;
@@ -281,13 +496,22 @@ def main():
                     "hbm_peak_fraction_est": round(
                         hbm_gbps / V5E_PEAK_HBM_GBPS, 3
                     ) if on_tpu else None,
-                    "platform": jax.devices()[0].platform,
+                    "platform": devices[0].platform,
                     # which inner engine actually ran: "pallas-packed"
                     # (the tuned config), "pallas-flat", or "xla"
                     "engine": engine,
+                    # True: the engine above was canary-vetted (or is the
+                    # reference XLA engine); False: the canary harness
+                    # crashed and the engine ran UNVETTED; null: non-TPU
+                    # run, canary not applicable
+                    "canary_passed": canary_passed,
                     # non-null if any canary or compile fallback fired;
                     # records each failure (separately truncated)
                     "compile_fallback": fallback,
+                    # non-null on a degraded run: the accelerator backend
+                    # failed to initialise with this error and the
+                    # measurement below ran on the CPU backend instead
+                    "init_fallback": init_fallback,
                 },
             }
         )
