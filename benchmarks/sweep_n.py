@@ -50,15 +50,22 @@ from benchmarks.common import (  # noqa: E402
 )
 from tpusvm.data import MinMaxScaler, mnist_like  # noqa: E402
 from tpusvm.oracle.smo import get_sv_indices  # noqa: E402
-from tpusvm.solver.blocked import blocked_smo_solve  # noqa: E402
+from tpusvm.solver.blocked import (  # noqa: E402
+    blocked_smo_solve,
+    resolve_solver_config,
+)
 from tpusvm.solver.predict import predict as device_predict  # noqa: E402
 from tpusvm.status import Status  # noqa: E402
 
 
 def run_size(n, Xs, Y, Xt, Yt, solver_opts, gamma):
-    q_eff = min(solver_opts["q"], n if n % 2 == 0 else n - 1) if n >= 2 else 2
-    engine = ("pallas" if jax.default_backend() == "tpu"
-              and q_eff % 128 == 0 else "xla")
+    # effective config from the solver's own resolution rules (shared
+    # helper) so a result row cannot silently claim an engine/wss/selection
+    # it did not run if those rules ever change
+    q_eff, engine, eff_wss, eff_selection = resolve_solver_config(
+        n, solver_opts["q"], wss=solver_opts["wss"],
+        selection=solver_opts["selection"],
+    )
     Xd = jax.device_put(jnp.asarray(Xs[:n]))
     Yd = jax.device_put(jnp.asarray(Y[:n]))
     traced = dict(C=10.0, gamma=gamma, eps=1e-12, tau=1e-5)
@@ -119,17 +126,12 @@ def run_size(n, Xs, Y, Xt, Yt, solver_opts, gamma):
         "n_sv": int(len(get_sv_indices(alpha))),
         "iterations": int(res.n_iter),
         "status": Status(int(res.status)).name,
-        # effective solver config, mirroring blocked_smo_solve's own
-        # resolution (q clamps to n; the pallas engine needs TPU + 128-lane
-        # alignment; wss=2 exists only in the pallas engine; selection=auto
-        # resolves by backend) — so a row can't silently claim a config it
-        # didn't run
+        # effective solver config via blocked.resolve_solver_config — the
+        # solver's own resolution, not a re-implementation
         "q": q_eff,
         "inner_engine": engine,
-        "wss": solver_opts["wss"] if engine == "pallas" else 1,
-        "selection": ("approx" if jax.default_backend() == "tpu"
-                      else "exact") if solver_opts["selection"] == "auto"
-                     else solver_opts["selection"],
+        "wss": eff_wss,
+        "selection": eff_selection,
         "vs_gpu_train": round(GPU_TRAIN_S[n] / train_s, 2) if n in GPU_TRAIN_S else None,
         # SV-compacted serving path vs the reference's all-n GPU kernel:
         # includes an ~n/n_sv fewer-FLOPs factor on top of framework speed

@@ -263,3 +263,24 @@ def test_blocked_fused_fupdate_rejects_reduced_precision():
     with pytest.raises(ValueError, match="fused_fupdate"):
         blocked_smo_solve(X, Y, fused_fupdate=True,
                           matmul_precision="default", refine=16)
+
+
+def test_resolve_solver_config_matches_solver_behavior():
+    """The shared resolution helper (what benchmarks record per-row) must
+    mirror the solver's actual rules: q clamps to even n, inner='auto' is
+    XLA off-TPU, selection='auto' is exact off-TPU, and wss degrades to
+    first-order whenever the XLA engine runs (ADVICE r2)."""
+    from tpusvm.solver.blocked import resolve_solver_config
+
+    # q clamp: odd n drops to n-1; tiny n floors at 2
+    assert resolve_solver_config(385, 1024)[0] == 384
+    assert resolve_solver_config(384, 128)[0] == 128
+    assert resolve_solver_config(1, 128)[0] == 2
+    # this suite runs on CPU: auto resolves to (xla, exact), wss degrades
+    q, inner, wss, selection = resolve_solver_config(
+        60000, 2048, inner="auto", wss=2, selection="auto")
+    assert (q, inner, wss, selection) == (2048, "xla", 1, "exact")
+    # explicit engine/selection pass through; wss=2 survives on pallas
+    _, inner, wss, _ = resolve_solver_config(
+        60000, 2048, inner="pallas", wss=2, selection="approx")
+    assert (inner, wss) == ("pallas", 2)

@@ -51,6 +51,31 @@ from tpusvm.status import Status
 _PALLAS_LANE = 128
 
 
+def resolve_solver_config(n: int, q: int = 1024, inner: str = "auto",
+                          wss: int = 1, selection: str = "auto"):
+    """Effective (q, inner, wss, selection) blocked_smo_solve will run.
+
+    The single source of truth for the solver's config-resolution rules —
+    q clamps to the (even) training-set size, inner='auto' resolves to the
+    pallas engine only on TPU with a lane-aligned q, selection='auto'
+    resolves by backend, and wss degrades to first-order on the XLA engine
+    (which implements only the reference's Keerthi selection). Benchmarks
+    that record per-row effective config MUST derive it from this helper
+    rather than re-implementing the rules, so recorded rows cannot
+    silently claim an engine/wss/selection they did not run.
+    blocked_smo_solve itself resolves through this helper too; it layers
+    its own validation errors (explicit inner='pallas' with unaligned q,
+    explicit wss=2 with inner='xla') on top.
+    """
+    q = min(q, n if n % 2 == 0 else n - 1) if n >= 2 else 2
+    if selection == "auto":
+        selection = "approx" if jax.default_backend() == "tpu" else "exact"
+    if inner == "auto":
+        inner = ("pallas" if jax.default_backend() == "tpu"
+                 and q % _PALLAS_LANE == 0 else "xla")
+    return q, inner, (wss if inner == "pallas" else 1), selection
+
+
 class _OuterState(NamedTuple):
     alpha: jax.Array      # (n,) accum dtype
     f: jax.Array          # (n,) accum dtype
@@ -270,8 +295,6 @@ def blocked_smo_solve(
     n = Y.shape[0]
     dtype = X.dtype
     adt = dtype if accum_dtype is None else accum_dtype
-    q = min(q, n if n % 2 == 0 else n - 1) if n >= 2 else 2
-    half = q // 2
 
     if inner not in ("auto", "xla", "pallas"):
         raise ValueError(f"inner must be auto|xla|pallas, got {inner!r}")
@@ -286,8 +309,11 @@ def blocked_smo_solve(
         raise ValueError(
             f"selection must be auto|exact|approx, got {selection!r}"
         )
-    if selection == "auto":
-        selection = "approx" if jax.default_backend() == "tpu" else "exact"
+    requested_inner = inner
+    q, inner, _eff_wss, selection = resolve_solver_config(
+        n, q, inner=inner, wss=wss, selection=selection
+    )
+    half = q // 2
     if pallas_layout not in ("packed", "flat"):
         raise ValueError(
             f"pallas_layout must be packed|flat, got {pallas_layout!r}"
@@ -306,11 +332,7 @@ def blocked_smo_solve(
             ">= 1 so convergence claims are re-validated on a "
             "full-precision reconstruction"
         )
-    requested_inner = inner
-    if inner == "auto":
-        inner = ("pallas" if jax.default_backend() == "tpu"
-                 and q % _PALLAS_LANE == 0 else "xla")
-    elif inner == "pallas" and q % _PALLAS_LANE:
+    if inner == "pallas" and q % _PALLAS_LANE:
         raise ValueError(
             f"inner='pallas' needs the working-set size to be a multiple of "
             f"{_PALLAS_LANE}, but q={q} after clamping to the n={n} training "
