@@ -128,10 +128,6 @@ def _squeeze(tree):
     return jax.tree.map(lambda x: x[0], tree)
 
 
-def _unsqueeze(tree):
-    return jax.tree.map(lambda x: x[None], tree)
-
-
 def _solve(train: SVBuffer, cfg: SVMConfig, accum_dtype=None,
            solver: str = "pair", solver_opts: Optional[dict] = None):
     solve = blocked_smo_solve if solver == "blocked" else smo_solve
@@ -192,7 +188,26 @@ def _tree_round_device(
         "iters": jnp.stack(iters),
         "status": jnp.stack(statuses),
     }
-    return _unsqueeze((own, b, diag))
+    return _replicate_outputs(own, b, diag)
+
+
+def _replicate_outputs(model, b, diag):
+    """Broadcast rank 0's model/b and gather per-rank diagnostics so every
+    device (hence every PROCESS) holds the full round result. This is what
+    makes the cascade multi-host capable: with row-sharded outputs, a host
+    can only fetch its own shards (np.asarray on a cross-process array
+    raises), but the host-side round loop — convergence test, overflow
+    checks, checkpointing — needs the global model and all shards'
+    diagnostics on every process to take the same branch in SPMD lockstep
+    (the reference broadcasts its converged flag for the same reason,
+    mpi_svm_main3.cpp:822-827). The extra collectives are sv_cap-sized —
+    noise next to the per-round solves."""
+    model0 = jax.tree.map(
+        lambda x: lax.all_gather(x, CASCADE_AXIS)[0], model
+    )
+    b0 = lax.all_gather(b, CASCADE_AXIS)[0]
+    diag = {k: lax.all_gather(v, CASCADE_AXIS) for k, v in diag.items()}
+    return model0, b0, diag
 
 
 def _star_round_device(
@@ -223,7 +238,10 @@ def _star_round_device(
         "iters": jnp.stack([res.n_iter, res2.n_iter]),
         "status": jnp.stack([res.status, res2.status]),
     }
-    return _unsqueeze((new_global, res2.b, diag))
+    # new_global/b are already identical on every rank (the merged solve
+    # runs replicated); the helper's broadcast is then a no-op in value and
+    # the diag gather is what multi-host needs
+    return _replicate_outputs(new_global, res2.b, diag)
 
 
 def _build_round_fn(
@@ -255,10 +273,13 @@ def _build_round_fn(
         )
     part_specs = SVBuffer(*([P(CASCADE_AXIS)] * 5))
     repl_specs = SVBuffer(*([P()] * 5))
+    # outputs are replicated by _replicate_outputs (multi-host capability:
+    # every process can fetch them without touching remote shards); diag
+    # values carry the per-shard axis inside their leading dim
     out_specs = (
-        SVBuffer(*([P(CASCADE_AXIS)] * 5)),
-        P(CASCADE_AXIS),
-        {k: P(CASCADE_AXIS) for k in ("merged_count", "sv_count", "iters", "status")},
+        SVBuffer(*([P()] * 5)),
+        P(),
+        {k: P() for k in ("merged_count", "sv_count", "iters", "status")},
     )
     # check_vma=False: the solver's scan/while_loop carries start from
     # constant zeros (unvarying), which the varying-manual-axes checker would
@@ -423,8 +444,8 @@ def cascade_fit(
                 )
                 continue
             break
-        new_global = jax.tree.map(lambda x: np.asarray(x[0]), out_global)
-        b = float(np.asarray(b_all)[0])
+        new_global = jax.tree.map(np.asarray, out_global)
+        b = float(np.asarray(b_all))
         dt = time.perf_counter() - t0
         rounds = rnd
 
@@ -491,7 +512,11 @@ def cascade_fit(
             converged = True
         prev_ids = ids_now
 
-        if checkpoint_path is not None:
+        if checkpoint_path is not None and jax.process_index() == 0:
+            # every process computes identical (replicated) round state;
+            # only process 0 persists it — the reference's rank-0-only IO
+            # pattern (SURVEY.md §5.5), and it avoids a same-file rename
+            # race on a shared filesystem
             save_round_state(checkpoint_path, new_global, prev_ids, rnd, b)
 
         if converged:
