@@ -42,7 +42,29 @@ def test_dryrun_multichip_direct_provisioning():
         timeout=300,
     )
     assert r.returncode == 0, r.stderr[-2000:]
+    # both merge topologies must appear in the driver artifact, each
+    # having run its multi-round convergence loop
     assert "dryrun_multichip OK" in r.stdout
+    assert "tree OK" in r.stdout and "star OK" in r.stdout
+    assert "rounds" in r.stdout
+
+
+def test_dryrun_multichip_non_power_of_two_runs_star_only():
+    # the tree topology requires P = 2^k (reference parity,
+    # mpi_svm_main3.cpp power-of-two check); a 6-device mesh must still
+    # produce a star artifact instead of failing
+    code = (
+        f"import sys; sys.path.insert(0, {_REPO!r}); "
+        "from __graft_entry__ import dryrun_multichip; "
+        "dryrun_multichip(6)"
+    )
+    r = subprocess.run(
+        [sys.executable, "-c", code], capture_output=True, text=True,
+        timeout=300,
+    )
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "star OK" in r.stdout
+    assert "tree OK" not in r.stdout
 
 
 def test_dryrun_multichip_after_backend_init():
