@@ -29,6 +29,13 @@ refine = int(sys.argv[6]) if len(sys.argv) > 6 else 0
 selection = sys.argv[7] if len(sys.argv) > 7 else "auto"
 fused = len(sys.argv) > 8 and sys.argv[8] in ("1", "fused", "true")
 
+# DELIBERATELY the headline benchmark's frozen recipe (bench.py — see its
+# docstring: noise=30/label_noise=0.005, kept for cross-round
+# comparability), NOT the accuracy-calibrated BENCH_NOISE recipe: this
+# probe tunes the exact optimisation problem the headline measures.
+# Different seed from bench.py (0 vs 587): tuning on a sibling instance
+# of the same distribution guards against overfitting knobs to the
+# measured instance.
 X, Y = mnist_like(n=60000, d=784, seed=0, noise=30, label_noise=0.005)
 Xs = MinMaxScaler().fit_transform(X)
 Xd = jnp.asarray(Xs, jnp.float32)
