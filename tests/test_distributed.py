@@ -119,6 +119,34 @@ def test_two_process_cascade_converges(topology, tmp_path):
     assert train_evts[0]["sv_count"] > 0
 
 
+def test_two_process_checkpoint_then_resume(tmp_path):
+    """Checkpointing under the 2-process cluster: only process 0 writes
+    the round state (rank-0 IO), and a second cluster launch resumes from
+    it — the cascade's inter-round state survives a full cluster restart,
+    the elastic-recovery property the reference lacks entirely."""
+    ckpt = tmp_path / "cascade.npz"
+    base = [
+        "train", "--synthetic", "blobs", "--n", "64", "--n-test", "0",
+        "--d", "8", "--gamma", "0.5", "--C", "1.0",
+        "--mode", "cascade", "--topology", "star",
+        "--shards", "2", "--sv-capacity", "32",
+        "--checkpoint", str(ckpt),
+    ]
+    # run 1: stop after a single round (max_rounds=1 cannot converge —
+    # convergence needs two rounds with equal ID sets)
+    results = _run_cluster(base + ["--max-rounds", "1"])
+    for rc, out in results:
+        assert rc == 0, out[-3000:]
+    assert ckpt.exists()
+    # run 2: a fresh cluster resumes from the checkpoint and converges
+    results = _run_cluster(base + ["--max-rounds", "6", "--resume"])
+    for rc, out in results:
+        assert rc == 0, out[-3000:]
+    out0 = results[0][1]
+    assert "resuming cascade from round 2" in out0
+    assert "converged = True" in out0
+
+
 def test_two_process_mesh_spans_processes():
     """The info command must see one global 2-device mesh (process_count 2,
     one addressable device each) — proof the cluster actually formed, not
