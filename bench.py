@@ -512,6 +512,10 @@ def main():
                     # failed to initialise with this error and the
                     # measurement below ran on the CPU backend instead
                     "init_fallback": init_fallback,
+                    # provenance for CPU measurements (a 41s CPU run on a
+                    # 128-core host is not a 41s CPU run on a laptop)
+                    "cpu_count": (os.cpu_count()
+                                  if devices[0].platform == "cpu" else None),
                 },
             }
         )
