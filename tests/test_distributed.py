@@ -35,14 +35,20 @@ def _free_port() -> int:
 
 
 def _run_cluster(extra_args, num_processes=2, timeout=420,
-                 per_process_args=None):
+                 per_process_args=None, devices_per_process=1):
     """Launch the CLI on every 'host' of the localhost cluster; returns
     [(rc, output), ...] in process-id order."""
     port = _free_port()
-    # one CPU device per process: the global mesh then spans processes,
-    # which is the whole point (8 virtual devices per process would let
-    # a 2-shard mesh land entirely on process 0)
+    # default one CPU device per process: the global mesh then spans
+    # processes, which is the whole point (8 virtual devices per process
+    # would let a 2-shard mesh land entirely on process 0).
+    # devices_per_process > 1 models a real pod host (several chips per
+    # host): collectives must cross BOTH device and process boundaries.
     env = {k: v for k, v in os.environ.items() if k != "XLA_FLAGS"}
+    if devices_per_process > 1:
+        env["XLA_FLAGS"] = (
+            f"--xla_force_host_platform_device_count={devices_per_process}"
+        )
     procs = []
     outfiles = []
     for pid in range(num_processes):
@@ -145,6 +151,33 @@ def test_two_process_checkpoint_then_resume(tmp_path):
     out0 = results[0][1]
     assert "resuming cascade from round 2" in out0
     assert "converged = True" in out0
+
+
+def test_two_process_four_device_mesh(tmp_path):
+    """The real pod shape — multiple devices PER process (2 hosts x 2
+    'chips'): a 4-shard cascade whose merge collectives cross both the
+    intra-process device boundary and the inter-process one in a single
+    mesh axis. This is the topology a multi-host TPU slice presents
+    (ICI within a host's chips, DCN between hosts)."""
+    import numpy as np
+
+    models = [tmp_path / f"model{pid}.npz" for pid in (0, 1)]
+    results = _run_cluster(
+        [
+            "train", "--synthetic", "blobs", "--n", "128", "--n-test", "0",
+            "--d", "8", "--gamma", "0.5", "--C", "1.0",
+            "--mode", "cascade", "--topology", "tree",
+            "--shards", "4", "--sv-capacity", "64", "--max-rounds", "5",
+        ],
+        per_process_args=[["--save", str(m)] for m in models],
+        devices_per_process=2,
+    )
+    for rc, out in results:
+        assert rc == 0, out[-3000:]
+    assert "converged = True" in results[0][1]
+    with np.load(models[0]) as m0, np.load(models[1]) as m1:
+        np.testing.assert_array_equal(m0["sv_ids"], m1["sv_ids"])
+        assert len(m0["sv_ids"]) > 0
 
 
 def test_two_process_mesh_spans_processes():

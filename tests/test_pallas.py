@@ -156,3 +156,45 @@ def test_inner_smo_rejects_bad_layout():
     with pytest.raises(ValueError, match="layout must be"):
         inner_smo_pallas(K, y, a0, f0, act, 10.0, 1e-12, 1e-5,
                          max_inner=64, interpret=True, layout="ragged")
+
+
+@pytest.mark.parametrize("n,q,block", [
+    (1000, 64, 256),    # n not divisible by block (masked final write)
+    (256, 128, 1024),   # block clamps to n
+    (777, 32, 128),     # odd everything
+])
+def test_fused_fupdate_matches_xla_contraction(n, q, block):
+    """rbf_cross_matvec_pallas (interpret) vs the XLA contraction it
+    replaces, across block-boundary shapes — the masked final-block
+    write and the no-padded-copy design must not leak out-of-bounds
+    lanes into real rows. Derisks flipping fused_fupdate on once
+    hardware timing exists (VERDICT r2 #3 is hardware-blocked)."""
+    from tpusvm.ops.pallas.fused_fupdate import rbf_cross_matvec_pallas
+    from tpusvm.ops.rbf import rbf_cross_matvec
+
+    rng = np.random.default_rng(n + q)
+    X = jnp.asarray(rng.random((n, 16)), jnp.float32)
+    XB = jnp.asarray(rng.random((q, 16)), jnp.float32)
+    coef = jnp.asarray(rng.standard_normal(q), jnp.float32)
+    ref = rbf_cross_matvec(X, XB, coef, 0.25)
+    got = rbf_cross_matvec_pallas(X, XB, coef, 0.25, block=block,
+                                  interpret=True)
+    assert got.shape == (n,) and got.dtype == X.dtype
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_fused_fupdate_traced_gamma_and_sn():
+    """gamma is traced (SMEM-delivered) and a precomputed sn must give
+    the same result as the internally computed one."""
+    from tpusvm.ops.pallas.fused_fupdate import rbf_cross_matvec_pallas
+    from tpusvm.ops.rbf import sq_norms
+
+    rng = np.random.default_rng(3)
+    X = jnp.asarray(rng.random((300, 8)), jnp.float32)
+    XB = jnp.asarray(rng.random((64, 8)), jnp.float32)
+    coef = jnp.asarray(rng.standard_normal(64), jnp.float32)
+    a = rbf_cross_matvec_pallas(X, XB, coef, 0.5, interpret=True)
+    b = rbf_cross_matvec_pallas(X, XB, coef, jnp.float32(0.5),
+                                sn=sq_norms(X), interpret=True)
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-6)
