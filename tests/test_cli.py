@@ -210,3 +210,37 @@ def test_cli_not_distributed_by_default(capsys, monkeypatch):
     assert main(["info"]) == 0
     assert calls == []
     capsys.readouterr()
+
+
+def test_cli_class_parallel_multiclass(capsys):
+    """--multiclass --class-parallel trains the one-vs-rest classes
+    sharded over the (virtual 8-device) mesh through the CLI."""
+    rc = main([
+        "train", "--synthetic", "blobs", "--n", "160", "--n-test", "0",
+        "--d", "4", "--gamma", "0.25", "--C", "1.0",
+        "--multiclass", "--class-parallel",
+    ])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "classes = " in out
+
+
+def test_cli_class_parallel_requires_multiclass(capsys):
+    with pytest.raises(SystemExit, match="requires --multiclass"):
+        main(["train", "--synthetic", "blobs", "--n", "64",
+              "--class-parallel"])
+
+
+def test_cli_class_parallel_rejects_blocked(capsys):
+    with pytest.raises(SystemExit, match="pair solver"):
+        main(["train", "--synthetic", "blobs", "--n", "64", "--multiclass",
+              "--class-parallel", "--solver", "blocked"])
+
+
+def test_cli_class_parallel_rejects_distributed(capsys, monkeypatch):
+    import jax
+
+    monkeypatch.setattr(jax.distributed, "initialize", lambda **kw: None)
+    with pytest.raises(SystemExit, match="single-controller"):
+        main(["--distributed", "train", "--synthetic", "blobs", "--n", "64",
+              "--multiclass", "--class-parallel"])

@@ -151,3 +151,34 @@ def test_ovr_solver_opts_forwarded():
     with pytest.raises(TypeError):
         OneVsRestSVC(cfg, solver="blocked",
                      solver_opts={"bogus": 1}).fit(X, labels)
+
+
+def test_ovr_class_parallel_matches_single_device():
+    """class_parallel=True (BASELINE config 5: the class axis sharded over
+    the device mesh) reaches the same solution as the single-device vmap —
+    4 classes over the test mesh's devices, padded with dummy all-negative
+    classes that terminate immediately. Parity is solution-level (same SV
+    union / b / predictions): shard_map compiles the same math into a
+    different schedule, so fp-tie trajectories may differ microscopically,
+    exactly like the repo's cross-engine parity standard."""
+    X, labels = _four_class_data(n=240, seed=5)
+    cfg = SVMConfig(C=10.0, gamma=2.0)
+    m0 = OneVsRestSVC(cfg, dtype=jnp.float64, batched=True).fit(X, labels)
+    mp = OneVsRestSVC(cfg, dtype=jnp.float64, class_parallel=True).fit(
+        X, labels)
+    assert (mp.statuses_ == Status.CONVERGED).all()
+    assert mp.coef_.shape[0] == 4  # dummy padding classes were dropped
+    # b is only determined to the 2*tau stopping window (tau=1e-5);
+    # measured cross-schedule agreement is ~6e-6
+    np.testing.assert_allclose(mp.b_, m0.b_, atol=5e-5)
+    assert m0.X_sv_.shape == mp.X_sv_.shape  # same SV union
+    Xt, lt = _four_class_data(n=100, seed=6)
+    np.testing.assert_array_equal(mp.predict(Xt), m0.predict(Xt))
+    assert mp.score(Xt, lt) > 0.95
+
+
+def test_ovr_class_parallel_rejects_blocked_solver():
+    import pytest
+
+    with pytest.raises(ValueError, match="class_parallel"):
+        OneVsRestSVC(SVMConfig(), solver="blocked", class_parallel=True)

@@ -138,6 +138,10 @@ def _build_parser() -> argparse.ArgumentParser:
     mode.add_argument("--multiclass", action="store_true",
                       help="one-vs-rest over all labels instead of the "
                       "reference's binary '1 vs rest' mapping")
+    mode.add_argument("--class-parallel", action="store_true",
+                      help="with --multiclass: shard the class axis over "
+                      "the device mesh (one-vs-rest problems train "
+                      "chip-parallel; requires the pair solver)")
 
     hp = tr.add_argument_group("hyperparameters (defaults = reference constants)")
     hp.add_argument("--preset", choices=["mnist", "banknote", "debug"],
@@ -321,6 +325,16 @@ def _cmd_train(args) -> int:
                 f"{bad}; known: {sorted(known)}"
                 + (f" (use the dedicated flags for {hint})" if hint else "")
             )
+    if args.class_parallel and not args.multiclass:
+        raise SystemExit("--class-parallel requires --multiclass (it "
+                         "shards the one-vs-rest class axis)")
+    if args.class_parallel and args.distributed:
+        raise SystemExit(
+            "--class-parallel is a single-controller feature (class axis "
+            "over this process's local devices); with --distributed each "
+            "process would redundantly train every class — run without "
+            "--distributed on one host"
+        )
     if args.resume and not args.checkpoint:
         raise SystemExit("--resume requires --checkpoint")
     if args.checkpoint and args.mode != "cascade":
@@ -339,10 +353,16 @@ def _cmd_train(args) -> int:
     if args.multiclass:
         if args.mode != "single":
             raise SystemExit("--multiclass currently supports --mode single")
+        if args.class_parallel and args.solver == "blocked":
+            raise SystemExit(
+                "--class-parallel shards the vmapped pair solver over the "
+                "mesh; --solver blocked trains classes sequentially instead"
+            )
         model = OneVsRestSVC(config=cfg, dtype=dtype, scale=not args.no_scale,
                              accum_dtype=accum_dtype,
                              solver=args.solver or "pair",
-                             solver_opts=solver_opts)
+                             solver_opts=solver_opts,
+                             class_parallel=args.class_parallel)
         with timer.phase("training"), trace(args.profile):
             model.fit(X, Y)
         log.info("classes = %s", list(model.classes_))

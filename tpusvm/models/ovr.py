@@ -14,6 +14,15 @@ chips"). TPU-native design:
     on big problems (MNIST-60k scale) this is orders of magnitude faster
     than lockstep pairwise, whose vmapped while_loop streams all of X once
     per class per 2-alpha update.
+  - training, class_parallel=True: the BASELINE config-5 design verbatim
+    ("10 SVMs vmapped over chips") — the class axis is sharded over a 1-D
+    device mesh via shard_map, each device running the vmapped pair solver
+    on its slice of the one-vs-rest label matrix with X replicated
+    (classes share the data; only the +/-1 labels differ, so the class
+    axis is embarrassingly parallel — no collectives in the hot path).
+    The class count is padded to a device multiple with all-negative dummy
+    label vectors, which terminate NO_WORKING_SET after one masked
+    iteration (free in the lockstep batched while_loop).
   - prediction: ONE kernel matrix K(test, train) feeds all classes:
     scores = K @ coef^T with coef (K, n) = alpha * y per class — a single
     MXU matmul batched over classes instead of K separate predict passes.
@@ -56,6 +65,8 @@ class OneVsRestSVC:
         accum_dtype="auto",
         solver: str = "pair",
         solver_opts: Optional[dict] = None,
+        class_parallel: bool = False,
+        mesh=None,
     ):
         if solver not in ("pair", "blocked"):
             raise ValueError(f"solver must be pair|blocked, got {solver!r}")
@@ -66,6 +77,16 @@ class OneVsRestSVC:
                 UserWarning,
                 stacklevel=2,
             )
+        if class_parallel and solver != "pair":
+            # the class axis is parallelised by vmapping the solver and
+            # sharding the batch; only the pair solver has a vmap-clean
+            # body (the blocked solver's fused Pallas subproblem has no
+            # batching rule)
+            raise ValueError(
+                "class_parallel=True requires solver='pair' (the vmapped "
+                "lockstep solver BASELINE config 5 names); the blocked "
+                "solver trains classes sequentially instead"
+            )
         self.config = config
         self.dtype = dtype
         self.scale = scale
@@ -73,6 +94,8 @@ class OneVsRestSVC:
         self.batched = batched if batched is not None else (solver == "pair")
         self.accum_dtype = accum_dtype
         self.solver = solver
+        self.class_parallel = class_parallel
+        self.mesh = mesh  # class_parallel: 1-D mesh (default: all devices)
         # extra static solver knobs forwarded to the per-class solve calls
         # (blocked: q, max_outer, max_inner, wss, refine, matmul_precision)
         self.solver_opts = dict(solver_opts or {})
@@ -127,7 +150,52 @@ class OneVsRestSVC:
                     **self.solver_opts,
                 )
 
-        if self.batched and self.solver == "pair":
+        if self.class_parallel:
+            # BASELINE config 5 verbatim: the K one-vs-rest problems
+            # sharded over the device mesh, the vmapped pair solver
+            # running each device's class slice. X is a closure capture
+            # (replicated); classes share no state, so the only
+            # cross-device traffic is the initial label scatter.
+            from jax.sharding import PartitionSpec as P
+            from tpusvm.parallel.mesh import make_mesh
+
+            K = Ys.shape[0]
+            mesh = self.mesh
+            if mesh is None:
+                # LOCAL devices only: class-parallel is a single-controller
+                # feature (host-local inputs into a jit). A default mesh
+                # over global jax.devices() under jax.distributed would mix
+                # non-addressable devices into the jit and crash; with
+                # local devices each process simply trains the full class
+                # set on its own chips
+                devs = jax.local_devices()
+                mesh = make_mesh(min(K, len(devs)), devices=devs,
+                                 axis="classes")
+            axis = mesh.axis_names[0]
+            n_use = mesh.devices.size
+            pad = (-K) % n_use
+            # all-negative dummy labels: I_high is empty, so the padded
+            # problems end NO_WORKING_SET after one masked lockstep
+            # iteration — effectively free
+            Ys_p = np.concatenate(
+                [Ys, -np.ones((pad, Ys.shape[1]), np.int32)]
+            )
+            # check_vma=False for the same reason as parallel/cascade.py:
+            # the solver's while_loop/cond carries start from unvarying
+            # constants, which the varying-manual-axes checker rejects on
+            # every carry; no cross-device communication happens inside
+            # the solver, so correctness is unaffected
+            fn = jax.jit(jax.shard_map(
+                jax.vmap(solve_one), mesh=mesh,
+                in_specs=P(axis), out_specs=P(axis),
+                check_vma=False,
+            ))
+            res = fn(jnp.asarray(Ys_p))
+            alphas = np.asarray(res.alpha)[:K]       # (K, n)
+            bs = np.asarray(res.b)[:K]
+            iters = np.asarray(res.n_iter)[:K]
+            statuses = np.asarray(res.status)[:K]
+        elif self.batched and self.solver == "pair":
             res = jax.vmap(solve_one)(jnp.asarray(Ys))
             alphas = np.asarray(res.alpha)           # (K, n)
             bs = np.asarray(res.b)
