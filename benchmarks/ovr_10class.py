@@ -43,11 +43,19 @@ def main(argv=None) -> int:
     ap.add_argument("--wss", type=int, default=1, choices=(1, 2))
     ap.add_argument("--selection", default="auto",
                     choices=("auto", "exact", "approx"))
+    ap.add_argument("--class-parallel", action="store_true",
+                    help="shard the class axis over the local device mesh "
+                    "(pair solver only; BASELINE config 5's 'vmapped over "
+                    "chips')")
     ap.add_argument("--smoke", action="store_true")
     args = ap.parse_args(argv)
     if args.smoke:
         args.n, args.n_test, args.d = 2048, 512, 64
         args.gamma = 1.0 / args.d
+    if args.class_parallel and args.solver != "pair":
+        # validate BEFORE the (minutes-long at full size) dataset generation
+        log("ERROR: --class-parallel requires --solver pair")
+        return 2
 
     import jax.numpy as jnp  # noqa: E402
 
@@ -78,6 +86,7 @@ def main(argv=None) -> int:
         accum_dtype=jnp.float64,
         solver=args.solver,
         solver_opts=solver_opts,
+        class_parallel=args.class_parallel,
     )
     log("training 10 one-vs-rest SVMs...")
     # NOTE train_s comes from fit(), which times the whole training phase
@@ -108,6 +117,7 @@ def main(argv=None) -> int:
         "predict_s": round(predict_s, 3),
         "accuracy": round(float((yp == yte).mean()), 4),
         "n_sv_union": int(model.X_sv_.shape[0]),
+        "class_parallel": args.class_parallel,
         "statuses": [Status(int(s)).name for s in model.statuses_],
         "platform": jax.devices()[0].platform,
     })

@@ -240,7 +240,14 @@ def test_cli_class_parallel_rejects_blocked(capsys):
 def test_cli_class_parallel_rejects_distributed(capsys, monkeypatch):
     import jax
 
-    monkeypatch.setattr(jax.distributed, "initialize", lambda **kw: None)
-    with pytest.raises(SystemExit, match="single-controller"):
+    calls = []
+    monkeypatch.setattr(jax.distributed, "initialize",
+                        lambda **kw: calls.append(kw))
+    # parser.error exits 2 BEFORE jax.distributed.initialize — a conflict
+    # knowable from args alone must not first join (or hang on) the
+    # cluster barrier
+    with pytest.raises(SystemExit):
         main(["--distributed", "train", "--synthetic", "blobs", "--n", "64",
               "--multiclass", "--class-parallel"])
+    assert calls == []
+    assert "single-controller" in capsys.readouterr().err

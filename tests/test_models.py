@@ -161,11 +161,20 @@ def test_ovr_class_parallel_matches_single_device():
     union / b / predictions): shard_map compiles the same math into a
     different schedule, so fp-tie trajectories may differ microscopically,
     exactly like the repo's cross-engine parity standard."""
+    import jax
+
+    from tpusvm.parallel.mesh import make_mesh
+
     X, labels = _four_class_data(n=240, seed=5)
     cfg = SVMConfig(C=10.0, gamma=2.0)
     m0 = OneVsRestSVC(cfg, dtype=jnp.float64, batched=True).fit(X, labels)
-    mp = OneVsRestSVC(cfg, dtype=jnp.float64, class_parallel=True).fit(
-        X, labels)
+    # an explicit 3-device mesh for K=4 classes forces pad=2: the dummy
+    # all-negative padding branch (what 10 classes on 8 chips hits) must
+    # actually execute — the default mesh would size itself to min(K, 8)
+    # = 4 devices and never pad
+    mesh = make_mesh(3, devices=jax.devices()[:3], axis="classes")
+    mp = OneVsRestSVC(cfg, dtype=jnp.float64, class_parallel=True,
+                      mesh=mesh).fit(X, labels)
     assert (mp.statuses_ == Status.CONVERGED).all()
     assert mp.coef_.shape[0] == 4  # dummy padding classes were dropped
     # b is only determined to the 2*tau stopping window (tau=1e-5);

@@ -328,13 +328,6 @@ def _cmd_train(args) -> int:
     if args.class_parallel and not args.multiclass:
         raise SystemExit("--class-parallel requires --multiclass (it "
                          "shards the one-vs-rest class axis)")
-    if args.class_parallel and args.distributed:
-        raise SystemExit(
-            "--class-parallel is a single-controller feature (class axis "
-            "over this process's local devices); with --distributed each "
-            "process would redundantly train every class — run without "
-            "--distributed on one host"
-        )
     if args.resume and not args.checkpoint:
         raise SystemExit("--resume requires --checkpoint")
     if args.checkpoint and args.mode != "cascade":
@@ -485,6 +478,16 @@ def main(argv=None) -> int:
         parser.error(
             "--coordinator-address/--num-processes/--process-id require "
             "--distributed"
+        )
+    if args.distributed and getattr(args, "class_parallel", False):
+        # knowable from args alone — reject BEFORE jax.distributed
+        # .initialize below, which blocks until every process joins (and
+        # hangs outright on misconfigured geometry)
+        parser.error(
+            "--class-parallel is a single-controller feature (class axis "
+            "over this process's local devices); with --distributed each "
+            "process would redundantly train every class — run without "
+            "--distributed on one host"
         )
     if args.platform:
         import jax
