@@ -191,3 +191,44 @@ def test_ovr_class_parallel_rejects_blocked_solver():
 
     with pytest.raises(ValueError, match="class_parallel"):
         OneVsRestSVC(SVMConfig(), solver="blocked", class_parallel=True)
+
+
+def test_binary_svc_mesh_sharded_predict_matches_single_device():
+    """decision_function/predict/score with a mesh shard the test rows
+    over the 8-device CPU mesh; scores must match the single-device path
+    (no collectives in the forward pass — each row is independent).
+    m=100 deliberately does not divide 8 (uneven final shard). Score
+    agreement is to ~ULP (the partitioned matmul may tile the
+    contraction differently); predicted labels could in principle flip
+    on an exactly-zero margin, which is measure-zero on real data."""
+    import jax
+
+    from tpusvm.data import rings
+    from tpusvm.parallel.mesh import make_mesh
+
+    X, Y = rings(n=300, seed=7)
+    m = BinarySVC(SVMConfig(C=10.0, gamma=10.0), dtype=jnp.float64).fit(X, Y)
+    Xt, Yt = rings(n=100, seed=8)
+    mesh = make_mesh(len(jax.devices()))
+    s0 = m.decision_function(Xt)
+    s1 = m.decision_function(Xt, mesh=mesh)
+    np.testing.assert_allclose(s1, s0, rtol=1e-12, atol=1e-12)
+    np.testing.assert_array_equal(m.predict(Xt, mesh=mesh), m.predict(Xt))
+    assert m.score(Xt, Yt, mesh=mesh) == m.score(Xt, Yt)
+
+
+def test_ovr_mesh_sharded_predict_matches_single_device():
+    import jax
+
+    from tpusvm.parallel.mesh import make_mesh
+
+    X, labels = _four_class_data(n=240, seed=9)
+    m = OneVsRestSVC(SVMConfig(C=10.0, gamma=2.0), dtype=jnp.float64).fit(
+        X, labels)
+    Xt, lt = _four_class_data(n=100, seed=10)  # 100 % 8 != 0
+    mesh = make_mesh(len(jax.devices()))
+    np.testing.assert_allclose(
+        m.decision_function(Xt, mesh=mesh), m.decision_function(Xt),
+        rtol=1e-12, atol=1e-12)
+    np.testing.assert_array_equal(m.predict(Xt, mesh=mesh), m.predict(Xt))
+    assert m.score(Xt, lt, mesh=mesh) == m.score(Xt, lt)

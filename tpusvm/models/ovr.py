@@ -234,26 +234,33 @@ class OneVsRestSVC:
             )
         return self
 
-    def decision_function(self, X: np.ndarray) -> np.ndarray:
-        """(m, K) OvR scores via one batched kernel matmul."""
+    def decision_function(self, X: np.ndarray, mesh=None) -> np.ndarray:
+        """(m, K) OvR scores via one batched kernel matmul.
+
+        mesh: optional 1-D mesh — shards the test-row axis over local
+        devices (SV set / coef replicated), same semantics as
+        BinarySVC.decision_function."""
         if self.X_sv_ is None:
             raise RuntimeError("model is not fitted")
+        from tpusvm.parallel.mesh import shard_rows_padded
+
         Xq = self.scaler_.transform(np.asarray(X)) if self.scale else np.asarray(X)
+        Xd, m = shard_rows_padded(mesh, jnp.asarray(Xq, self.dtype))
         scores = _ovr_scores(
-            jnp.asarray(Xq, self.dtype),
+            Xd,
             jnp.asarray(self.X_sv_, self.dtype),
             jnp.asarray(self.coef_, self.dtype),
             jnp.asarray(self.b_, self.dtype),
             self.config.gamma,
         )
-        return np.asarray(scores)
+        return np.asarray(scores[:m])
 
-    def predict(self, X: np.ndarray) -> np.ndarray:
-        scores = self.decision_function(X)
+    def predict(self, X: np.ndarray, mesh=None) -> np.ndarray:
+        scores = self.decision_function(X, mesh=mesh)
         return self.classes_[np.argmax(scores, axis=1)]
 
-    def score(self, X: np.ndarray, labels: np.ndarray) -> float:
-        return float((self.predict(X) == np.asarray(labels)).mean())
+    def score(self, X: np.ndarray, labels: np.ndarray, mesh=None) -> float:
+        return float((self.predict(X, mesh=mesh) == np.asarray(labels)).mean())
 
     def save(self, path: str) -> None:
         if self.X_sv_ is None:

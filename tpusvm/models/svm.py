@@ -177,25 +177,44 @@ class BinarySVC:
         if self.sv_X_ is None:
             raise RuntimeError("model is not fitted")
 
-    def decision_function(self, X: np.ndarray) -> np.ndarray:
+    def decision_function(self, X: np.ndarray, mesh=None) -> np.ndarray:
+        """Decision scores f(x) = sum_k alpha_k y_k K(x, x_k) - b.
+
+        mesh: optional 1-D jax.sharding.Mesh — shards the TEST-ROW axis
+        over the mesh's devices (SV set and b replicated) so serving a
+        large batch uses every chip; XLA partitions the K(test, SV)
+        matmul along the sharded rows with no collectives in the forward
+        pass (each row's score depends only on that row). Scores match
+        the single-device path to fp-summation-order noise (~1 ULP: the
+        partitioned matmul may tile the contraction differently). Single-controller
+        (local devices); rows are zero-padded to a device multiple for
+        the even NamedSharding split and the padding is sliced off the
+        returned scores (a zero row's score is garbage but independent —
+        it cannot contaminate real rows).
+        """
         self._check_fitted()
+        from tpusvm.parallel.mesh import shard_rows_padded
+
         Xs = self.scaler_.transform(np.asarray(X)) if self.scale else np.asarray(X)
+        Xd, m = shard_rows_padded(mesh, jnp.asarray(Xs, self.dtype))
         coef = jnp.asarray(self.sv_alpha_ * self.sv_Y_, self.dtype)
         scores = _decision(
-            jnp.asarray(Xs, self.dtype),
+            Xd,
             jnp.asarray(self.sv_X_, self.dtype),
             coef,
             jnp.asarray(self.b_, self.dtype),
             gamma=self.config.gamma,
         )
-        return np.asarray(scores)
+        return np.asarray(scores[:m])
 
-    def predict(self, X: np.ndarray) -> np.ndarray:
+    def predict(self, X: np.ndarray, mesh=None) -> np.ndarray:
         # strict > 0 -> +1, the oracle convention (main3.cpp:399)
-        return np.where(self.decision_function(X) > 0, 1, -1).astype(np.int32)
+        return np.where(
+            self.decision_function(X, mesh=mesh) > 0, 1, -1
+        ).astype(np.int32)
 
-    def score(self, X: np.ndarray, Y: np.ndarray) -> float:
-        return float((self.predict(X) == np.asarray(Y)).mean())
+    def score(self, X: np.ndarray, Y: np.ndarray, mesh=None) -> float:
+        return float((self.predict(X, mesh=mesh) == np.asarray(Y)).mean())
 
     @property
     def n_support_(self) -> int:

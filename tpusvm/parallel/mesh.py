@@ -48,3 +48,25 @@ def replicate(mesh: Mesh, tree):
     def put(x):
         return jax.device_put(x, NamedSharding(mesh, P()))
     return jax.tree.map(put, tree)
+
+
+def shard_rows_padded(mesh: Optional[Mesh], X):
+    """Zero-pad X's leading axis to a device multiple, device_put it
+    row-sharded over the mesh's (single) axis. Returns (X_sharded, n) with
+    n the original row count — slice outputs back to [:n]. For
+    row-independent computations (e.g. the prediction matmul) the zero
+    padding rows produce garbage-but-isolated outputs that the slice
+    drops; NamedSharding itself requires an even split, hence the pad.
+    mesh=None returns (X, n) unchanged, so callers with an optional mesh
+    need no branch."""
+    import jax.numpy as jnp
+
+    n = X.shape[0]
+    if mesh is None:
+        return X, n
+    pad = (-n) % mesh.devices.size
+    if pad:
+        X = jnp.concatenate(
+            [X, jnp.zeros((pad,) + X.shape[1:], X.dtype)]
+        )
+    return shard_leading(mesh, X, axis=mesh.axis_names[0]), n
