@@ -1,0 +1,43 @@
+#!/usr/bin/env bash
+# Multi-host distributed cascade launcher — the operational counterpart of
+# the reference's 2-node mpirun submission (code/mpi_svm3.sh: SLURM
+# allocates 2 nodes x 32 tasks, mpirun -np 2 ./mpi_svm3). Here each HOST
+# runs this script once with its rank; jax.distributed.initialize (the
+# MPI_Init equivalent, wired behind --distributed) forms one global device
+# mesh spanning the hosts, and the cascade's collectives ride ICI within a
+# host / DCN between hosts.
+#
+# On TPU pods the geometry is auto-discovered from the TPU metadata:
+#   scripts/run_distributed.sh                       # on every pod host
+#
+# Off-TPU (or for a localhost test cluster), pass the geometry explicitly:
+#   COORD=10.0.0.1:8476 NPROC=2 PID=0 scripts/run_distributed.sh   # host 0
+#   COORD=10.0.0.1:8476 NPROC=2 PID=1 scripts/run_distributed.sh   # host 1
+#
+# A 2-process localhost smoke (one CPU device per process — the same
+# cluster tests/test_distributed.py forms):
+#   COORD=127.0.0.1:8476 NPROC=2 PID=0 scripts/run_distributed.sh \
+#       --platform cpu --synthetic blobs --n 64 --d 8 --gamma 0.5 &
+#   COORD=127.0.0.1:8476 NPROC=2 PID=1 scripts/run_distributed.sh \
+#       --platform cpu --synthetic blobs --n 64 --d 8 --gamma 0.5
+#
+# Extra arguments are forwarded to `tpusvm train` (after the defaults
+# below, so user flags win).
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+GEO=()
+if [[ -n "${COORD:-}" ]]; then
+  GEO+=(--coordinator-address "$COORD")
+fi
+if [[ -n "${NPROC:-}" ]]; then
+  GEO+=(--num-processes "$NPROC")
+fi
+if [[ -n "${PID:-}" ]]; then
+  GEO+=(--process-id "$PID")
+fi
+
+exec python -m tpusvm --distributed "${GEO[@]}" train \
+  --synthetic mnist-like --mode cascade --topology "${TOPOLOGY:-tree}" \
+  ${SHARDS:+--shards "$SHARDS"} \
+  "$@"

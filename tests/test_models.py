@@ -232,3 +232,45 @@ def test_ovr_mesh_sharded_predict_matches_single_device():
         rtol=1e-12, atol=1e-12)
     np.testing.assert_array_equal(m.predict(Xt, mesh=mesh), m.predict(Xt))
     assert m.score(Xt, lt, mesh=mesh) == m.score(Xt, lt)
+
+
+def test_mesh_sharded_predict_compiles_with_zero_collectives():
+    """The sharded-serving contract is STRUCTURAL, not just numerical: the
+    compiled HLO for both estimators' mesh paths must contain no
+    collectives (all-gather/collective-permute/all-reduce of the test
+    rows would mean every device gets every row and per-device
+    memory/compute does not shrink). The binary path uses the FLAT matmul
+    for exactly this reason — the blocked scan variant's reshape destroys
+    row sharding and XLA all-gathers (caught by review in round 3)."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from tpusvm.parallel.mesh import make_mesh
+    from tpusvm.solver.predict import decision_function_flat
+
+    mesh = make_mesh(8)
+    rows = NamedSharding(mesh, P("cascade"))
+    Xq = jnp.zeros((1024, 16), jnp.float32)
+    Xsv = jnp.zeros((64, 16), jnp.float32)
+
+    lowered = jax.jit(
+        lambda Xq, Xsv, coef, b: decision_function_flat(
+            Xq, Xsv, coef, b, gamma=0.5),
+        in_shardings=(rows, None, None, None),
+    ).lower(Xq, Xsv, jnp.zeros(64, jnp.float32), jnp.float32(0.0))
+    hlo = lowered.compile().as_text()
+    for coll in ("all-gather", "collective-permute", "all-reduce",
+                 "all-to-all"):
+        assert coll not in hlo, f"{coll} in sharded binary predict HLO"
+
+    from tpusvm.models.ovr import _ovr_scores
+
+    lowered = jax.jit(
+        lambda Xq, Xsv, coef, b: _ovr_scores(Xq, Xsv, coef, b, 0.5),
+        in_shardings=(rows, None, None, None),
+    ).lower(Xq, Xsv, jnp.zeros((4, 64), jnp.float32),
+            jnp.zeros(4, jnp.float32))
+    hlo = lowered.compile().as_text()
+    for coll in ("all-gather", "collective-permute", "all-reduce",
+                 "all-to-all"):
+        assert coll not in hlo, f"{coll} in sharded OVR predict HLO"

@@ -29,7 +29,10 @@ from tpusvm.models.serialization import load_model, save_model
 from tpusvm.oracle.smo import get_sv_indices
 from tpusvm.parallel.cascade import cascade_fit
 from tpusvm.solver.blocked import blocked_smo_solve
-from tpusvm.solver.predict import decision_function as _decision
+from tpusvm.solver.predict import (
+    decision_function as _decision,
+    decision_function_flat as _decision_flat,
+)
 from tpusvm.solver.smo import smo_solve
 from tpusvm.status import Status
 
@@ -198,13 +201,19 @@ class BinarySVC:
         Xs = self.scaler_.transform(np.asarray(X)) if self.scale else np.asarray(X)
         Xd, m = shard_rows_padded(mesh, jnp.asarray(Xs, self.dtype))
         coef = jnp.asarray(self.sv_alpha_ * self.sv_Y_, self.dtype)
-        scores = _decision(
+        args = (
             Xd,
             jnp.asarray(self.sv_X_, self.dtype),
             coef,
             jnp.asarray(self.b_, self.dtype),
-            gamma=self.config.gamma,
         )
+        if mesh is not None:
+            # the FLAT matmul: the blocked variant's reshape+scan destroys
+            # row sharding (XLA all-gathers the test set onto every
+            # device); flat partitions cleanly — see decision_function_flat
+            scores = _decision_flat(*args, gamma=self.config.gamma)
+        else:
+            scores = _decision(*args, gamma=self.config.gamma)
         return np.asarray(scores[:m])
 
     def predict(self, X: np.ndarray, mesh=None) -> np.ndarray:

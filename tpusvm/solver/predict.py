@@ -47,6 +47,31 @@ def decision_function(
     return scores.reshape(-1)[:m] - b
 
 
+@functools.partial(jax.jit, static_argnames=("gamma",))
+def decision_function_flat(
+    X_test: jax.Array,
+    X_train: jax.Array,
+    coef: jax.Array,
+    b,
+    *,
+    gamma: float,
+) -> jax.Array:
+    """Unblocked variant of decision_function: one flat matmul.
+
+    Used by mesh-sharded serving (models.*.decision_function(mesh=...)):
+    the blocked variant's reshape-to-(nb, block, d) + lax.scan destroys a
+    row sharding — XLA all-gathers the whole test set onto every device —
+    while a flat matmul partitions cleanly along the sharded rows with
+    zero collectives (each device computes its own rows' scores). The
+    (m, n_train) kernel slab is materialised, but sharded: each device
+    holds m/P rows, which is exactly the memory scaling sharded serving
+    is for. Single-device callers should prefer the blocked variant,
+    which bounds the slab at (block, n_train).
+    """
+    K = rbf_cross(X_test, X_train, gamma, snB=sq_norms(X_train))
+    return K @ coef - b
+
+
 def predict(
     X_test: jax.Array,
     X_train: jax.Array,
