@@ -153,12 +153,14 @@ def test_two_process_checkpoint_then_resume(tmp_path):
     assert "converged = True" in out0
 
 
-def test_two_process_four_device_mesh(tmp_path):
+@pytest.mark.parametrize("topology", ["tree", "star"])
+def test_two_process_four_device_mesh(topology, tmp_path):
     """The real pod shape — multiple devices PER process (2 hosts x 2
     'chips'): a 4-shard cascade whose merge collectives cross both the
     intra-process device boundary and the inter-process one in a single
-    mesh axis. This is the topology a multi-host TPU slice presents
-    (ICI within a host's chips, DCN between hosts)."""
+    mesh axis (tree's ppermute exchange and star's all_gather both run
+    mixed intra/inter-process). This is the topology a multi-host TPU
+    slice presents (ICI within a host's chips, DCN between hosts)."""
     import numpy as np
 
     models = [tmp_path / f"model{pid}.npz" for pid in (0, 1)]
@@ -166,7 +168,7 @@ def test_two_process_four_device_mesh(tmp_path):
         [
             "train", "--synthetic", "blobs", "--n", "128", "--n-test", "0",
             "--d", "8", "--gamma", "0.5", "--C", "1.0",
-            "--mode", "cascade", "--topology", "tree",
+            "--mode", "cascade", "--topology", topology,
             "--shards", "4", "--sv-capacity", "64", "--max-rounds", "5",
         ],
         per_process_args=[["--save", str(m)] for m in models],
