@@ -201,13 +201,20 @@ def _should_probe():
     initialised. Probing in the pinned/initialised cases would re-init
     the accelerator plugin in a throwaway subprocess and hang for the
     full timeout per call without affecting the run."""
-    from jax._src import xla_bridge
-
     forced_cpu = os.environ.get(_FORCE_CPU_ENV) == "1"
     accel_child = os.environ.get(_ACCEL_CHILD_ENV) == "1"
     cpu_pinned = (getattr(jax.config, "jax_platforms", None) or "") == "cpu"
+    try:
+        # private API: if a JAX upgrade moves/renames it, conservatively
+        # treat backends as uninitialised (probe anyway) so the insurance
+        # chain survives internals churn instead of crashing pre-fallback
+        from jax._src import xla_bridge
+
+        initialised = bool(xla_bridge.backends_are_initialized())
+    except Exception:
+        initialised = False
     return (not forced_cpu and not accel_child and not cpu_pinned
-            and not xla_bridge.backends_are_initialized())
+            and not initialised)
 
 
 def _run_supervised_accel():

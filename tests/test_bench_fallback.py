@@ -163,6 +163,24 @@ def test_bench_init_probe_failure_triggers_cpu_reexec(bench_mod,
     assert calls["err"] == "synthetic: backend init hang"
 
 
+def test_should_probe_survives_private_api_removal(bench_mod, monkeypatch):
+    """ADVICE r3: _should_probe leans on the private
+    jax._src.xla_bridge.backends_are_initialized; if a JAX upgrade removes
+    it, the guard must conservatively probe anyway rather than crash the
+    benchmark before any fallback engages."""
+    import jax
+    from jax._src import xla_bridge
+
+    monkeypatch.delenv(bench_mod._FORCE_CPU_ENV, raising=False)
+    monkeypatch.delenv(bench_mod._ACCEL_CHILD_ENV, raising=False)
+    # un-pin the conftest's cpu platform for the duration of the check
+    # (jax_platforms is a read-only class property; monkeypatch restores)
+    monkeypatch.setattr(type(jax.config), "jax_platforms",
+                        property(lambda self: ""))
+    monkeypatch.delattr(xla_bridge, "backends_are_initialized")
+    assert bench_mod._should_probe() is True
+
+
 def test_bench_probe_pass_runs_supervised_accel_child(bench_mod,
                                                       monkeypatch):
     calls = []

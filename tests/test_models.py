@@ -234,6 +234,40 @@ def test_ovr_mesh_sharded_predict_matches_single_device():
     assert m.score(Xt, lt, mesh=mesh) == m.score(Xt, lt)
 
 
+def _mesh_2d():
+    import jax
+    from jax.sharding import Mesh
+
+    # fixed 2-device slice so the guard test runs under any device count
+    return Mesh(np.asarray(jax.devices()[:2]).reshape(1, 2), ("a", "b"))
+
+
+def test_mesh_sharded_predict_rejects_multi_axis_mesh():
+    """shard_rows_padded pads by mesh.devices.size but shards only axis 0,
+    so a multi-axis mesh must be rejected up front (ADVICE r3) instead of
+    producing an obscure sharding error or silent over-padding."""
+    import pytest
+
+    from tpusvm.data import rings
+    from tpusvm.parallel.mesh import shard_rows_padded
+
+    with pytest.raises(ValueError, match="1-D mesh"):
+        shard_rows_padded(_mesh_2d(), jnp.zeros((16, 3)))
+    X, Y = rings(n=64, seed=7)
+    m = BinarySVC(SVMConfig(C=10.0, gamma=10.0)).fit(X, Y)
+    with pytest.raises(ValueError, match="1-D mesh"):
+        m.decision_function(X, mesh=_mesh_2d())
+
+
+def test_ovr_class_parallel_rejects_multi_axis_mesh():
+    import pytest
+
+    X, labels = _four_class_data(n=64, seed=5)
+    m = OneVsRestSVC(SVMConfig(), class_parallel=True, mesh=_mesh_2d())
+    with pytest.raises(ValueError, match="1-D mesh"):
+        m.fit(X, labels)
+
+
 def test_mesh_sharded_predict_compiles_with_zero_collectives():
     """The sharded-serving contract is STRUCTURAL, not just numerical: the
     compiled HLO for both estimators' mesh paths must contain no

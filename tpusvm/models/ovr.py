@@ -171,6 +171,9 @@ class OneVsRestSVC:
                 devs = jax.local_devices()
                 mesh = make_mesh(min(K, len(devs)), devices=devs,
                                  axis="classes")
+            from tpusvm.parallel.mesh import require_1d_mesh
+
+            require_1d_mesh(mesh, "class_parallel")
             axis = mesh.axis_names[0]
             n_use = mesh.devices.size
             pad = (-K) % n_use

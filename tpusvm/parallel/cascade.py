@@ -124,6 +124,78 @@ def load_round_state(path: str, dtype=jnp.float32):
         )
 
 
+def _resume_fingerprint(loaded: bool, start_round: int, prev_ids,
+                        b: float) -> np.ndarray:
+    """Compact per-process summary of the loaded checkpoint state:
+    [loaded?, next round, CRC of the sorted SV-ID set, b bits lo, b bits hi].
+    Identical checkpoints produce identical fingerprints; any divergence
+    (missing file on one host, different round, different SV set) differs
+    in at least one field. uint32 fields so the cross-process gather is
+    exact whether or not jax x64 is enabled."""
+    import zlib
+
+    ids = np.asarray(sorted(prev_ids), np.int64)
+    b_bits = int(np.float64(b).view(np.uint64))
+    return np.array(
+        [
+            int(bool(loaded)),
+            start_round,
+            zlib.crc32(ids.tobytes()),
+            b_bits & 0xFFFFFFFF,
+            b_bits >> 32,
+        ],
+        np.uint32,
+    )
+
+
+def _check_resume_fingerprints(all_fps: np.ndarray) -> None:
+    """Raise unless every process loaded the same checkpoint state.
+
+    all_fps: (process_count, 5) stack of _resume_fingerprint rows. The
+    cascade round loop is SPMD: every process must launch the same number
+    of round_fn collectives with the same global_sv input, so a resume
+    where process 0 starts at round N while another process (whose host
+    lacks the checkpoint file) starts fresh at round 1 is a distributed
+    deadlock, not a recoverable skew. Checkpoint/resume on a multi-host
+    cluster therefore REQUIRES checkpoint_path on a shared filesystem (or
+    an identical copy staged to every host before restart)."""
+    if (all_fps == all_fps[0]).all():
+        return
+    loaded = all_fps[:, 0].astype(bool)
+    if loaded.any() and not loaded.all():
+        missing = np.nonzero(~loaded)[0].tolist()
+        raise RuntimeError(
+            "cascade resume: checkpoint file present on some processes but "
+            f"missing on processes {missing}. Multi-host resume requires "
+            "checkpoint_path on a shared filesystem (process 0 writes it); "
+            "stage the file to every host or fix the path."
+        )
+    raise RuntimeError(
+        "cascade resume: processes loaded DIVERGENT checkpoint state "
+        "(per-process [loaded, round, id_crc32, b_lo, b_hi] = "
+        f"{all_fps.tolist()}). "
+        "All processes must read the same checkpoint file — use a shared "
+        "filesystem or stage identical copies before restarting."
+    )
+
+
+def _verify_resume_agreement(loaded: bool, start_round: int, prev_ids,
+                             b: float) -> None:
+    """Cross-process agreement check for resume=True (no-op single-process).
+
+    Gathers every process's checkpoint fingerprint and raises before any
+    round collective is launched if they disagree — turning the silent
+    distributed deadlock/garbage of a partial resume into an immediate,
+    explained error."""
+    if jax.process_count() == 1:
+        return
+    from jax.experimental import multihost_utils
+
+    fp = _resume_fingerprint(loaded, start_round, prev_ids, b)
+    all_fps = np.asarray(multihost_utils.process_allgather(fp))
+    _check_resume_fingerprints(all_fps)
+
+
 def _squeeze(tree):
     return jax.tree.map(lambda x: x[0], tree)
 
@@ -376,7 +448,8 @@ def cascade_fit(
     if resume and checkpoint_path is not None:
         import os
 
-        if os.path.exists(checkpoint_path):
+        ckpt_loaded = os.path.exists(checkpoint_path)
+        if ckpt_loaded:
             global_sv, prev_ids, start_round, b = load_round_state(
                 checkpoint_path, dtype
             )
@@ -399,6 +472,10 @@ def cascade_fit(
                     RuntimeWarning,
                     stacklevel=2,
                 )
+        # multi-host: fail fast (before any collective) if the processes
+        # did not all load the same state — ADVICE r3 medium; see
+        # _check_resume_fingerprints for the shared-filesystem requirement
+        _verify_resume_agreement(ckpt_loaded, start_round, prev_ids, b)
 
     # fallback result if the loop body never runs (resumed past max_rounds)
     new_global = jax.tree.map(np.asarray, global_sv)

@@ -18,6 +18,17 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 CASCADE_AXIS = "cascade"
 
 
+def require_1d_mesh(mesh: Mesh, what: str) -> None:
+    """Raise unless mesh has exactly one axis. Callers that pad/shard by
+    mesh.devices.size along axis 0 rely on the two agreeing, which only a
+    1-D mesh guarantees."""
+    if len(mesh.axis_names) != 1:
+        raise ValueError(
+            f"{what} requires a 1-D mesh; got axes {mesh.axis_names} "
+            f"with shape {dict(mesh.shape)}"
+        )
+
+
 def make_mesh(
     n_shards: Optional[int] = None,
     devices: Optional[Sequence[jax.Device]] = None,
@@ -64,6 +75,7 @@ def shard_rows_padded(mesh: Optional[Mesh], X):
     n = X.shape[0]
     if mesh is None:
         return X, n
+    require_1d_mesh(mesh, "shard_rows_padded")
     pad = (-n) % mesh.devices.size
     if pad:
         X = jnp.concatenate(
