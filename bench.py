@@ -450,6 +450,22 @@ def main():
             ).compile()
     log(f"compile: {time.perf_counter() - t0:.1f}s")
 
+    # Effective config via the solver's own resolution rules (the shared
+    # helper blocked_smo_solve itself resolves through), computed from the
+    # FINAL static_kwargs — after any canary/compile fallback — so a
+    # degraded record is self-describing: wss=2 silently degrades to 1 on
+    # the XLA engine, and selection='auto' resolves by backend (approx on
+    # TPU, exact elsewhere) — both show up here, not just as stderr text.
+    from tpusvm.solver.blocked import resolve_solver_config
+
+    eff_q, eff_inner, eff_wss, eff_selection = resolve_solver_config(
+        Xd.shape[0],
+        q=static_kwargs["q"],
+        inner=static_kwargs.get("inner", "auto"),
+        wss=static_kwargs.get("wss", 1),
+        selection=static_kwargs.get("selection", "auto"),
+    )
+
     # Force the H2D transfer of X/Y to COMPLETE before the timed region
     # (benchmarks.common.h2d_sync). The 188MB X upload otherwise lands
     # inside the first executable invocation and adds ~6.5s of development
@@ -524,6 +540,20 @@ def main():
                     # which inner engine actually ran: "pallas-packed"
                     # (the tuned config), "pallas-flat", or "xla"
                     "engine": engine,
+                    # the EFFECTIVE solver config this measurement ran
+                    # (resolve_solver_config on the final static_kwargs):
+                    # requested knobs can resolve differently — wss=2
+                    # degrades to 1 on the XLA engine; selection='auto'
+                    # resolves by backend — and a record must say what
+                    # actually ran
+                    "solver_config": {
+                        "q": eff_q,
+                        "inner": eff_inner,
+                        "wss": eff_wss,
+                        "selection": eff_selection,
+                        "max_inner": static_kwargs["max_inner"],
+                        "max_outer": static_kwargs["max_outer"],
+                    },
                     # True: the engine above was canary-vetted (or is the
                     # reference XLA engine); False: the canary harness
                     # crashed and the engine ran UNVETTED; null: non-TPU

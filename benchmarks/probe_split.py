@@ -26,7 +26,10 @@ import jax.numpy as jnp  # noqa: E402
 import numpy as np  # noqa: E402
 
 from tpusvm.data import MinMaxScaler, mnist_like  # noqa: E402
-from tpusvm.solver.blocked import blocked_smo_solve  # noqa: E402
+from tpusvm.solver.blocked import (  # noqa: E402
+    blocked_smo_solve,
+    resolve_solver_config,
+)
 
 q, max_inner, max_outer = (int(a) for a in sys.argv[1:4])
 wss = int(sys.argv[4]) if len(sys.argv) > 4 else 1
@@ -66,9 +69,16 @@ out = (int(np.asarray(r.n_outer)), int(np.asarray(r.n_iter)) - 1,
        int(np.asarray(r.status)))
 t1 = time.perf_counter()
 n_sv = int((np.asarray(r.alpha) > 1e-8).sum())
+# effective config via the solver's own resolution rules, so a row records
+# what actually ran (requested wss/selection degrade on the XLA engine)
+q_eff, inner_eff, wss_eff, selection_eff = resolve_solver_config(
+    Xd.shape[0], q=q, wss=wss, selection=selection)
 print(json.dumps({"q": q, "max_inner": max_inner, "wss": wss,
                   "precision": precision, "refine": refine,
                   "selection": selection, "fused": fused,
+                  "q_eff": q_eff, "inner_eff": inner_eff,
+                  "wss_eff": wss_eff, "selection_eff": selection_eff,
+                  "platform": jax.default_backend(),
                   "outers": out[0], "updates": out[1], "status": out[2],
                   "n_sv": n_sv, "b": float(np.asarray(r.b)),
                   "time_s": round(t1 - t0, 4)}))

@@ -75,6 +75,18 @@ def test_bench_plain_cpu_uses_xla_engine(bench_mod):
     assert d["compile_fallback"] is None
     assert d["canary_passed"] is None  # non-TPU: canary not applicable
     assert d["init_fallback"] is None
+    # VERDICT r3: a degraded record must carry the EFFECTIVE solver
+    # config — on CPU the requested q=2048/wss=2/selection=auto resolve
+    # to q=n and wss=1 on the XLA engine, with selection=exact (the
+    # non-TPU resolution of 'auto')
+    assert d["solver_config"] == {
+        "q": 512,  # clamped to the shrunken fixture's n
+        "inner": "xla",
+        "wss": 1,
+        "selection": "exact",
+        "max_inner": 4096,
+        "max_outer": 5000,
+    }
 
 
 @pytest.mark.filterwarnings(

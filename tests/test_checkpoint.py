@@ -132,6 +132,14 @@ def test_resume_agreement_check(tmp_path):
     with pytest.raises(RuntimeError, match="DIVERGENT"):
         _check_resume_fingerprints(np.stack([ok, divergent]))
 
+    # a load FAILURE travels through the gather as status=2 (raising
+    # locally before the gather would hang the peers) and every process
+    # raises naming the failing one
+    failed = _resume_fingerprint(2, 1, set(), 0.0)
+    with pytest.raises(RuntimeError,
+                       match=r"failed to load on processes \[1\]"):
+        _check_resume_fingerprints(np.stack([ok, failed]))
+
     # single-process: the in-run check is a no-op (covers the plain-resume
     # tests above passing through it)
     _verify_resume_agreement(True, 2, {7, 9}, 0.5)

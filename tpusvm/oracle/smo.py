@@ -200,11 +200,35 @@ def predict(
     gamma: float,
     sv_tol: float = 1e-8,
 ) -> np.ndarray:
-    """sign(sum_{k in SV} a_k y_k K(x, x_k) - b), strict >0 -> +1 (main3.cpp:391-402)."""
+    """sign(sum_{k in SV} a_k y_k K(x, x_k) - b), strict >0 -> +1 (main3.cpp:391-402).
+
+    Vectorised blockwise (VERDICT r3 #6: the per-row Python loop made
+    mid-scale parity runs needlessly slow): squared distances via the
+    norms+dot identity ||x-z||^2 = ||x||^2 + ||z||^2 - 2 x.z in float64,
+    clamped at 0 — the same formulation as the framework's device kernels
+    (ops/rbf.py), here with f64 accumulation so cancellation stays at the
+    1e-12 level. The decision rule (strict >0 -> +1) is unchanged; scores
+    can move by ~1ulp vs the old per-row diff loop, which only matters on
+    an exactly-zero margin (measure zero on real data). Memory is bounded
+    by blocking the test rows (~2e7 kernel entries per block)."""
     sv = get_sv_indices(alpha, sv_tol)
-    coef = alpha[sv] * Y_train[sv]
+    Xsv = np.asarray(X_train, np.float64)[sv]
+    coef = np.asarray(alpha, np.float64)[sv] * np.asarray(Y_train)[sv]
     preds = np.empty(len(X_test), np.int32)
-    for i in range(len(X_test)):
-        k = rbf_row(X_train[sv], X_test[i], gamma)
-        preds[i] = 1 if float(coef @ k) - b > 0 else -1
+    m = len(sv)
+    if m == 0:
+        preds[:] = 1 if -b > 0 else -1  # empty SV sum: score = -b
+        return preds
+    sv_sq = np.einsum("kj,kj->k", Xsv, Xsv)
+    block = max(1, int(2e7) // m)
+    for s0 in range(0, len(X_test), block):
+        # cast per block so a huge f32 test set is never duplicated whole
+        B = np.asarray(X_test[s0:s0 + block], np.float64)
+        d2 = (
+            np.einsum("ij,ij->i", B, B)[:, None]
+            + sv_sq[None, :]
+            - 2.0 * (B @ Xsv.T)
+        )
+        scores = np.exp(-gamma * np.maximum(d2, 0.0)) @ coef - b
+        preds[s0:s0 + block] = np.where(scores > 0, 1, -1)
     return preds

@@ -124,10 +124,11 @@ def load_round_state(path: str, dtype=jnp.float32):
         )
 
 
-def _resume_fingerprint(loaded: bool, start_round: int, prev_ids,
+def _resume_fingerprint(status, start_round: int, prev_ids,
                         b: float) -> np.ndarray:
     """Compact per-process summary of the loaded checkpoint state:
-    [loaded?, next round, CRC of the sorted SV-ID set, b bits lo, b bits hi].
+    [status, next round, CRC of the sorted SV-ID set, b bits lo, b bits hi]
+    with status 0 = file missing, 1 = loaded, 2 = load failed.
     Identical checkpoints produce identical fingerprints; any divergence
     (missing file on one host, different round, different SV set) differs
     in at least one field. uint32 fields so the cross-process gather is
@@ -138,7 +139,7 @@ def _resume_fingerprint(loaded: bool, start_round: int, prev_ids,
     b_bits = int(np.float64(b).view(np.uint64))
     return np.array(
         [
-            int(bool(loaded)),
+            int(status),
             start_round,
             zlib.crc32(ids.tobytes()),
             b_bits & 0xFFFFFFFF,
@@ -158,10 +159,25 @@ def _check_resume_fingerprints(all_fps: np.ndarray) -> None:
     lacks the checkpoint file) starts fresh at round 1 is a distributed
     deadlock, not a recoverable skew. Checkpoint/resume on a multi-host
     cluster therefore REQUIRES checkpoint_path on a shared filesystem (or
-    an identical copy staged to every host before restart)."""
+    an identical copy staged to every host before restart).
+
+    A local load FAILURE (stale shapes, corrupt file) is folded into the
+    fingerprint as status=2 rather than raised before the gather — raising
+    early on one process would leave the others blocked inside
+    process_allgather forever, the very hang this check exists to
+    prevent."""
+    status = all_fps[:, 0]
+    if (status == 2).any():
+        bad = np.nonzero(status == 2)[0].tolist()
+        raise RuntimeError(
+            "cascade resume: checkpoint failed to load on processes "
+            f"{bad} (stale shapes or corrupt file); see that process's "
+            "chained error. All processes must be restarted with a valid, "
+            "identical checkpoint."
+        )
     if (all_fps == all_fps[0]).all():
         return
-    loaded = all_fps[:, 0].astype(bool)
+    loaded = status.astype(bool)
     if loaded.any() and not loaded.all():
         missing = np.nonzero(~loaded)[0].tolist()
         raise RuntimeError(
@@ -172,28 +188,34 @@ def _check_resume_fingerprints(all_fps: np.ndarray) -> None:
         )
     raise RuntimeError(
         "cascade resume: processes loaded DIVERGENT checkpoint state "
-        "(per-process [loaded, round, id_crc32, b_lo, b_hi] = "
+        "(per-process [status, round, id_crc32, b_lo, b_hi] = "
         f"{all_fps.tolist()}). "
         "All processes must read the same checkpoint file — use a shared "
         "filesystem or stage identical copies before restarting."
     )
 
 
-def _verify_resume_agreement(loaded: bool, start_round: int, prev_ids,
-                             b: float) -> None:
+def _verify_resume_agreement(status, start_round: int, prev_ids,
+                             b: float, load_err=None) -> None:
     """Cross-process agreement check for resume=True (no-op single-process).
 
     Gathers every process's checkpoint fingerprint and raises before any
     round collective is launched if they disagree — turning the silent
     distributed deadlock/garbage of a partial resume into an immediate,
-    explained error."""
+    explained error. load_err: the local load failure (if any), chained
+    onto the raised error so the failing process reports its real cause."""
     if jax.process_count() == 1:
         return
     from jax.experimental import multihost_utils
 
-    fp = _resume_fingerprint(loaded, start_round, prev_ids, b)
+    fp = _resume_fingerprint(status, start_round, prev_ids, b)
     all_fps = np.asarray(multihost_utils.process_allgather(fp))
-    _check_resume_fingerprints(all_fps)
+    try:
+        _check_resume_fingerprints(all_fps)
+    except RuntimeError as e:
+        if load_err is not None:
+            raise e from load_err
+        raise
 
 
 def _squeeze(tree):
@@ -448,17 +470,32 @@ def cascade_fit(
     if resume and checkpoint_path is not None:
         import os
 
-        ckpt_loaded = os.path.exists(checkpoint_path)
-        if ckpt_loaded:
-            global_sv, prev_ids, start_round, b = load_round_state(
-                checkpoint_path, dtype
-            )
-            if global_sv.capacity != sv_cap or global_sv.X.shape[1] != d:
-                raise ValueError(
-                    "cascade checkpoint shapes do not match this run: "
-                    f"capacity {global_sv.capacity} vs {sv_cap}, "
-                    f"d {global_sv.X.shape[1]} vs {d}"
+        ckpt_status = 1 if os.path.exists(checkpoint_path) else 0
+        load_err = None
+        if ckpt_status:
+            # a load failure must NOT raise before the agreement gather
+            # below: peers would block in process_allgather forever —
+            # fold it into the fingerprint (status=2) and raise after
+            try:
+                global_sv, prev_ids, start_round, b = load_round_state(
+                    checkpoint_path, dtype
                 )
+                if global_sv.capacity != sv_cap or global_sv.X.shape[1] != d:
+                    raise ValueError(
+                        "cascade checkpoint shapes do not match this run: "
+                        f"capacity {global_sv.capacity} vs {sv_cap}, "
+                        f"d {global_sv.X.shape[1]} vs {d}"
+                    )
+            except Exception as e:  # noqa: BLE001 — re-raised below
+                ckpt_status, load_err = 2, e
+        # multi-host: fail fast (before any round collective) if the
+        # processes did not all load the same state — ADVICE r3 medium;
+        # see _check_resume_fingerprints for the shared-fs requirement
+        _verify_resume_agreement(ckpt_status, start_round, prev_ids, b,
+                                 load_err)
+        if load_err is not None:
+            raise load_err
+        if ckpt_status == 1:
             if verbose:
                 print(f"resuming cascade from round {start_round} "
                       f"({len(prev_ids)} SVs in checkpoint)")
@@ -472,10 +509,6 @@ def cascade_fit(
                     RuntimeWarning,
                     stacklevel=2,
                 )
-        # multi-host: fail fast (before any collective) if the processes
-        # did not all load the same state — ADVICE r3 medium; see
-        # _check_resume_fingerprints for the shared-filesystem requirement
-        _verify_resume_agreement(ckpt_loaded, start_round, prev_ids, b)
 
     # fallback result if the loop body never runs (resumed past max_rounds)
     new_global = jax.tree.map(np.asarray, global_sv)
