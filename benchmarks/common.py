@@ -39,6 +39,25 @@ CASCADE_TRAIN_S = {  # (topology, P) -> seconds, B4-B13, 2x32-core nodes
 SERIAL_TRAIN_S = 3285.662  # B1
 
 
+def pin_platform(env_var: str = "TPUSVM_PROBE_PLATFORM") -> None:
+    """Pin the JAX backend from an env var, BEFORE backend init.
+
+    TPUSVM_PROBE_PLATFORM=cpu selects the CPU backend for harness runs when
+    the accelerator is unavailable (or to use the simulated multi-device
+    mesh via XLA_FLAGS=--xla_force_host_platform_device_count=N). The
+    env-var JAX_PLATFORMS route does NOT work in this environment —
+    sitecustomize force-registers the accelerator plugin and sets
+    jax_platforms programmatically, overriding it; only a later
+    jax.config.update wins. Call this before any jax.numpy/device use."""
+    import os
+
+    import jax
+
+    platform = os.environ.get(env_var)
+    if platform:
+        jax.config.update("jax_platforms", platform)
+
+
 def log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
 

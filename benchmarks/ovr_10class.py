@@ -28,7 +28,9 @@ jax.config.update("jax_enable_x64", True)
 
 import numpy as np  # noqa: E402
 
-from benchmarks.common import emit, log  # noqa: E402
+from benchmarks.common import emit, log, pin_platform  # noqa: E402
+
+pin_platform()  # TPUSVM_PROBE_PLATFORM=cpu -> CPU backend (see helper)
 
 
 def main(argv=None) -> int:
@@ -118,6 +120,12 @@ def main(argv=None) -> int:
         "accuracy": round(float((yp == yte).mean()), 4),
         "n_sv_union": int(model.X_sv_.shape[0]),
         "class_parallel": args.class_parallel,
+        # the mesh fit() actually trained over (class_parallel only):
+        # axes/shape say the effective process geometry of this row
+        "mesh": (
+            {k: v for k, v in model.class_mesh_.items() if k != "devices"}
+            if model.class_mesh_ else None
+        ),
         "statuses": [Status(int(s)).name for s in model.statuses_],
         "platform": jax.devices()[0].platform,
     })

@@ -15,12 +15,10 @@ sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 import jax
 
 jax.config.update("jax_enable_x64", True)
-# TPUSVM_PROBE_PLATFORM=cpu pins the CPU backend BEFORE init (the env-var
-# JAX_PLATFORMS route is overridden by sitecustomize in this environment) —
-# used to tune the benchmark's degraded-CPU-fallback configuration when the
-# accelerator is unavailable
-if os.environ.get("TPUSVM_PROBE_PLATFORM"):
-    jax.config.update("jax_platforms", os.environ["TPUSVM_PROBE_PLATFORM"])
+
+from benchmarks.common import pin_platform  # noqa: E402
+
+pin_platform()  # TPUSVM_PROBE_PLATFORM=cpu -> CPU backend (see helper)
 
 import jax.numpy as jnp  # noqa: E402
 import numpy as np  # noqa: E402

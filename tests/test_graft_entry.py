@@ -42,10 +42,12 @@ def test_dryrun_multichip_direct_provisioning():
         timeout=300,
     )
     assert r.returncode == 0, r.stderr[-2000:]
-    # both merge topologies must appear in the driver artifact, each
-    # having run its multi-round convergence loop
+    # the full topology x solver 2x2 must appear in the driver artifact
+    # (VERDICT r3 #5), each combination having run its multi-round
+    # convergence loop
     assert "dryrun_multichip OK" in r.stdout
-    assert "tree OK" in r.stdout and "star OK" in r.stdout
+    for combo in ("tree+blocked", "tree+pair", "star+blocked", "star+pair"):
+        assert f"{combo} OK" in r.stdout, r.stdout
     assert "rounds" in r.stdout
 
 
@@ -63,8 +65,8 @@ def test_dryrun_multichip_non_power_of_two_runs_star_only():
         timeout=300,
     )
     assert r.returncode == 0, r.stderr[-2000:]
-    assert "star OK" in r.stdout
-    assert "tree OK" not in r.stdout
+    assert "star+blocked OK" in r.stdout and "star+pair OK" in r.stdout
+    assert "tree+" not in r.stdout
 
 
 def test_dryrun_multichip_after_backend_init():

