@@ -294,25 +294,39 @@ def main():
     Xd = jax.device_put(jnp.asarray(Xs))
     Yd = jax.device_put(jnp.asarray(Y))
 
-    traced_kwargs = dict(C=10.0, gamma=0.00125, eps=1e-12, tau=1e-5)
+    # max_iter is a SAFETY bound, not part of the stopping rule (the
+    # reference iterates until the Keerthi criterion with no update cap);
+    # the deeper CPU-fallback inner budget below legitimately spends ~146k
+    # updates, so the old 100k default would truncate a converging run
+    traced_kwargs = dict(C=10.0, gamma=0.00125, eps=1e-12, tau=1e-5,
+                         max_iter=10**6)
+    on_tpu = devices[0].platform == "tpu"
     # q/max_inner/wss tuned with benchmarks/probe_split.py on this workload;
-    # wss=2 = second-order partner selection in the fused inner kernel
-    # (same stopping rule, ~25% fewer updates than first-order).
-    # max_inner=4096 (deeper subproblems per K-block) measured ~11% faster
-    # than 2048 — fewer O(n*d*q) outer passes buy more cheap VMEM updates;
-    # 8192 was flat vs 4096 (over-optimising stale subproblems). Grid +
-    # pick rationale: benchmarks/results/probe_split_tpu_v5e.jsonl and its
-    # README row (q=1536 probed 3% faster once but with 21% more inner
-    # updates — inside noise, more latency exposure; not adopted).
+    # wss=2 = second-order partner selection — implemented by BOTH inner
+    # engines since round 4 (same stopping rule, ~25% fewer updates than
+    # first-order on TPU, 23% on CPU).
+    # max_inner is platform-conditional because the engines price inner
+    # updates differently:
+    #   - TPU (pallas kernel): 4096 measured ~11% faster than 2048; 8192
+    #     was flat (over-optimising stale subproblems costs kernel time).
+    #     Grid: benchmarks/results/probe_split_tpu_v5e.jsonl and its README
+    #     row (q=1536 probed 3% faster once but with 21% more inner
+    #     updates — inside noise, more latency exposure; not adopted).
+    #   - CPU fallback (XLA loop): the O(n*d*q) outer contraction dominates
+    #     on one core, so deeper subproblems that cut outer rounds win even
+    #     at +90% updates: wss=2 grid (probe_cpu_fallback.jsonl round-4
+    #     rows) measured 4096=38.5s / 8192=29.2s / 16384=27.0s /
+    #     32768=24.0s (9 outers) in-session vs 47.2s for the round-3
+    #     wss=1/4096 config — 2.0x, and 2.4x the reference GPU's 58.57s.
     # matmul_precision="default" (bf16 MXU passes) was evaluated and NOT
     # adopted: a CPU-emulated drift study (bf16-quantised inputs) converged
     # to the identical SV set but needed ~1.8x the outer rounds + all its
     # refine budget — roughly a wash net of the ~3x matmul speedup, with a
     # weaker convergence guarantee. It remains an opt-in
     # (tpusvm/solver/blocked.py matmul_precision).
-    static_kwargs = dict(q=2048, max_outer=5000, max_inner=4096, wss=2,
+    static_kwargs = dict(q=2048, max_outer=5000,
+                         max_inner=4096 if on_tpu else 32768, wss=2,
                          accum_dtype=jnp.float64)
-    on_tpu = devices[0].platform == "tpu"
     # Tiny-shape kernel canary BEFORE the heavy compile (TPU only — off
     # TPU the solver's inner='auto' resolves to the XLA engine and the
     # canary could not affect the run): a Mosaic regression that compiles
@@ -384,7 +398,9 @@ def main():
             if picked is None:
                 log("WARNING: no kernel layout passed the canary; using "
                     "the XLA inner engine")
-                static_kwargs = dict(static_kwargs, inner="xla", wss=1)
+                # wss=2 stays: the XLA loop implements the same
+                # second-order selection as the kernel (round 4)
+                static_kwargs = dict(static_kwargs, inner="xla")
                 engine = "xla"
                 canary_passed = True  # the engine that runs IS vetted
             else:
@@ -442,8 +458,8 @@ def main():
                 log(f"WARNING: flat-layout kernel also failed. Full "
                     f"error:\n{e2_full}")
                 fallback = f"{fallback} | {e2_full[:300]}"
-            log("WARNING: falling back to inner='xla', wss=1")
-            static_kwargs = dict(static_kwargs, inner="xla", wss=1)
+            log("WARNING: falling back to inner='xla' (wss=2 retained)")
+            static_kwargs = dict(static_kwargs, inner="xla")
             engine = "xla"
             compiled = blocked_smo_solve.lower(
                 Xd, Yd, **traced_kwargs, **static_kwargs
@@ -453,9 +469,9 @@ def main():
     # Effective config via the solver's own resolution rules (the shared
     # helper blocked_smo_solve itself resolves through), computed from the
     # FINAL static_kwargs — after any canary/compile fallback — so a
-    # degraded record is self-describing: wss=2 silently degrades to 1 on
-    # the XLA engine, and selection='auto' resolves by backend (approx on
-    # TPU, exact elsewhere) — both show up here, not just as stderr text.
+    # degraded record is self-describing: selection='auto' resolves by
+    # backend (approx on TPU, exact elsewhere) and any canary/compile
+    # fallback's engine change shows up here, not just as stderr text.
     from tpusvm.solver.blocked import resolve_solver_config
 
     eff_q, eff_inner, eff_wss, eff_selection = resolve_solver_config(
@@ -542,10 +558,9 @@ def main():
                     "engine": engine,
                     # the EFFECTIVE solver config this measurement ran
                     # (resolve_solver_config on the final static_kwargs):
-                    # requested knobs can resolve differently — wss=2
-                    # degrades to 1 on the XLA engine; selection='auto'
-                    # resolves by backend — and a record must say what
-                    # actually ran
+                    # requested knobs can resolve differently — q clamps
+                    # to n, selection='auto' resolves by backend — and a
+                    # record must say what actually ran
                     "solver_config": {
                         "q": eff_q,
                         "inner": eff_inner,

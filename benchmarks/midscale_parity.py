@@ -18,6 +18,10 @@ Engines compared against the float64 NumPy oracle (tpusvm.oracle.smo):
                     (resolve_solver_config resolves selection='auto' to
                     approx on TPU), forced on explicitly so the CPU run
                     exercises the same code path
+  - blocked-{exact,approx}-wss2: ditto with second-order (maximal-gain)
+                    partner selection — the wss=2 path every headline
+                    benchmark ships (bench.py), on the XLA engine since
+                    round 4
 
 Usage: python benchmarks/midscale_parity.py [n ...]   (default: 2048 4096)
 Emits one JSON line per (n, engine) with n_sv / b / accuracy / timings and
@@ -132,21 +136,22 @@ def run_size(n: int):
     # --- blocked solver, production precision, exact + approx selection ---
     rows = {"oracle": (sv_o, o.b, acc_o),
             "pair-f64": (sv_j, float(j.b), acc_j)}
-    for selection in ("exact", "approx"):
+    for selection, wss in (("exact", 1), ("approx", 1),
+                           ("exact", 2), ("approx", 2)):
         q_eff, inner_eff, wss_eff, sel_eff = resolve_solver_config(
-            n, q=1024, inner="xla", selection=selection)
+            n, q=1024, inner="xla", wss=wss, selection=selection)
         t0 = time.perf_counter()
         r = blocked_smo_solve(
             jnp.asarray(Xs, jnp.float32), jnp.asarray(Y), C=CFG.C,
             gamma=CFG.gamma, eps=CFG.eps, tau=CFG.tau,
             max_iter=CFG.max_iter,
-            q=1024, max_inner=4096, max_outer=5000, inner="xla",
+            q=1024, max_inner=4096, max_outer=5000, inner="xla", wss=wss,
             selection=selection, accum_dtype=jnp.float64)
         a_r = np.asarray(r.alpha)
         r_s = time.perf_counter() - t0
         sv_r = get_sv_indices(a_r)
         acc_r = _accuracy(a_r, float(r.b), jnp.float32)
-        name = f"blocked-{selection}"
+        name = f"blocked-{selection}" + ("-wss2" if wss == 2 else "")
         _row(n, name, r.status, len(sv_r), float(r.b), acc_r, r_s, sv_r,
              {"updates": int(r.n_iter), "n_outer": int(r.n_outer),
               "solver_config": {"q": q_eff, "inner": inner_eff,

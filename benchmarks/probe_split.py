@@ -32,6 +32,8 @@ from tpusvm.solver.blocked import (  # noqa: E402
 q, max_inner, max_outer = (int(a) for a in sys.argv[1:4])
 wss = int(sys.argv[4]) if len(sys.argv) > 4 else 1
 precision = sys.argv[5] if len(sys.argv) > 5 else None
+if precision in ("", "none", "None"):
+    precision = None  # lets later positional args be passed explicitly
 refine = int(sys.argv[6]) if len(sys.argv) > 6 else 0
 selection = sys.argv[7] if len(sys.argv) > 7 else "auto"
 fused = len(sys.argv) > 8 and sys.argv[8] in ("1", "fused", "true")
@@ -68,7 +70,7 @@ out = (int(np.asarray(r.n_outer)), int(np.asarray(r.n_iter)) - 1,
 t1 = time.perf_counter()
 n_sv = int((np.asarray(r.alpha) > 1e-8).sum())
 # effective config via the solver's own resolution rules, so a row records
-# what actually ran (requested wss/selection degrade on the XLA engine)
+# what actually ran (q clamps to n; selection='auto' resolves by backend)
 q_eff, inner_eff, wss_eff, selection_eff = resolve_solver_config(
     Xd.shape[0], q=q, wss=wss, selection=selection)
 print(json.dumps({"q": q, "max_inner": max_inner, "wss": wss,

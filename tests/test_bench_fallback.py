@@ -64,11 +64,6 @@ def fake_tpu(monkeypatch, bench_mod):
     return ism.inner_smo_pallas
 
 
-@pytest.mark.filterwarnings(
-    # off TPU, bench's tuned wss=2 degrades to first-order on the XLA
-    # engine with this warning — the documented off-TPU behaviour
-    "ignore:wss=2 requested:RuntimeWarning"
-)
 def test_bench_plain_cpu_uses_xla_engine(bench_mod):
     d = _run(bench_mod)
     assert d["engine"] == "xla"
@@ -76,26 +71,20 @@ def test_bench_plain_cpu_uses_xla_engine(bench_mod):
     assert d["canary_passed"] is None  # non-TPU: canary not applicable
     assert d["init_fallback"] is None
     # VERDICT r3: a degraded record must carry the EFFECTIVE solver
-    # config — on CPU the requested q=2048/wss=2/selection=auto resolve
-    # to q=n and wss=1 on the XLA engine, with selection=exact (the
-    # non-TPU resolution of 'auto')
+    # config — on CPU the requested q=2048/selection=auto resolve to q=n
+    # and selection=exact (the non-TPU resolution of 'auto'); wss=2 runs
+    # as requested since the XLA engine implements second-order selection
+    # (round 4)
     assert d["solver_config"] == {
         "q": 512,  # clamped to the shrunken fixture's n
         "inner": "xla",
-        "wss": 1,
+        "wss": 2,
         "selection": "exact",
-        "max_inner": 4096,
+        "max_inner": 32768,  # the deeper CPU-fallback inner budget
         "max_outer": 5000,
     }
 
 
-@pytest.mark.filterwarnings(
-    # the faked TPU platform makes the canary run while the real backend
-    # is CPU, so the heavy solve's inner='auto' resolves to the XLA
-    # engine and the requested wss=2 legitimately degrades with this
-    # warning — expected for this fault-injection setup only
-    "ignore:wss=2 requested:RuntimeWarning"
-)
 def test_bench_canary_packed_fault_selects_flat(bench_mod, fake_tpu,
                                                 monkeypatch):
     orig = fake_tpu
@@ -113,9 +102,6 @@ def test_bench_canary_packed_fault_selects_flat(bench_mod, fake_tpu,
     assert d["canary_passed"] is True  # flat WAS vetted
 
 
-@pytest.mark.filterwarnings(
-    "ignore:wss=2 requested:RuntimeWarning"  # see sibling test
-)
 def test_bench_canary_total_fault_degrades_to_xla(bench_mod, fake_tpu,
                                                   monkeypatch):
     def broken_all(*a, **kw):
@@ -131,9 +117,6 @@ def test_bench_canary_total_fault_degrades_to_xla(bench_mod, fake_tpu,
     assert d["canary_passed"] is True
 
 
-@pytest.mark.filterwarnings(
-    "ignore:wss=2 requested:RuntimeWarning"  # see sibling test
-)
 def test_bench_canary_harness_crash_marks_unvetted(bench_mod, fake_tpu,
                                                    monkeypatch):
     import tpusvm.solver.blocked as blocked_mod

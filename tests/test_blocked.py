@@ -189,25 +189,48 @@ def test_blocked_rejects_bad_wss():
         blocked_smo_solve(X, Y, inner="xla", wss=7)
 
 
-def test_blocked_wss2_rejects_explicit_xla():
-    # the XLA engine is first-order only: wss=2 must not silently degrade
-    X = jnp.zeros((16, 4), jnp.float32)
-    Y = jnp.asarray([1, -1] * 8, jnp.int32)
-    with pytest.raises(ValueError, match="first-order"):
-        blocked_smo_solve(X, Y, inner="xla", wss=2)
+def test_blocked_wss2_xla_same_optimum_fewer_updates():
+    """The XLA engine's second-order partner selection (round 4: same
+    maximal-gain math as the pallas kernel) reaches the same optimum as
+    first-order, in fewer or equal updates — the whole point of wss=2."""
+    Xs, Y = _data(rings, n=512, seed=5)
+    kw = dict(C=10.0, gamma=10.0, tau=1e-5, q=64, max_inner=256,
+              inner="xla", accum_dtype=jnp.float64)
+    r1 = blocked_smo_solve(jnp.asarray(Xs), jnp.asarray(Y), **kw, wss=1)
+    r2 = blocked_smo_solve(jnp.asarray(Xs), jnp.asarray(Y), **kw, wss=2)
+    assert int(r1.status) == Status.CONVERGED
+    assert int(r2.status) == Status.CONVERGED
+    assert int(r2.n_iter) <= int(r1.n_iter)
+    sv1 = set(np.flatnonzero(np.asarray(r1.alpha) > 1e-8))
+    sv2 = set(np.flatnonzero(np.asarray(r2.alpha) > 1e-8))
+    # different trajectories stop anywhere inside the 2*tau band: allow
+    # tau-level boundary flips, same standard as the cross-engine tests
+    assert len(sv1 ^ sv2) <= max(2, len(sv1) // 25)
+    np.testing.assert_allclose(float(r2.b), float(r1.b), atol=1e-3)
 
 
-def test_blocked_wss2_warns_on_auto_xla_fallback():
-    # q=32 is below the 128-lane pallas alignment, so inner='auto' resolves
-    # to xla on every backend: warn that the requested second-order
-    # selection is falling back to first-order
-    Xs, Y = _data(blobs, n=64, seed=1)
-    with pytest.warns(RuntimeWarning, match="first-order"):
-        r = blocked_smo_solve(
-            jnp.asarray(Xs), jnp.asarray(Y), C=1.0, gamma=0.125, q=32,
-            inner="auto", wss=2,
-        )
-    assert int(r.status) == Status.CONVERGED
+def test_blocked_wss2_xla_matches_pallas_interpret_trajectory():
+    """Both engines implement the SAME wss=2 selection rule: on identical
+    subproblem inputs the XLA loop and the (interpreted) pallas kernel
+    must produce the same alpha trajectory to f32 resolution."""
+    from tpusvm.ops.pallas.inner_smo import inner_smo_pallas
+    from tpusvm.solver.blocked import _inner_smo
+    from tpusvm.ops.rbf import rbf_cross
+
+    rng = np.random.default_rng(3)
+    qq = 128
+    Xb = jnp.asarray(rng.random((qq, 6)), jnp.float32)
+    y = jnp.asarray(np.where(rng.random(qq) < 0.5, 1, -1), jnp.int32)
+    K = rbf_cross(Xb, Xb, 1.5)
+    a0 = jnp.zeros(qq, jnp.float32)
+    f0 = -y.astype(jnp.float32)
+    act = jnp.ones(qq, bool)
+    a_x = np.asarray(_inner_smo(K, y, a0, f0, act, 10.0, 1e-12, 1e-5,
+                                64, wss=2)[0])
+    a_p = np.asarray(inner_smo_pallas(K, y, a0, f0, act, 10.0, 1e-12,
+                                      1e-5, max_inner=64, interpret=True,
+                                      wss=2)[0])
+    np.testing.assert_allclose(a_p, a_x, atol=1e-3)
 
 
 def test_blocked_selection_approx_same_optimum():
@@ -268,18 +291,19 @@ def test_blocked_fused_fupdate_rejects_reduced_precision():
 def test_resolve_solver_config_matches_solver_behavior():
     """The shared resolution helper (what benchmarks record per-row) must
     mirror the solver's actual rules: q clamps to even n, inner='auto' is
-    XLA off-TPU, selection='auto' is exact off-TPU, and wss degrades to
-    first-order whenever the XLA engine runs (ADVICE r2)."""
+    XLA off-TPU, selection='auto' is exact off-TPU, and wss passes
+    through unchanged now that BOTH engines implement second-order
+    selection (round 4; the ADVICE-r2 degradation rule is gone)."""
     from tpusvm.solver.blocked import resolve_solver_config
 
     # q clamp: odd n drops to n-1; tiny n floors at 2
     assert resolve_solver_config(385, 1024)[0] == 384
     assert resolve_solver_config(384, 128)[0] == 128
     assert resolve_solver_config(1, 128)[0] == 2
-    # this suite runs on CPU: auto resolves to (xla, exact), wss degrades
+    # this suite runs on CPU: auto resolves to (xla, exact); wss survives
     q, inner, wss, selection = resolve_solver_config(
         60000, 2048, inner="auto", wss=2, selection="auto")
-    assert (q, inner, wss, selection) == (2048, "xla", 1, "exact")
+    assert (q, inner, wss, selection) == (2048, "xla", 2, "exact")
     # explicit engine/selection pass through; wss=2 survives on pallas
     _, inner, wss, _ = resolve_solver_config(
         60000, 2048, inner="pallas", wss=2, selection="approx")

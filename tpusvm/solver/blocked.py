@@ -57,15 +57,16 @@ def resolve_solver_config(n: int, q: int = 1024, inner: str = "auto",
 
     The single source of truth for the solver's config-resolution rules —
     q clamps to the (even) training-set size, inner='auto' resolves to the
-    pallas engine only on TPU with a lane-aligned q, selection='auto'
-    resolves by backend, and wss degrades to first-order on the XLA engine
-    (which implements only the reference's Keerthi selection). Benchmarks
-    that record per-row effective config MUST derive it from this helper
-    rather than re-implementing the rules, so recorded rows cannot
-    silently claim an engine/wss/selection they did not run.
-    blocked_smo_solve itself resolves through this helper too; it layers
-    its own validation errors (explicit inner='pallas' with unaligned q,
-    explicit wss=2 with inner='xla') on top.
+    pallas engine only on TPU with a lane-aligned q, and selection='auto'
+    resolves by backend. wss passes through unchanged: BOTH inner engines
+    implement first-order (1) and second-order (2) partner selection
+    (round 4; previously the XLA engine was first-order only and wss
+    degraded here). Benchmarks that record per-row effective config MUST
+    derive it from this helper rather than re-implementing the rules, so
+    recorded rows cannot silently claim an engine/wss/selection they did
+    not run. blocked_smo_solve itself resolves through this helper too;
+    it layers its own validation errors (explicit inner='pallas' with
+    unaligned q) on top.
     """
     q = min(q, n if n % 2 == 0 else n - 1) if n >= 2 else 2
     if selection == "auto":
@@ -73,7 +74,7 @@ def resolve_solver_config(n: int, q: int = 1024, inner: str = "auto",
     if inner == "auto":
         inner = ("pallas" if jax.default_backend() == "tpu"
                  and q % _PALLAS_LANE == 0 else "xla")
-    return q, inner, (wss if inner == "pallas" else 1), selection
+    return q, inner, wss, selection
 
 
 class _OuterState(NamedTuple):
@@ -89,7 +90,8 @@ class _OuterState(NamedTuple):
     n_refines: jax.Array  # reconstructions done so far (refine mode)
 
 
-def _inner_smo(K_BB, y_B, a_B, f_B, active_B, C, eps, tau, max_inner):
+def _inner_smo(K_BB, y_B, a_B, f_B, active_B, C, eps, tau, max_inner,
+               wss: int = 1):
     """Pairwise SMO restricted to the working set, all VMEM-sized.
 
     K_BB is (q, q); each iteration is the reference's 2-variable analytic
@@ -99,8 +101,20 @@ def _inner_smo(K_BB, y_B, a_B, f_B, active_B, C, eps, tau, max_inner):
     (CONVERGED / NO_WORKING_SET / INFEASIBLE_UV / NONPOS_ETA / STALLED /
     MAX_ITER-for-the-inner-cap) — the outer loop decides what it means
     globally.
+
+    wss=1 picks i_low by first-order Keerthi argmax-f (the reference's
+    heuristic, main3.cpp:124-142); wss=2 picks the maximal-gain partner —
+    among violating I_low members j maximise (f_j - b_high)^2 / eta_j, the
+    LIBSVM-WSS2-style second-order rule, same math as the pallas kernel
+    (ops/pallas/inner_smo.py) so both engines reach the optimum in
+    comparably fewer updates. The Keerthi STOP decision stays on the
+    global (b_high, b_low) pair either way; when no violating partner
+    exists the iteration is exactly the converged/not-found exit (an
+    I_low member with f > b_high exists whenever b_low > b_high + 2*tau).
     """
     adt = f_B.dtype
+    if wss == 2:
+        diag_B = jnp.diagonal(K_BB).astype(adt)
 
     def cond(st):
         return st[4] == Status.RUNNING
@@ -110,11 +124,25 @@ def _inner_smo(K_BB, y_B, a_B, f_B, active_B, C, eps, tau, max_inner):
         m_h = i_high_mask(a_B, y_B, C, eps, active_B)
         m_l = i_low_mask(a_B, y_B, C, eps, active_B)
         i_h = jnp.argmin(jnp.where(m_h, f_B, jnp.inf)).astype(jnp.int32)
-        i_l = jnp.argmax(jnp.where(m_l, f_B, -jnp.inf)).astype(jnp.int32)
         found = jnp.any(m_h) & jnp.any(m_l)
         b_h = f_B[i_h]
+        if wss == 2:
+            # stop on the global Keerthi gap; partner by maximal gain
+            b_stop = jnp.max(jnp.where(m_l, f_B, -jnp.inf))
+            eta_vec = jnp.maximum(
+                K_BB[i_h, i_h].astype(adt) + diag_B
+                - 2.0 * K_BB[i_h, :].astype(adt),
+                1e-12,
+            )
+            viol = m_l & (f_B > b_h)
+            vg = jnp.where(viol, (f_B - b_h) ** 2 / eta_vec, -jnp.inf)
+            i_l = jnp.argmax(vg).astype(jnp.int32)
+        else:
+            i_l = jnp.argmax(jnp.where(m_l, f_B, -jnp.inf)).astype(jnp.int32)
+            b_stop = None
         b_l = f_B[i_l]
-        converged = found & (b_l <= b_h + 2.0 * tau)
+        gap_l = b_stop if wss == 2 else b_l
+        converged = found & (gap_l <= b_h + 2.0 * tau)
         proceed = found & ~converged
 
         y_h = y_B[i_h].astype(adt)
@@ -218,10 +246,14 @@ def blocked_smo_solve(
     float32 subproblem, interpreted off-TPU); "auto" = pallas on TPU when
     q is lane-aligned, xla otherwise.
 
-    wss (pallas engine only; the XLA engine is always first-order,
-    reference-faithful): 1 = Keerthi argmax-f partner selection, 2 =
-    maximal-gain second-order partner selection (LIBSVM WSS2 style) —
-    fewer updates to the same optimum; the stopping rule is unchanged.
+    wss (both engines): 1 = Keerthi argmax-f partner selection (the
+    reference's heuristic), 2 = maximal-gain second-order partner
+    selection (LIBSVM WSS2 style) — fewer updates to the same optimum;
+    the stopping rule is unchanged. The pallas kernel and the XLA loop
+    implement the same wss=2 math (ops/pallas/inner_smo.py vs
+    _inner_smo), so the choice of engine never silently changes the
+    selection order anymore (round 4; previously XLA was first-order
+    only and wss=2 degraded with a warning).
 
     refine (static): 0 = judge convergence on the per-round ACCUMULATED
     error vector, like the reference's GPU build accumulates f on device.
@@ -309,8 +341,7 @@ def blocked_smo_solve(
         raise ValueError(
             f"selection must be auto|exact|approx, got {selection!r}"
         )
-    requested_inner = inner
-    q, inner, _eff_wss, selection = resolve_solver_config(
+    q, inner, wss, selection = resolve_solver_config(
         n, q, inner=inner, wss=wss, selection=selection
     )
     half = q // 2
@@ -339,24 +370,6 @@ def blocked_smo_solve(
             f"rows; use inner='auto' to fall back to the XLA engine on "
             f"small/unaligned problems"
         )
-    if wss == 2 and inner == "xla":
-        # the XLA engine is always first-order (reference-faithful); don't
-        # let wss=2 silently degrade to it
-        if requested_inner == "xla":
-            raise ValueError(
-                "wss=2 (second-order partner selection) is implemented only "
-                "by the pallas inner engine; inner='xla' is first-order"
-            )
-        import warnings
-
-        warnings.warn(
-            f"wss=2 requested but inner='auto' resolved to the first-order "
-            f"XLA engine (backend={jax.default_backend()!r}, q={q}); "
-            "falling back to Keerthi first-order selection",
-            RuntimeWarning,
-            stacklevel=2,
-        )
-
     if valid is None:
         valid = jnp.ones((n,), bool)
     if alpha0 is None:
@@ -479,12 +492,13 @@ def blocked_smo_solve(
                     lambda: (da_B, upd, progress, inner_reason),
                     lambda: (lambda r: (r[0] - a_B, r[1], r[2], r[3]))(
                         _inner_smo(K_BB, y_B, a_B, f_B, active_B, C, eps,
-                                   tau, max_inner)
+                                   tau, max_inner, wss=wss)
                     ),
                 )
             else:
                 a_B_new, upd, progress, inner_reason = _inner_smo(
-                    K_BB, y_B, a_B, f_B, active_B, C, eps, tau, max_inner
+                    K_BB, y_B, a_B, f_B, active_B, C, eps, tau, max_inner,
+                    wss=wss,
                 )
                 da_B = a_B_new - a_B
 
