@@ -1,0 +1,127 @@
+"""Randomized cross-engine parity fuzz: every engine vs the f64 oracle.
+
+Breadth complement to the targeted parity artifacts: where
+`midscale_parity.py` proves the reference's criterion at production scale
+on the bench recipe, this sweeps RANDOM geometry (generator, n, d, C,
+gamma) and checks every solver engine against the NumPy oracle on each
+instance — the blocked solver across its selection × wss grid plus the
+f64 pair solver. Criterion per instance (the cross-engine standard of
+tests/test_solver_parity.py): both CONVERGED, SV symmetric difference
+<= max(2, n_sv/25) (f32 features vs the oracle's f64 allow tau-band
+boundary flips; the pair solver runs f64 and must match the SV set
+exactly), |b - b_oracle| <= 2e-3.
+
+Usage: python benchmarks/fuzz_parity.py [n_cases] [base_seed]
+Emits one JSON line per case with per-engine verdicts, then a summary
+line {cases, engines, violations}. A committed run lives in
+benchmarks/results/fuzz_parity_cpu.jsonl.
+"""
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import jax
+
+jax.config.update("jax_enable_x64", True)
+
+from benchmarks.common import pin_platform  # noqa: E402
+
+pin_platform()  # TPUSVM_PROBE_PLATFORM=cpu -> CPU backend (see helper)
+
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+from tpusvm.config import SVMConfig  # noqa: E402
+from tpusvm.data import MinMaxScaler, blobs, rings  # noqa: E402
+from tpusvm.oracle import get_sv_indices, smo_train  # noqa: E402
+from tpusvm.solver import smo_solve  # noqa: E402
+from tpusvm.solver.blocked import blocked_smo_solve  # noqa: E402
+from tpusvm.status import Status  # noqa: E402
+
+# (engine name, solver kwargs, f64 features?) — f64 engines must match the
+# oracle's SV set exactly; f32 engines get the tau-band allowance
+ENGINES = [
+    ("pair-f64", None, True),
+    ("blocked-exact", dict(selection="exact", wss=1), False),
+    ("blocked-approx", dict(selection="approx", wss=1), False),
+    ("blocked-exact-wss2", dict(selection="exact", wss=2), False),
+    ("blocked-approx-wss2", dict(selection="approx", wss=2), False),
+]
+
+
+def run_case(seed: int):
+    rng = np.random.default_rng(seed)
+    gen = rings if rng.random() < 0.5 else blobs
+    n = int(rng.integers(96, 640))
+    d = int(rng.integers(2, 24)) if gen is blobs else 2
+    C = float(rng.choice([1.0, 10.0, 100.0]))
+    gamma = float(rng.choice([0.125, 0.5, 2.0, 10.0])) / max(1, d // 4)
+    kw = dict(n=n, seed=seed)
+    if gen is blobs:
+        kw["d"] = d
+    X, Y = gen(**kw)
+    Xs = MinMaxScaler().fit_transform(X)
+    cfg = SVMConfig(C=C, gamma=gamma)
+
+    o = smo_train(Xs, Y, cfg)
+    rec = {"seed": seed, "gen": gen.__name__, "n": n, "d": Xs.shape[1],
+           "C": C, "gamma": round(gamma, 6),
+           "oracle_status": Status(int(o.status)).name,
+           "n_sv": int(len(get_sv_indices(o.alpha))),
+           "b": float(o.b), "engines": {}, "violations": []}
+    if o.status != Status.CONVERGED:
+        # degenerate instance (the oracle itself bailed): skip, recorded
+        rec["skipped"] = True
+        return rec
+    sv_o = set(get_sv_indices(o.alpha).tolist())
+
+    common = dict(C=cfg.C, gamma=cfg.gamma, eps=cfg.eps, tau=cfg.tau,
+                  max_iter=cfg.max_iter, accum_dtype=jnp.float64)
+    # one jit cache entry per (n, d) shape per engine config; the fuzz
+    # intentionally varies shapes, so expect recompiles — correctness run,
+    # not a timing run
+    for name, opts, f64 in ENGINES:
+        if opts is None:
+            r = smo_solve(jnp.asarray(Xs, jnp.float64), jnp.asarray(Y),
+                          **common)
+        else:
+            r = blocked_smo_solve(
+                jnp.asarray(Xs, jnp.float32), jnp.asarray(Y),
+                q=256, max_inner=1024, max_outer=2000, inner="xla",
+                **opts, **common)
+        sv = set(get_sv_indices(np.asarray(r.alpha)).tolist())
+        sym = len(sv ^ sv_o)
+        db = abs(float(r.b) - o.b)
+        allowed = 0 if f64 else max(2, len(sv_o) // 25)
+        ok = (int(r.status) == Status.CONVERGED and sym <= allowed
+              and db <= 2e-3)
+        rec["engines"][name] = {
+            "status": Status(int(r.status)).name,
+            "sv_sym_diff": sym, "b_abs_diff": round(db, 8), "ok": bool(ok),
+        }
+        if not ok:
+            rec["violations"].append(name)
+    return rec
+
+
+def main(n_cases: int = 64, base_seed: int = 1000) -> int:
+    violations = 0
+    skipped = 0
+    for i in range(n_cases):
+        rec = run_case(base_seed + i)
+        print(json.dumps(rec), flush=True)
+        skipped += int(bool(rec.get("skipped")))
+        violations += len(rec["violations"])
+    print(json.dumps({
+        "summary": True, "cases": n_cases, "skipped_degenerate": skipped,
+        "engines": [e[0] for e in ENGINES], "violations": violations,
+        "platform": jax.default_backend(),
+    }), flush=True)
+    return 0 if violations == 0 else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main(int(sys.argv[1]) if len(sys.argv) > 1 else 64,
+                  int(sys.argv[2]) if len(sys.argv) > 2 else 1000))

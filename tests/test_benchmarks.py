@@ -77,6 +77,28 @@ def test_ovr_10class_smoke_schema(capsys):
     assert r["n_sv_union"] > 0
 
 
+def test_fuzz_parity_smoke_schema(capsys):
+    # two random instances through all five engines vs the oracle: keeps
+    # the fuzz harness runnable and its verdict logic honest (a committed
+    # 64-case run lives in benchmarks/results/fuzz_parity_cpu.jsonl)
+    from benchmarks import fuzz_parity
+
+    rc = fuzz_parity.main(2, 4242)
+    recs = _records(capsys)
+    assert len(recs) == 3  # 2 cases + summary
+    summary = recs[-1]
+    assert summary["summary"] is True
+    assert rc == 0 and summary["violations"] == 0
+    for rec in recs[:-1]:
+        if rec.get("skipped"):
+            continue
+        assert set(rec["engines"]) == {
+            "pair-f64", "blocked-exact", "blocked-approx",
+            "blocked-exact-wss2", "blocked-approx-wss2"}
+        for verdict in rec["engines"].values():
+            assert verdict["ok"]
+
+
 def test_sweep_p_tree_skips_non_power_of_two(capsys):
     from benchmarks import sweep_p
 

@@ -153,6 +153,36 @@ def test_two_process_checkpoint_then_resume(tmp_path):
     assert "converged = True" in out0
 
 
+def test_two_process_divergent_resume_fails_fast(tmp_path):
+    """ADVICE r3 medium, end-to-end: resume=True where the checkpoint file
+    exists on process 0 but is MISSING on process 1 (no shared
+    filesystem) must fail fast on EVERY process with the explained
+    shared-filesystem error — not deadlock in the first round collective
+    with one process at round 2 and the other at round 1."""
+    ckpt0 = tmp_path / "ck0.npz"
+    ckpt1 = tmp_path / "ck1.npz"  # never written: the 'other host' path
+    base = [
+        "train", "--synthetic", "blobs", "--n", "64", "--n-test", "0",
+        "--d", "8", "--gamma", "0.5", "--C", "1.0",
+        "--mode", "cascade", "--topology", "star",
+        "--shards", "2", "--sv-capacity", "32",
+    ]
+    results = _run_cluster(
+        base + ["--max-rounds", "1", "--checkpoint", str(ckpt0)])
+    for rc, out in results:
+        assert rc == 0, out[-3000:]
+    assert ckpt0.exists() and not ckpt1.exists()
+    results = _run_cluster(
+        base + ["--max-rounds", "6", "--resume"],
+        per_process_args=[["--checkpoint", str(ckpt0)],
+                          ["--checkpoint", str(ckpt1)]],
+        timeout=240,  # must fail FAST; a deadlock would ride to timeout
+    )
+    for rc, out in results:
+        assert rc != 0, out[-3000:]
+        assert "missing on processes [1]" in out, out[-3000:]
+
+
 @pytest.mark.parametrize("topology", ["tree", "star"])
 def test_two_process_four_device_mesh(topology, tmp_path):
     """The real pod shape — multiple devices PER process (2 hosts x 2
