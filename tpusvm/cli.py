@@ -479,16 +479,6 @@ def main(argv=None) -> int:
             "--coordinator-address/--num-processes/--process-id require "
             "--distributed"
         )
-    if args.distributed and getattr(args, "class_parallel", False):
-        # knowable from args alone — reject BEFORE jax.distributed
-        # .initialize below, which blocks until every process joins (and
-        # hangs outright on misconfigured geometry)
-        parser.error(
-            "--class-parallel is a single-controller feature (class axis "
-            "over this process's local devices); with --distributed each "
-            "process would redundantly train every class — run without "
-            "--distributed on one host"
-        )
     if args.platform:
         import jax
 

@@ -19,10 +19,15 @@ chips"). TPU-native design:
     device mesh via shard_map, each device running the vmapped pair solver
     on its slice of the one-vs-rest label matrix with X replicated
     (classes share the data; only the +/-1 labels differ, so the class
-    axis is embarrassingly parallel — no collectives in the hot path).
-    The class count is padded to a device multiple with all-negative dummy
-    label vectors, which terminate NO_WORKING_SET after one masked
-    iteration (free in the lockstep batched while_loop).
+    axis is embarrassingly parallel — no collectives in the hot path; one
+    end-of-solve all_gather replicates the results). The class count is
+    padded to a device multiple with all-negative dummy label vectors,
+    which terminate NO_WORKING_SET after one masked iteration (free in
+    the lockstep batched while_loop). MULTI-HOST capable (round 4): under
+    jax.distributed the default mesh spans all global devices and every
+    process passes the same host data (the multi-controller contract,
+    like cascade_fit) — the class axis then shards across hosts the way
+    the reference's MPI ranks split work across nodes.
   - prediction: ONE kernel matrix K(test, train) feeds all classes:
     scores = K @ coef^T with coef (K, n) = alpha * y per class — a single
     MXU matmul batched over classes instead of K separate predict passes.
@@ -130,7 +135,11 @@ class OneVsRestSVC:
             Xs = self.scaler_.transform(X)
         else:
             Xs = X
-        Xd = jnp.asarray(Xs, self.dtype)
+        # the class_parallel path feeds X in as a mesh-replicated global
+        # array instead, so only the single-controller branches pay the
+        # plain device transfer
+        if not self.class_parallel:
+            Xd = jnp.asarray(Xs, self.dtype)
 
         if self.solver == "blocked":
             # per-class blocked working-set solves, sequentially: every
@@ -148,40 +157,52 @@ class OneVsRestSVC:
                     accum_dtype=accum_dtype, **self.solver_opts,
                 )
         else:
-            def solve_one(y):
+            def solve_pair(Xarr, y):
                 return smo_solve(
-                    Xd, y, C=cfg.C, gamma=cfg.gamma, eps=cfg.eps, tau=cfg.tau,
-                    max_iter=cfg.max_iter, accum_dtype=accum_dtype,
-                    **self.solver_opts,
+                    Xarr, y, C=cfg.C, gamma=cfg.gamma, eps=cfg.eps,
+                    tau=cfg.tau, max_iter=cfg.max_iter,
+                    accum_dtype=accum_dtype, **self.solver_opts,
                 )
+
+            def solve_one(y):
+                return solve_pair(Xd, y)
 
         if self.class_parallel:
             # BASELINE config 5 verbatim: the K one-vs-rest problems
             # sharded over the device mesh, the vmapped pair solver
-            # running each device's class slice. X is a closure capture
-            # (replicated); classes share no state, so the only
-            # cross-device traffic is the initial label scatter.
-            from jax.sharding import PartitionSpec as P
-            from tpusvm.parallel.mesh import make_mesh
+            # running each device's class slice with X replicated; classes
+            # share no state, so the hot path has zero collectives.
+            # Multi-host capable (round 4): under jax.distributed the
+            # default mesh spans ALL global devices, inputs are built as
+            # global arrays (label matrix class-sharded, X replicated),
+            # and the outputs are all_gathered inside the shard_map so
+            # every PROCESS holds the full replicated result — the same
+            # treatment that makes the cascade multi-host
+            # (parallel/cascade.py:_replicate_outputs): sharded outputs
+            # are not process-addressable, and the host-side SV-union /
+            # save / score steps need the whole model everywhere.
+            from jax import lax
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            from tpusvm.parallel.mesh import make_mesh, require_1d_mesh
 
             K = Ys.shape[0]
             mesh = self.mesh
             if mesh is None:
-                # LOCAL devices only: class-parallel is a single-controller
-                # feature (host-local inputs into a jit). A default mesh
-                # over global jax.devices() under jax.distributed would mix
-                # non-addressable devices into the jit and crash; with
-                # local devices each process simply trains the full class
-                # set on its own chips
-                devs = jax.local_devices()
-                mesh = make_mesh(min(K, len(devs)), devices=devs,
-                                 axis="classes")
-            from tpusvm.parallel.mesh import require_1d_mesh
-
+                if jax.process_count() > 1:
+                    # every process must run the same SPMD program, and a
+                    # mesh over ALL devices keeps every process holding
+                    # addressable (replicated) output shards; surplus
+                    # devices just train dummy padding classes
+                    devs = jax.devices()
+                else:
+                    devs = jax.local_devices()
+                    devs = devs[: min(K, len(devs))]
+                mesh = make_mesh(len(devs), devices=devs, axis="classes")
             require_1d_mesh(mesh, "class_parallel")
             self.class_mesh_ = {
                 "axes": tuple(mesh.axis_names),
                 "shape": dict(mesh.shape),
+                "processes": jax.process_count(),
                 "devices": [str(d) for d in mesh.devices.flat],
             }
             axis = mesh.axis_names[0]
@@ -193,17 +214,37 @@ class OneVsRestSVC:
             Ys_p = np.concatenate(
                 [Ys, -np.ones((pad, Ys.shape[1]), np.int32)]
             )
+            # global input arrays: every process passes the SAME host data
+            # (the multi-controller contract, as for cascade_fit) and
+            # materialises its addressable shards — works identically
+            # single-host
+            Xs_f = np.asarray(Xs, self.dtype)
+            Xg = jax.make_array_from_callback(
+                Xs_f.shape, NamedSharding(mesh, P()),
+                lambda idx: Xs_f[idx])
+            Ysg = jax.make_array_from_callback(
+                Ys_p.shape, NamedSharding(mesh, P(axis)),
+                lambda idx: Ys_p[idx])
+
+            def device_fn(Xr, ys):
+                res = jax.vmap(lambda y: solve_pair(Xr, y))(ys)
+                # K_padded-sized end-of-solve gather — noise next to the
+                # per-class solves, and what makes the result replicated
+                return jax.tree.map(
+                    lambda x: lax.all_gather(x, axis, tiled=True), res
+                )
+
             # check_vma=False for the same reason as parallel/cascade.py:
             # the solver's while_loop/cond carries start from unvarying
             # constants, which the varying-manual-axes checker rejects on
             # every carry; no cross-device communication happens inside
             # the solver, so correctness is unaffected
             fn = jax.jit(jax.shard_map(
-                jax.vmap(solve_one), mesh=mesh,
-                in_specs=P(axis), out_specs=P(axis),
+                device_fn, mesh=mesh,
+                in_specs=(P(), P(axis)), out_specs=P(),
                 check_vma=False,
             ))
-            res = fn(jnp.asarray(Ys_p))
+            res = fn(Xg, Ysg)
             alphas = np.asarray(res.alpha)[:K]       # (K, n)
             bs = np.asarray(res.b)[:K]
             iters = np.asarray(res.n_iter)[:K]
