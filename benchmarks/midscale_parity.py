@@ -22,6 +22,10 @@ Engines compared against the float64 NumPy oracle (tpusvm.oracle.smo):
                     partner selection — the wss=2 path every headline
                     benchmark ships (bench.py), on the XLA engine since
                     round 4
+  - blocked-cpu-bench-config: the EXACT shipping CPU-fallback config
+                    (bench.py off-TPU: q=2048, max_inner=32768, wss=2,
+                    selection auto->exact) so the headline-producing
+                    configuration itself is oracle-anchored
 
 Usage: python benchmarks/midscale_parity.py [n ...]   (default: 2048 4096)
 Emits one JSON line per (n, engine) with n_sv / b / accuracy / timings and
@@ -136,27 +140,34 @@ def run_size(n: int):
     # --- blocked solver, production precision, exact + approx selection ---
     rows = {"oracle": (sv_o, o.b, acc_o),
             "pair-f64": (sv_j, float(j.b), acc_j)}
-    for selection, wss in (("exact", 1), ("approx", 1),
-                           ("exact", 2), ("approx", 2)):
+    grid = [
+        (f"blocked-{sel}" + ("-wss2" if wss == 2 else ""),
+         dict(q=1024, max_inner=4096, wss=wss, selection=sel))
+        for sel, wss in (("exact", 1), ("approx", 1),
+                         ("exact", 2), ("approx", 2))
+    ]
+    # the exact shipping CPU-fallback config (bench.py off-TPU)
+    grid.append(("blocked-cpu-bench-config",
+                 dict(q=2048, max_inner=32768, wss=2, selection="auto")))
+    for name, opts in grid:
         q_eff, inner_eff, wss_eff, sel_eff = resolve_solver_config(
-            n, q=1024, inner="xla", wss=wss, selection=selection)
+            n, q=opts["q"], inner="xla", wss=opts["wss"],
+            selection=opts["selection"])
         t0 = time.perf_counter()
         r = blocked_smo_solve(
             jnp.asarray(Xs, jnp.float32), jnp.asarray(Y), C=CFG.C,
             gamma=CFG.gamma, eps=CFG.eps, tau=CFG.tau,
             max_iter=CFG.max_iter,
-            q=1024, max_inner=4096, max_outer=5000, inner="xla", wss=wss,
-            selection=selection, accum_dtype=jnp.float64)
+            max_outer=5000, inner="xla", accum_dtype=jnp.float64, **opts)
         a_r = np.asarray(r.alpha)
         r_s = time.perf_counter() - t0
         sv_r = get_sv_indices(a_r)
         acc_r = _accuracy(a_r, float(r.b), jnp.float32)
-        name = f"blocked-{selection}" + ("-wss2" if wss == 2 else "")
         _row(n, name, r.status, len(sv_r), float(r.b), acc_r, r_s, sv_r,
              {"updates": int(r.n_iter), "n_outer": int(r.n_outer),
               "solver_config": {"q": q_eff, "inner": inner_eff,
                                 "wss": wss_eff, "selection": sel_eff,
-                                "max_inner": 4096},
+                                "max_inner": opts["max_inner"]},
               **_deltas(sv_r, float(r.b), acc_r)})
         rows[name] = (sv_r, float(r.b), acc_r)
 

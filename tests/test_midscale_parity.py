@@ -31,7 +31,8 @@ def test_midscale_parity_n2048():
 
     rows, summary = run_size(2048)
     for engine in ("pair-f64", "blocked-exact", "blocked-approx",
-                   "blocked-exact-wss2", "blocked-approx-wss2"):
+                   "blocked-exact-wss2", "blocked-approx-wss2",
+                   "blocked-cpu-bench-config"):
         verdict = summary[engine]
         assert verdict["sv_set_identical"], (engine, verdict)
         assert verdict["b_within_0.003pct"], (engine, verdict)

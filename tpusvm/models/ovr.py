@@ -164,8 +164,10 @@ class OneVsRestSVC:
                     accum_dtype=accum_dtype, **self.solver_opts,
                 )
 
-            def solve_one(y):
-                return solve_pair(Xd, y)
+            if not self.class_parallel:
+                # class_parallel feeds X explicitly (no Xd exists there)
+                def solve_one(y):
+                    return solve_pair(Xd, y)
 
         if self.class_parallel:
             # BASELINE config 5 verbatim: the K one-vs-rest problems
