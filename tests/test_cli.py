@@ -78,6 +78,52 @@ def test_cli_train_single_and_predict(tmp_path, capsys):
     assert rc == 0
     assert "accuracy = " in capsys.readouterr().out
 
+    # mesh-sharded serving through the CLI: same accuracy as plain predict
+    rc = main(["predict", "--model", model, "--data", csv,
+               "--mesh-predict"])
+    assert rc == 0
+    assert "accuracy = " in capsys.readouterr().out
+
+
+def test_cli_predict_multiclass_model_autodetected(tmp_path, capsys):
+    """`predict` must work on a --multiclass-saved model: the state is
+    auto-detected (classes key), CSV labels stay RAW instead of the
+    binary != 1 -> -1 mapping, and --scores prints one column per
+    class."""
+    import numpy as np
+
+    from tpusvm.data import write_csv
+    from tpusvm.data.synthetic import mnist_like_multiclass
+
+    model = str(tmp_path / "ovr.npz")
+    # one clean multiclass dataset split into train/test CSVs so the
+    # saved model and the predict data share a distribution
+    X, labels = mnist_like_multiclass(n=256, d=16, seed=9)
+    train_csv = str(tmp_path / "mc_train.csv")
+    csv = str(tmp_path / "mc.csv")
+    write_csv(train_csv, X[:192], labels[:192])
+    write_csv(csv, X[192:], labels[192:])
+    rc = main([
+        "train", "--train", train_csv, "--multiclass",
+        "--gamma", "0.0625", "--save", model, "--quiet",
+    ])
+    assert rc == 0
+    capsys.readouterr()
+    rc = main(["predict", "--model", model, "--data", csv])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "accuracy = " in out
+    acc = float(out.split("accuracy = ")[1].split()[0])
+    assert acc > 0.5  # raw labels compared against argmax classes
+
+    rc = main(["predict", "--model", model, "--data", csv, "--scores",
+               "--mesh-predict"])
+    assert rc == 0
+    rows = capsys.readouterr().out.strip().splitlines()
+    assert len(rows) == 64
+    assert len(rows[0].split()) == 10  # one score column per class (K=10)
+    assert np.isfinite([float(v) for v in rows[0].split()]).all()
+
 
 def test_cli_solver_opt_passthrough(capsys):
     # KEY=VALUE knobs reach the blocked solver: q=64 on a 200-point problem

@@ -175,11 +175,16 @@ def _build_parser() -> argparse.ArgumentParser:
 
     pr = sub.add_parser("predict", parents=[common],
                         help="evaluate a saved model on a CSV")
-    pr.add_argument("--model", required=True, metavar="NPZ")
+    pr.add_argument("--model", required=True, metavar="NPZ",
+                    help="binary or --multiclass model (auto-detected)")
     pr.add_argument("--data", required=True, metavar="CSV")
     pr.add_argument("--n-limit", type=int, default=None)
     pr.add_argument("--scores", action="store_true",
-                    help="print decision scores instead of accuracy")
+                    help="print decision scores instead of accuracy (one "
+                    "line per row; multiclass: one column per class)")
+    pr.add_argument("--mesh-predict", action="store_true",
+                    help="shard the test rows over the local device mesh "
+                    "(zero-collective sharded serving)")
 
     sub.add_parser("info", parents=[common],
                    help="print device / backend information")
@@ -435,19 +440,35 @@ def _fit_oracle(X, Y, cfg, timer, log):
 
 def _cmd_predict(args) -> int:
     from tpusvm.data.native_io import read_csv_fast
-    from tpusvm.models import BinarySVC
+    from tpusvm.models import BinarySVC, OneVsRestSVC
+    from tpusvm.models.serialization import is_multiclass_model
     from tpusvm.utils import PhaseTimer
 
     timer = PhaseTimer()
-    model = BinarySVC.load(args.model)
+    # dispatch on the saved state; multiclass labels then stay raw instead
+    # of the reference's binary != 1 -> -1 mapping
+    multiclass = is_multiclass_model(args.model)
+    model = (OneVsRestSVC if multiclass else BinarySVC).load(args.model)
     with timer.phase("data"):
-        X, Y = read_csv_fast(args.data, n_limit=args.n_limit)
+        X, Y = read_csv_fast(args.data, n_limit=args.n_limit,
+                             binary_labels=not multiclass)
+    mesh = None
+    if args.mesh_predict:
+        import jax
+
+        from tpusvm.parallel.mesh import make_mesh
+
+        devs = jax.local_devices()
+        mesh = make_mesh(len(devs), devices=devs)
     if args.scores:
-        for s in model.decision_function(X):
-            print(f"{s:.15f}")
+        scores = np.asarray(model.decision_function(X, mesh=mesh))
+        if len(scores):  # reshape(n, -1) is ambiguous on 0 rows;
+            # an empty CSV must print nothing, as the old loop did
+            for row in scores.reshape(len(scores), -1):
+                print(" ".join(f"{s:.15f}" for s in row))
         return 0
     with timer.phase("prediction"):
-        acc = model.score(X, Y)
+        acc = model.score(X, Y, mesh=mesh)
     m = len(Y)
     print(f"accuracy = {acc:.4f} ({round(acc * m)}/{m})")
     print(timer.report())

@@ -25,6 +25,15 @@ def _norm(path: str) -> str:
     return path if path.endswith(".npz") else path + ".npz"
 
 
+def is_multiclass_model(path: str) -> bool:
+    """True if the saved model is a OneVsRestSVC state (carries the
+    `classes` array; BinarySVC state has no such key). Reads only the zip
+    directory — cheap enough to sniff before choosing which class to
+    load."""
+    with np.load(_norm(path), allow_pickle=False) as z:
+        return "classes" in z.files
+
+
 def save_model(path: str, state: Dict[str, Any], config: SVMConfig) -> None:
     np.savez_compressed(
         _norm(path),
