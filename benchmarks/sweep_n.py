@@ -149,13 +149,18 @@ def main(argv=None) -> int:
     ap.add_argument("--d", type=int, default=784)
     ap.add_argument("--smoke", action="store_true",
                     help="tiny sizes for a fast functional check")
-    ap.add_argument("--q", type=int, default=1024)
+    ap.add_argument("--q", type=int, default=2048,
+                    help="working-set size (default = bench.py's tuned "
+                    "value; clamps to n at small sizes)")
     ap.add_argument("--gamma", type=float, default=0.00125,
                     help="RBF width (reference MNIST value); scaled to ~1/d in --smoke")
-    ap.add_argument("--max-inner", type=int, default=1024)
-    ap.add_argument("--wss", type=int, default=1, choices=(1, 2),
-                    help="inner partner selection (2 = second-order, "
-                    "pallas engine only — bench.py's tuned value)")
+    ap.add_argument("--max-inner", type=int, default=4096,
+                    help="inner budget (default = bench.py's TPU-tuned "
+                    "value)")
+    ap.add_argument("--wss", type=int, default=2, choices=(1, 2),
+                    help="inner partner selection (default 2 = "
+                    "second-order, bench.py's tuned value; both engines "
+                    "implement it since round 4)")
     ap.add_argument("--selection", default="auto",
                     choices=("auto", "exact", "approx"),
                     help="outer working-set selection engine")
