@@ -9,7 +9,16 @@ f64 pair solver. Criterion per instance (the cross-engine standard of
 tests/test_solver_parity.py): both CONVERGED, SV symmetric difference
 <= max(2, n_sv/25) (f32 features vs the oracle's f64 allow tau-band
 boundary flips; the pair solver runs f64 and must match the SV set
-exactly), |b - b_oracle| <= 2e-3.
+exactly), and b within a scale-aware band.
+
+The b band is scale-aware: max(2e-3 absolute, 0.02% of |b_oracle|). The
+absolute floor matches the cross-engine test standard at the usual |b|~1
+geometry; the relative term covers large-|b| instances (rings at C=100
+put b ~ 40-46), where the f32 engines' kernel-evaluation noise scales
+with the dual magnitudes (~sum|alpha|*1e-7, see solver/blocked.py's
+refine discussion) — observed spread there is ~0.005-0.01% relative,
+identical for exact and approx selection (so it is precision, not
+selection), while the f64 pair solver stays within 1e-4 absolute.
 
 Usage: python benchmarks/fuzz_parity.py [n_cases] [base_seed]
 Emits one JSON line per case with per-engine verdicts, then a summary
@@ -95,11 +104,15 @@ def run_case(seed: int):
         sym = len(sv ^ sv_o)
         db = abs(float(r.b) - o.b)
         allowed = 0 if f64 else max(2, len(sv_o) // 25)
+        # scale-aware b band (see module docstring); the f64 pair solver
+        # is held to the absolute floor alone
+        b_band = 2e-3 if f64 else max(2e-3, 2e-4 * abs(o.b))
         ok = (int(r.status) == Status.CONVERGED and sym <= allowed
-              and db <= 2e-3)
+              and db <= b_band)
         rec["engines"][name] = {
             "status": Status(int(r.status)).name,
-            "sv_sym_diff": sym, "b_abs_diff": round(db, 8), "ok": bool(ok),
+            "sv_sym_diff": sym, "b_abs_diff": round(db, 8),
+            "b_band": round(b_band, 8), "ok": bool(ok),
         }
         if not ok:
             rec["violations"].append(name)

@@ -40,9 +40,11 @@ def main(argv=None) -> int:
     ap.add_argument("--d", type=int, default=784)
     ap.add_argument("--gamma", type=float, default=0.00125)
     ap.add_argument("--solver", choices=["blocked", "pair"], default="blocked")
-    # blocked-solver defaults = bench.py's tuned per-binary config (each
-    # one-vs-rest class is the same 60k workload bench measures); rows
-    # are self-describing via the recorded solver_opts
+    # blocked-solver defaults = bench.py's TPU-tuned per-binary config
+    # (each one-vs-rest class is the same 60k workload bench measures;
+    # bench's CPU fallback additionally deepens max_inner to 32768 —
+    # platform-conditional, not mirrored here); rows are self-describing
+    # via the recorded solver_opts
     ap.add_argument("--q", type=int, default=2048)
     ap.add_argument("--max-inner", type=int, default=4096)
     ap.add_argument("--wss", type=int, default=2, choices=(1, 2))

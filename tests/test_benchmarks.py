@@ -79,8 +79,9 @@ def test_ovr_10class_smoke_schema(capsys):
 
 def test_fuzz_parity_smoke_schema(capsys):
     # two random instances through all five engines vs the oracle: keeps
-    # the fuzz harness runnable and its verdict logic honest (a committed
-    # 64-case run lives in benchmarks/results/fuzz_parity_cpu.jsonl)
+    # the fuzz harness runnable and its verdict logic honest (two
+    # committed 64-case batches live in
+    # benchmarks/results/fuzz_parity_cpu.jsonl)
     from benchmarks import fuzz_parity
 
     rc = fuzz_parity.main(2, 4242)
