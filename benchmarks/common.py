@@ -39,6 +39,33 @@ CASCADE_TRAIN_S = {  # (topology, P) -> seconds, B4-B13, 2x32-core nodes
 SERIAL_TRAIN_S = 3285.662  # B1
 
 
+def random_instance(rng, seed, n_range, d_range, C_choices, gamma_choices,
+                    extra: int = 0):
+    """One random binary instance from the shared fuzz geometry family.
+
+    rings/blobs 50/50, n and (blobs-only) d drawn from the given ranges,
+    gamma scaled ~1/d. Both fuzz harnesses (fuzz_parity, fuzz_cascade)
+    draw through this so their geometry families stay in sync. The draw
+    ORDER (gen, n, d, C, gamma) is part of the committed artifacts'
+    reproducibility contract — rows are keyed by seed — so do not reorder
+    the rng calls. `extra` rows are generated beyond the drawn n (for a
+    held-out slice) without affecting the stream. Returns
+    (gen_name, n, X, Y, C, gamma) with X of n + extra rows.
+    """
+    from tpusvm.data import blobs, rings
+
+    gen = rings if rng.random() < 0.5 else blobs
+    n = int(rng.integers(*n_range))
+    d = int(rng.integers(*d_range)) if gen is blobs else 2
+    C = float(rng.choice(C_choices))
+    gamma = float(rng.choice(gamma_choices)) / max(1, d // 4)
+    kw = dict(n=n + extra, seed=seed)
+    if gen is blobs:
+        kw["d"] = d
+    X, Y = gen(**kw)
+    return gen.__name__, n, X, Y, C, gamma
+
+
 def pin_platform(env_var: str = "TPUSVM_PROBE_PLATFORM") -> None:
     """Pin the JAX backend from an env var, BEFORE backend init.
 

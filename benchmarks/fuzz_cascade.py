@@ -1,0 +1,148 @@
+"""Randomized cascade fuzz: tree-vs-star convergence and model parity.
+
+Breadth complement to benchmarks/sweep_p.py: where the P-sweep
+demonstrates the reference's cascade properties (convergence in a few
+rounds at every P, near-identical SV sets across topologies — report
+Tables 3-4 / Fig. 6) on the one bench workload family, this sweeps RANDOM
+geometry and checks, per instance:
+
+  - BOTH topologies converge (ID-set fixed point) within max_rounds;
+  - the tree and star models agree: SV-set Jaccard >= 0.9 and held-out
+    predictions differ on at most max(2, m/50) points (the two merge
+    schedules are different optimisation paths to the same fixed-point
+    criterion, so tau-band boundary flips are allowed — the same
+    standard as the repo's cross-engine parity);
+  - cascade accuracy is within 0.05 of a direct single-shard solve on
+    the same instance (the cascade's fixed point is NOT bitwise the
+    direct optimum — the reference's own claim is accuracy parity).
+
+The per-shard solver alternates pair/blocked by seed so both production
+paths ride the fuzz. Rows are shuffled before partitioning (contiguous
+partitions on class-sorted data would make shards single-class — the
+documented cascade failure mode, raised loudly by cascade_fit).
+
+Usage: python benchmarks/fuzz_cascade.py [n_cases] [base_seed] [shards]
+Needs >= `shards` devices (run under
+XLA_FLAGS=--xla_force_host_platform_device_count=8 TPUSVM_PROBE_PLATFORM=cpu
+off-TPU). Emits one JSON line per case + a summary line. A committed run
+lives in benchmarks/results/fuzz_cascade_sim_cpu.jsonl.
+"""
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import jax
+
+jax.config.update("jax_enable_x64", True)
+
+from benchmarks.common import pin_platform, random_instance  # noqa: E402
+
+pin_platform()  # TPUSVM_PROBE_PLATFORM=cpu -> CPU backend (see helper)
+
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+from tpusvm.config import CascadeConfig, SVMConfig  # noqa: E402
+from tpusvm.data import MinMaxScaler  # noqa: E402
+from tpusvm.parallel.cascade import cascade_fit  # noqa: E402
+from tpusvm.solver.blocked import blocked_smo_solve  # noqa: E402
+from tpusvm.solver.predict import predict as device_predict  # noqa: E402
+from tpusvm.status import Status  # noqa: E402
+
+
+def _predict(sv_X, sv_Y, sv_alpha, b, Xq, gamma, dtype=jnp.float64):
+    yp = device_predict(
+        jnp.asarray(Xq, dtype), jnp.asarray(sv_X, dtype),
+        jnp.asarray(sv_Y), jnp.asarray(sv_alpha, dtype),
+        jnp.asarray(b, dtype), gamma=gamma)
+    return np.asarray(yp)
+
+
+def run_case(seed: int, shards: int):
+    rng = np.random.default_rng(seed)
+    # n: multiple-of-shards not required (partition pads); >= ~48/shard so
+    # shards see both classes after the shuffle; 128 extra rows become
+    # the held-out slice
+    gen_name, n, X, Y, C, gamma = random_instance(
+        rng, seed, (192, 512), (2, 16), [1.0, 10.0], [0.25, 1.0, 4.0],
+        extra=128)
+    perm = rng.permutation(len(Y))
+    X, Y = X[perm], Y[perm]
+    Xq, Yq = X[n:], Y[n:]  # held-out slice
+    X, Y = X[:n], Y[:n]
+    sc = MinMaxScaler().fit(X)
+    Xs, Xqs = sc.transform(X), sc.transform(Xq)
+    cfg = SVMConfig(C=C, gamma=gamma, max_rounds=10)
+    solver = "blocked" if seed % 2 else "pair"
+    # capacity = n: rings at large C can make nearly every point an SV,
+    # and the tree rounds train (received SVs u own partition)
+    cc = lambda topo: CascadeConfig(  # noqa: E731 — tiny local factory
+        n_shards=shards, sv_capacity=n, topology=topo)
+
+    rec = {"seed": seed, "gen": gen_name, "n": n, "d": Xs.shape[1],
+           "C": C, "gamma": round(gamma, 6), "shards": shards,
+           "solver": solver, "topologies": {}, "violations": []}
+
+    models = {}
+    for topo in ("tree", "star"):
+        res = cascade_fit(Xs, Y, cfg, cc(topo), solver=solver)
+        yp = _predict(res.sv_X, res.sv_Y, res.sv_alpha, res.b, Xqs, gamma)
+        models[topo] = (set(res.sv_ids.tolist()), yp,
+                        float((yp == Yq).mean()))
+        rec["topologies"][topo] = {
+            "converged": bool(res.converged), "rounds": res.rounds,
+            "n_sv": len(res.sv_ids), "b": res.b,
+            "accuracy": models[topo][2],
+        }
+        if not res.converged:
+            rec["violations"].append(f"{topo}-not-converged")
+
+    sv_t, yp_t, acc_t = models["tree"]
+    sv_s, yp_s, acc_s = models["star"]
+    jac = len(sv_t & sv_s) / max(len(sv_t | sv_s), 1)
+    flips = int((yp_t != yp_s).sum())
+    rec["sv_jaccard"] = round(jac, 4)
+    rec["pred_flips"] = flips
+    if jac < 0.9:
+        rec["violations"].append("jaccard")
+    if flips > max(2, len(Yq) // 50):
+        rec["violations"].append("pred-disagreement")
+
+    # direct single-shard reference solve on the same instance
+    r = blocked_smo_solve(
+        jnp.asarray(Xs, jnp.float64), jnp.asarray(Y), C=C, gamma=gamma,
+        eps=cfg.eps, tau=cfg.tau, max_iter=cfg.max_iter,
+        accum_dtype=jnp.float64)
+    alpha = np.asarray(r.alpha)
+    sv = alpha > 1e-8
+    yp_d = _predict(Xs[sv], Y[sv], alpha[sv], float(r.b), Xqs, gamma)
+    rec["direct_accuracy"] = float((yp_d == Yq).mean())
+    rec["direct_status"] = Status(int(r.status)).name
+    if int(r.status) != Status.CONVERGED:
+        # an unconverged reference model would make the accuracy-gap
+        # check meaningless in either direction — flag it loudly
+        rec["violations"].append("direct-not-converged")
+    for topo, acc in (("tree", acc_t), ("star", acc_s)):
+        if abs(acc - rec["direct_accuracy"]) > 0.05:
+            rec["violations"].append(f"{topo}-accuracy-gap")
+    return rec
+
+
+def main(n_cases: int = 24, base_seed: int = 3000, shards: int = 4) -> int:
+    violations = 0
+    for i in range(n_cases):
+        rec = run_case(base_seed + i, shards)
+        print(json.dumps(rec), flush=True)
+        violations += len(rec["violations"])
+    print(json.dumps({
+        "summary": True, "cases": n_cases, "shards": shards,
+        "violations": violations, "platform": jax.default_backend(),
+        "n_devices": len(jax.devices()),
+    }), flush=True)
+    return 0 if violations == 0 else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main(*(int(a) for a in sys.argv[1:4])))

@@ -35,7 +35,7 @@ import jax
 
 jax.config.update("jax_enable_x64", True)
 
-from benchmarks.common import pin_platform  # noqa: E402
+from benchmarks.common import pin_platform, random_instance  # noqa: E402
 
 pin_platform()  # TPUSVM_PROBE_PLATFORM=cpu -> CPU backend (see helper)
 
@@ -43,7 +43,7 @@ import jax.numpy as jnp  # noqa: E402
 import numpy as np  # noqa: E402
 
 from tpusvm.config import SVMConfig  # noqa: E402
-from tpusvm.data import MinMaxScaler, blobs, rings  # noqa: E402
+from tpusvm.data import MinMaxScaler  # noqa: E402
 from tpusvm.oracle import get_sv_indices, smo_train  # noqa: E402
 from tpusvm.solver import smo_solve  # noqa: E402
 from tpusvm.solver.blocked import blocked_smo_solve  # noqa: E402
@@ -62,20 +62,14 @@ ENGINES = [
 
 def run_case(seed: int):
     rng = np.random.default_rng(seed)
-    gen = rings if rng.random() < 0.5 else blobs
-    n = int(rng.integers(96, 640))
-    d = int(rng.integers(2, 24)) if gen is blobs else 2
-    C = float(rng.choice([1.0, 10.0, 100.0]))
-    gamma = float(rng.choice([0.125, 0.5, 2.0, 10.0])) / max(1, d // 4)
-    kw = dict(n=n, seed=seed)
-    if gen is blobs:
-        kw["d"] = d
-    X, Y = gen(**kw)
+    gen_name, n, X, Y, C, gamma = random_instance(
+        rng, seed, (96, 640), (2, 24), [1.0, 10.0, 100.0],
+        [0.125, 0.5, 2.0, 10.0])
     Xs = MinMaxScaler().fit_transform(X)
     cfg = SVMConfig(C=C, gamma=gamma)
 
     o = smo_train(Xs, Y, cfg)
-    rec = {"seed": seed, "gen": gen.__name__, "n": n, "d": Xs.shape[1],
+    rec = {"seed": seed, "gen": gen_name, "n": n, "d": Xs.shape[1],
            "C": C, "gamma": round(gamma, 6),
            "oracle_status": Status(int(o.status)).name,
            "n_sv": int(len(get_sv_indices(o.alpha))),

@@ -100,6 +100,24 @@ def test_fuzz_parity_smoke_schema(capsys):
             assert verdict["ok"]
 
 
+def test_fuzz_cascade_smoke_schema(capsys):
+    # one random instance through tree AND star vs a direct solve: keeps
+    # the cascade fuzz harness runnable (committed 24-case run in
+    # benchmarks/results/fuzz_cascade_sim_cpu.jsonl)
+    from benchmarks import fuzz_cascade
+
+    rc = fuzz_cascade.main(1, 3001, 4)
+    recs = _records(capsys)
+    assert len(recs) == 2  # 1 case + summary
+    assert rc == 0 and recs[-1]["violations"] == 0
+    case = recs[0]
+    assert set(case["topologies"]) == {"tree", "star"}
+    for t in case["topologies"].values():
+        assert t["converged"] and t["n_sv"] > 0
+    assert case["sv_jaccard"] >= 0.9
+    assert case["direct_status"] == "CONVERGED"
+
+
 def test_sweep_p_tree_skips_non_power_of_two(capsys):
     from benchmarks import sweep_p
 
