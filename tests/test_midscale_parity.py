@@ -11,8 +11,8 @@ Opt-in because the float64 NumPy oracle takes minutes at n=2048:
 
     TPUSVM_RUN_MIDSCALE=1 python -m pytest tests/test_midscale_parity.py
 
-The committed capture of the same harness at n=2048 and n=4096 lives in
-benchmarks/results/midscale_parity_cpu.jsonl.
+The committed capture of the same harness at n ∈ {2048, 4096, 8192}
+lives in benchmarks/results/midscale_parity_cpu.jsonl.
 """
 
 import os
