@@ -209,10 +209,48 @@ def test_blocked_wss2_xla_same_optimum_fewer_updates():
     np.testing.assert_allclose(float(r2.b), float(r1.b), atol=1e-3)
 
 
+def test_blocked_wss2_survives_degenerate_partner_candidates():
+    """Regression (parity-fuzz seed 4047): rings with near-coincident
+    points used to kill the XLA wss=2 engine mid-solve — the gain
+    formula's clamped denominator made a near-duplicate of x[i_h] the
+    argmax partner, and the analytic update bails on eta <= eps
+    (NONPOS_ETA, b off by 0.22 while every other engine converged).
+    Degenerate partners are now excluded from the gain selection, so the
+    exact failing instance must converge to the same solution as wss=1
+    under BOTH selection modes."""
+    from benchmarks.common import random_instance
+
+    rng = np.random.default_rng(4047)
+    _, _, X, Y, C, gamma = random_instance(
+        rng, 4047, (96, 640), (2, 24), [1.0, 10.0, 100.0],
+        [0.125, 0.5, 2.0, 10.0])
+    Xs = MinMaxScaler().fit_transform(X)
+    kw = dict(C=C, gamma=gamma, tau=1e-5, q=256, max_inner=1024,
+              inner="xla", accum_dtype=jnp.float64)
+    r1 = blocked_smo_solve(jnp.asarray(Xs, jnp.float32), jnp.asarray(Y),
+                           **kw, wss=1)
+    assert int(r1.status) == Status.CONVERGED
+    sv1 = set(np.flatnonzero(np.asarray(r1.alpha) > 1e-8))
+    for selection in ("exact", "approx"):
+        r2 = blocked_smo_solve(jnp.asarray(Xs, jnp.float32),
+                               jnp.asarray(Y), **kw, wss=2,
+                               selection=selection)
+        assert int(r2.status) == Status.CONVERGED, selection
+        sv2 = set(np.flatnonzero(np.asarray(r2.alpha) > 1e-8))
+        assert len(sv1 ^ sv2) <= max(2, len(sv1) // 25), selection
+        np.testing.assert_allclose(float(r2.b), float(r1.b), atol=1e-3)
+
+
 def test_blocked_wss2_xla_matches_pallas_interpret_trajectory():
-    """Both engines implement the SAME wss=2 selection rule: on identical
-    subproblem inputs the XLA loop and the (interpreted) pallas kernel
-    must produce the same alpha trajectory to f32 resolution."""
+    """Both engines implement the SAME wss=2 selection rule on
+    non-degenerate data: on identical subproblem inputs (random floats —
+    no eta<=eps partner ever wins the gain argmax here) the XLA loop and
+    the (interpreted) pallas kernel must produce the same alpha
+    trajectory to f32 resolution. On DEGENERATE data the engines
+    deliberately diverge in trajectory (XLA excludes dead partners from
+    selection, pallas selects-then-shrinks) while reaching the same
+    optimum — see _inner_smo's docstring and
+    test_blocked_wss2_survives_degenerate_partner_candidates."""
     from tpusvm.ops.pallas.inner_smo import inner_smo_pallas
     from tpusvm.solver.blocked import _inner_smo
     from tpusvm.ops.rbf import rbf_cross

@@ -148,7 +148,15 @@ def _make_kernel(q: int, max_inner: int, wss: int, R: int, L: int):
                 # LIBSVM's WSS2, free here because row_h is already in
                 # VMEM): among violating I_low members, maximise
                 # (f_j - b_h)^2 / eta_j. The Keerthi STOP check above stays
-                # on the global (b_h, b_l) pair regardless.
+                # on the global (b_h, b_l) pair regardless. NOTE: a
+                # degenerate partner (true eta <= eps; the clamp below
+                # makes its gain huge) CAN win this argmax — the kernel
+                # then self-heals by SHRINKING the dead pair (see the
+                # zero-progress policy below), where the XLA loop instead
+                # excludes such partners from selection up front
+                # (solver/blocked.py _inner_smo, fuzz seed 4047). Same
+                # optimum; folding the exclusion in here awaits a hardware
+                # measurement (one more reduction in the hot loop).
                 eta_vec = jnp.maximum(K11 + diag - 2.0 * row_h, 1e-12)
                 viol = m_l & (f > b_h)
                 vg = jnp.where(viol, (f - b_h) ** 2 / eta_vec, -jnp.inf)

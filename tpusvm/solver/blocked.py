@@ -105,12 +105,23 @@ def _inner_smo(K_BB, y_B, a_B, f_B, active_B, C, eps, tau, max_inner,
     wss=1 picks i_low by first-order Keerthi argmax-f (the reference's
     heuristic, main3.cpp:124-142); wss=2 picks the maximal-gain partner —
     among violating I_low members j maximise (f_j - b_high)^2 / eta_j, the
-    LIBSVM-WSS2-style second-order rule, same math as the pallas kernel
-    (ops/pallas/inner_smo.py) so both engines reach the optimum in
-    comparably fewer updates. The Keerthi STOP decision stays on the
-    global (b_high, b_low) pair either way; when no violating partner
-    exists the iteration is exactly the converged/not-found exit (an
-    I_low member with f > b_high exists whenever b_low > b_high + 2*tau).
+    LIBSVM-WSS2-style second-order rule, the same math as the pallas
+    kernel (ops/pallas/inner_smo.py) on NON-degenerate partners, so both
+    engines reach the optimum in comparably fewer updates. The Keerthi
+    STOP decision stays on the global (b_high, b_low) pair either way;
+    when no violating partner exists the iteration is exactly the
+    converged/not-found exit (an I_low member with f > b_high exists
+    whenever b_low > b_high + 2*tau).
+
+    Degenerate-partner asymmetry (deliberate): partners with
+    eta <= eps are excluded from this loop's gain selection (the analytic
+    update bails on them, and without shrinking that would end the
+    subproblem — fuzz seed 4047), while the pallas kernel still selects
+    them and SELF-HEALS by shrinking the dead pair (its documented
+    zero-progress policy, hardware-proven). Same optimum either way; the
+    trajectories differ only when a degenerate candidate would win the
+    gain argmax. Folding the same exclusion into the kernel awaits a
+    hardware measurement (it adds a reduction to the kernel hot loop).
     """
     adt = f_B.dtype
     if wss == 2:
@@ -128,15 +139,29 @@ def _inner_smo(K_BB, y_B, a_B, f_B, active_B, C, eps, tau, max_inner,
         b_h = f_B[i_h]
         if wss == 2:
             # stop on the global Keerthi gap; partner by maximal gain
-            b_stop = jnp.max(jnp.where(m_l, f_B, -jnp.inf))
-            eta_vec = jnp.maximum(
-                K_BB[i_h, i_h].astype(adt) + diag_B
-                - 2.0 * K_BB[i_h, :].astype(adt),
-                1e-12,
-            )
-            viol = m_l & (f_B > b_h)
-            vg = jnp.where(viol, (f_B - b_h) ** 2 / eta_vec, -jnp.inf)
-            i_l = jnp.argmax(vg).astype(jnp.int32)
+            masked_low = jnp.where(m_l, f_B, -jnp.inf)
+            b_stop = jnp.max(masked_low)
+            raw_eta = (K_BB[i_h, i_h].astype(adt) + diag_B
+                       - 2.0 * K_BB[i_h, :].astype(adt))
+            # partners with eta <= eps are EXCLUDED from the gain
+            # selection: the clamped denominator would otherwise make a
+            # near-duplicate of x[i_h] the argmax (gain ~ 1/1e-12), and
+            # the analytic update bails on exactly that pair
+            # (NONPOS_ETA), ending a subproblem the first-order rule
+            # would have solved — found by the parity fuzz (seed 4047:
+            # rings with near-coincident points, approx+wss2 died
+            # mid-solve with b off by 0.22 while every other engine
+            # converged). The pallas kernel survives the same selection
+            # by SHRINKING the dead pair instead; the XLA loop prevents.
+            viol = m_l & (f_B > b_h) & (raw_eta > eps)
+            vg = jnp.where(viol, (f_B - b_h) ** 2
+                           / jnp.maximum(raw_eta, 1e-12), -jnp.inf)
+            i_l2 = jnp.argmax(vg).astype(jnp.int32)
+            # every violating partner degenerate w.r.t. i_h: fall back
+            # to the first-order pick — identical failure semantics to
+            # wss=1 on such data (the reference's own behaviour)
+            i_l1 = jnp.argmax(masked_low).astype(jnp.int32)
+            i_l = jnp.where(jnp.any(viol), i_l2, i_l1)
         else:
             i_l = jnp.argmax(jnp.where(m_l, f_B, -jnp.inf)).astype(jnp.int32)
             b_stop = None
