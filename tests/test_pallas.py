@@ -198,3 +198,31 @@ def test_fused_fupdate_traced_gamma_and_sn():
     b = rbf_cross_matvec_pallas(X, XB, coef, jnp.float32(0.5),
                                 sn=sq_norms(X), interpret=True)
     np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-6)
+
+
+def test_fused_fupdate_auto_block():
+    """Pins the VMEM-aware block sizing (the block=None default that
+    replaced the OOMing block=1024): calibrated bench shape, wide-feature
+    shrink, and the clear too-big-for-VMEM error instead of a Mosaic
+    compile OOM. Model calibration evidence is in _auto_block's docstring
+    (q=2048/d=784 hardware compile probes, round 4)."""
+    from tpusvm.ops.pallas.fused_fupdate import _auto_block
+
+    assert _auto_block(2048, 784) == 256       # bench shape: measured fit
+    assert _auto_block(1024, 784) == 512       # narrower q -> bigger block
+    assert _auto_block(256, 4096) == 512       # wide d shrinks the block
+    assert _auto_block(64, 64) == 1024         # tiny problems hit the cap
+    with pytest.raises(ValueError, match="XLA contraction"):
+        _auto_block(8192, 4096)                # resident XB^T > VMEM
+    with pytest.raises(ValueError, match="XLA contraction"):
+        _auto_block(16384, 256)                # floor block busts the stack
+    assert _auto_block(16384, 256, n=32) == 32  # small n lowers the floor
+    # interpret mode must NOT raise on chip-infeasible shapes: the solver's
+    # off-TPU fused path (interpret=True) falls back to the flat default
+    from tpusvm.ops.pallas.fused_fupdate import rbf_cross_matvec_pallas
+    rng = np.random.default_rng(5)
+    X = jnp.asarray(rng.random((64, 256)), jnp.float32)
+    XB = jnp.asarray(rng.random((16384, 256)), jnp.float32)
+    coef = jnp.zeros((16384,), jnp.float32)
+    out = rbf_cross_matvec_pallas(X, XB, coef, 0.1, interpret=True)
+    assert out.shape == (64,)

@@ -163,3 +163,24 @@ def test_compiled_fused_fupdate_matches_xla():
                                              block=256, interpret=False))
     want = np.asarray(rbf_cross_matvec(X, XB, coef, 0.00125))
     np.testing.assert_allclose(got, want, atol=1e-4)
+
+
+def test_compiled_fused_fupdate_under_x64():
+    """Under jax_enable_x64 (the bench's f64-accumulator mode) grid index
+    maps trace their integer returns as i64, which Mosaic cannot legalize
+    ("func.return (i64)") — this killed the round-4 fused_on capture
+    (benchmarks/results/tpu_capture_r4/fused_on.jsonl.err). The kernel now
+    traces its pallas_call under jax.enable_x64(False); this test compiles
+    and runs the fused contraction with x64 ON to pin the fix."""
+    from tpusvm.ops.pallas.fused_fupdate import rbf_cross_matvec_pallas
+    from tpusvm.ops.rbf import rbf_cross_matvec
+
+    rng = np.random.default_rng(11)
+    X = jnp.asarray(rng.random((500, 784)), jnp.float32)
+    XB = jnp.asarray(rng.random((256, 784)), jnp.float32)
+    coef = jnp.asarray(rng.standard_normal(256), jnp.float32)
+    with jax.enable_x64(True):
+        got = np.asarray(rbf_cross_matvec_pallas(X, XB, coef, 0.00125,
+                                                 block=256, interpret=False))
+    want = np.asarray(rbf_cross_matvec(X, XB, coef, 0.00125))
+    np.testing.assert_allclose(got, want, atol=1e-4)

@@ -52,6 +52,48 @@ def _kernel(gamma_ref, x_ref, sn_ref, xb_t_ref, snb_ref, coef_ref, out_ref):
     )
 
 
+def _auto_block(q: int, d: int, n: int | None = None) -> int:
+    """Largest power-of-two row block whose per-step stack fits Mosaic's
+    16 MB scoped-vmem limit, from the kernel's measured cost model:
+
+      stack(block) = 2*block*q*8 + block*d*4   (double-buffered (block, q)
+                      f32 slab pair + the (block, d) X input block)
+
+    calibrated against q=2048/d=784 compile measurements: block=1024 ->
+    model 36.7 MB vs 37.2 MB measured OOM, block=512 -> 18.4 vs 18.4 OOM,
+    block=256 -> 9.2, compiles. The measured scoped figures match the
+    stack-only model (no 4*q*d term), so the resident XB^T/snB/coef blocks
+    are NOT charged against the scoped stack — they are bounded separately
+    against total VMEM (~128 MB on v5e): huge q*d raises here, pointing at
+    the XLA path, instead of failing as an inscrutable Mosaic compile OOM.
+    """
+    resident = 4 * q * d + 12 * q
+    if resident > 64_000_000:
+        # budget half the chip's ~128 MB VMEM for the resident blocks,
+        # leaving the rest for the scoped stack + double-buffered X/out
+        raise ValueError(
+            f"fused f-update cannot fit VMEM at q={q}, d={d}: the resident "
+            f"XB^T block is {resident / 1e6:.1f} MB, over the ~64 MB "
+            "budgeted for resident blocks (half of the chip's ~128 MB "
+            "VMEM). Use the XLA contraction (fused_fupdate=False)."
+        )
+    cost = lambda b: b * (2 * q * 8 + d * 4)
+    # the grid never steps more than n rows, so small n lowers the floor
+    floor = 128 if n is None else max(8, min(128, n))
+    if cost(floor) > 15_000_000:
+        # tall-skinny XB: even the floor block's slab pair busts the stack
+        raise ValueError(
+            f"fused f-update cannot fit VMEM at q={q}, d={d}: the minimum "
+            f"{floor}-row step needs {cost(floor) / 1e6:.1f} MB of the "
+            "16 MB scoped stack. Use the XLA contraction "
+            "(fused_fupdate=False)."
+        )
+    block = floor
+    while block < 1024 and cost(2 * block) <= 12_000_000:
+        block *= 2
+    return block
+
+
 @functools.partial(
     jax.jit, static_argnames=("block", "interpret")
 )
@@ -62,7 +104,7 @@ def rbf_cross_matvec_pallas(
     gamma: float,
     sn: jax.Array | None = None,
     *,
-    block: int = 1024,
+    block: int | None = None,
     interpret: bool = False,
 ) -> jax.Array:
     """sum_k coef_k K(x_i, xb_k) for all i, fused in VMEM. Shape (n,).
@@ -85,31 +127,52 @@ def rbf_cross_matvec_pallas(
         sn = sq_norms(X)
     snB = sq_norms(XB)
 
+    if block is None:
+        if interpret:
+            # interpret mode has no VMEM: keep hardware's block when the
+            # shape fits (so interpret tests exercise the same grid), but
+            # fall back to the old flat default instead of raising on
+            # shapes only the real chip cannot hold
+            try:
+                block = _auto_block(q, d, n)
+            except ValueError:
+                block = 1024
+        else:
+            block = _auto_block(q, d, n)
     block = min(block, max(n, 8))
     nb = -(-n // block)
 
-    out = pl.pallas_call(
-        _kernel,
-        grid=(nb,),
-        in_specs=[
-            pl.BlockSpec(memory_space=pltpu.SMEM),  # gamma
-            pl.BlockSpec((block, d), lambda i: (i, 0)),
-            pl.BlockSpec((block, 1), lambda i: (i, 0)),
-            # XB^T, snB, coef: whole-array blocks, identical every step —
-            # the compiler keeps them resident in VMEM across the grid
-            pl.BlockSpec((d, q), lambda i: (0, 0)),
-            pl.BlockSpec((1, q), lambda i: (0, 0)),
-            pl.BlockSpec((q, 1), lambda i: (0, 0)),
-        ],
-        out_specs=pl.BlockSpec((block, 1), lambda i: (i, 0)),
-        out_shape=jax.ShapeDtypeStruct((n, 1), jnp.float32),
-        interpret=interpret,
-    )(
-        jnp.asarray(gamma, jnp.float32).reshape(1),
-        X.astype(jnp.float32),
-        sn.astype(jnp.float32)[:, None],
-        XB.astype(jnp.float32).T,
-        snB.astype(jnp.float32)[None, :],
-        coef.astype(jnp.float32)[:, None],
-    )
+    # Trace the pallas_call with x64 promotion OFF: under jax_enable_x64
+    # the grid index maps' integer returns promote to i64, which Mosaic
+    # cannot legalize ("func.return (i64)" — reproduced on TPU v5e with a
+    # minimal grid kernel, so it is the platform's grid lowering, not this
+    # kernel). Every operand here is explicitly f32, so disabling
+    # promotion inside the call changes nothing semantically. The grid-less
+    # inner_smo kernel never hits this (no index maps).
+    with jax.enable_x64(False):
+        out = pl.pallas_call(
+            _kernel,
+            grid=(nb,),
+            in_specs=[
+                pl.BlockSpec(memory_space=pltpu.SMEM),  # gamma
+                pl.BlockSpec((block, d), lambda i: (i, 0)),
+                pl.BlockSpec((block, 1), lambda i: (i, 0)),
+                # XB^T, snB, coef: whole-array blocks, identical every
+                # step — the compiler keeps them resident in VMEM across
+                # the grid
+                pl.BlockSpec((d, q), lambda i: (0, 0)),
+                pl.BlockSpec((1, q), lambda i: (0, 0)),
+                pl.BlockSpec((q, 1), lambda i: (0, 0)),
+            ],
+            out_specs=pl.BlockSpec((block, 1), lambda i: (i, 0)),
+            out_shape=jax.ShapeDtypeStruct((n, 1), jnp.float32),
+            interpret=interpret,
+        )(
+            jnp.asarray(gamma, jnp.float32).reshape(1),
+            X.astype(jnp.float32),
+            sn.astype(jnp.float32)[:, None],
+            XB.astype(jnp.float32).T,
+            snB.astype(jnp.float32)[None, :],
+            coef.astype(jnp.float32)[:, None],
+        )
     return out[:, 0].astype(X.dtype)

@@ -152,7 +152,8 @@ def _inner_smo(K_BB, y_B, a_B, f_B, active_B, C, eps, tau, max_inner,
             # rings with near-coincident points, approx+wss2 died
             # mid-solve with b off by 0.22 while every other engine
             # converged). The pallas kernel survives the same selection
-            # by SHRINKING the dead pair instead; the XLA loop prevents.
+            # by SHRINKING the dead pair instead; the XLA loop prevents
+            # the dead selection up front via this eta exclusion.
             viol = m_l & (f_B > b_h) & (raw_eta > eps)
             vg = jnp.where(viol, (f_B - b_h) ** 2
                            / jnp.maximum(raw_eta, 1e-12), -jnp.inf)
