@@ -415,55 +415,92 @@ def main():
             fallback = ("canary harness failed (kernel unvetted): "
                         + f"{type(ce).__name__}: {ce}"[:300])
             canary_passed = False
+        # fused f-update canary (round-4 adoption made the fused kernel
+        # the TPU default, so it joins the "vet before the one heavy
+        # measurement" club): tiny-shape fused contraction checked
+        # against the XLA contraction it replaces. A compiles-but-
+        # miscomputes Mosaic regression here would poison f and burn the
+        # unattended headline with canary_passed=True — exactly the
+        # class the inner-kernel canary exists to catch. Any failure
+        # pins fused_fupdate=False for the run (recorded via
+        # solver_config.fused_fupdate + the fallback note); it does not
+        # touch canary_passed, which describes the inner engine.
+        try:
+            from tpusvm.ops.pallas.fused_fupdate import (
+                rbf_cross_matvec_pallas,
+            )
+            from tpusvm.ops.rbf import rbf_cross_matvec
 
-    class _AlreadyFailed(Exception):
-        """Sentinel: the canary-selected flat layout failed at full size;
-        retrying it would recompile the identical failing config."""
+            rngf = np.random.default_rng(1)
+            Xf = jnp.asarray(rngf.random((384, 8)), jnp.float32)
+            XBf = jnp.asarray(rngf.random((128, 8)), jnp.float32)
+            cf = jnp.asarray(rngf.standard_normal(128), jnp.float32)
+            got = np.asarray(rbf_cross_matvec_pallas(
+                Xf, XBf, cf, 0.5, interpret=False))
+            want = np.asarray(rbf_cross_matvec(Xf, XBf, cf, 0.5))
+            np.testing.assert_allclose(got, want, atol=1e-4)
+        except Exception as ce:  # noqa: BLE001 — any fused canary failure
+            msg = f"{type(ce).__name__}: {ce}"[:300]
+            log(f"WARNING: fused f-update canary failed; pinning "
+                f"fused_fupdate=False for this run: {msg}")
+            fallback = (fallback + " | " if fallback else "") + \
+                f"fused canary: {msg}"
+            static_kwargs = dict(static_kwargs, fused_fupdate=False)
 
     log("compiling solver (AOT)...")
     t0 = time.perf_counter()
-    try:
-        compiled = blocked_smo_solve.lower(
-            Xd, Yd, **traced_kwargs, **static_kwargs
-        ).compile()
-    except Exception as e:  # noqa: BLE001 — any lowering/compile failure
-        # Insurance for the unattended round-end run: a Mosaic lowering
-        # regression must degrade the headline, not lose it. Chain:
-        # packed-layout kernel (tuned) -> flat-layout kernel (the round-1
-        # hardware-proven lowering) -> XLA inner engine (always compiles,
-        # ~10x slower). The JSON record gets each failure truncated to
-        # ~300 chars (Mosaic failures embed whole IR dumps and the output
-        # contract is ONE parseable JSON line); the FULL text of every
-        # failure goes to stderr here.
-        e_full = f"{type(e).__name__}: {e}"
-        fallback = (fallback + " | " if fallback else "") + e_full[:300]
-        log(f"WARNING: the {engine} config failed to compile at full "
-            f"size. Full error:\n{e_full}")
-        if engine == "xla":
-            # the always-compilable engine itself failed: nothing lower
-            # to fall to — surface the error rather than loop
-            raise
+    # Insurance for the unattended round-end run: a Mosaic lowering
+    # regression must degrade the headline, not lose it. Degradation
+    # ladder: tuned config (fused f-update resolves 'auto', i.e. ON for
+    # TPU at this shape) -> fused f-update off (same inner engine) ->
+    # flat-layout inner kernel (the round-1 hardware-proven lowering,
+    # fused off) -> XLA inner engine (always compiles, ~10x slower,
+    # fused off). The JSON record gets each failure truncated to ~300
+    # chars (Mosaic failures embed whole IR dumps and the output
+    # contract is ONE parseable JSON line); the FULL text of every
+    # failure goes to stderr.
+    from tpusvm.solver.blocked import (
+        resolve_fused_fupdate,
+        resolve_solver_config,
+    )
+
+    # the fused-off rung exists only when rung 0 actually runs fused —
+    # otherwise 'auto' already resolves False and the rung would retry
+    # the identical failing config (doubling the failure wall-clock and
+    # duplicating the error note)
+    rung0_fused = resolve_fused_fupdate(
+        Xd.shape[0], Xd.shape[1], q=static_kwargs["q"],
+        fused=static_kwargs.get("fused_fupdate", "auto"),
+        matmul_precision=static_kwargs.get("matmul_precision"),
+    )
+    base = (dict(static_kwargs, fused_fupdate=False) if rung0_fused
+            else static_kwargs)
+    ladder = [(static_kwargs, engine)]
+    if rung0_fused:
+        ladder.append((base, engine))
+    if engine == "pallas-packed":
+        ladder.append((dict(base, pallas_layout="flat"), "pallas-flat"))
+    if engine != "xla":
+        ladder.append((dict(base, inner="xla"), "xla"))
+    for i, (kw, eng) in enumerate(ladder):
         try:
-            if engine == "pallas-flat":
-                raise _AlreadyFailed from e
-            log("WARNING: trying the flat-layout kernel")
-            static_kwargs = dict(static_kwargs, pallas_layout="flat")
             compiled = blocked_smo_solve.lower(
-                Xd, Yd, **traced_kwargs, **static_kwargs
+                Xd, Yd, **traced_kwargs, **kw
             ).compile()
-            engine = "pallas-flat"
-        except Exception as e2:  # noqa: BLE001
-            if not isinstance(e2, _AlreadyFailed):
-                e2_full = f"{type(e2).__name__}: {e2}"
-                log(f"WARNING: flat-layout kernel also failed. Full "
-                    f"error:\n{e2_full}")
-                fallback = f"{fallback} | {e2_full[:300]}"
-            log("WARNING: falling back to inner='xla' (wss=2 retained)")
-            static_kwargs = dict(static_kwargs, inner="xla")
-            engine = "xla"
-            compiled = blocked_smo_solve.lower(
-                Xd, Yd, **traced_kwargs, **static_kwargs
-            ).compile()
+            static_kwargs, engine = kw, eng
+            break
+        except Exception as e:  # noqa: BLE001 — any lowering/compile error
+            e_full = f"{type(e).__name__}: {e}"
+            fallback = (fallback + " | " if fallback else "") + e_full[:300]
+            log(f"WARNING: the {eng} config (rung {i}: "
+                f"fused_fupdate={kw.get('fused_fupdate', 'auto')!r}, "
+                f"layout={kw.get('pallas_layout', 'packed')}) failed to "
+                f"compile at full size. Full error:\n{e_full}")
+            if i == len(ladder) - 1:
+                # the always-compilable engine itself failed: nothing
+                # lower to fall to — surface the error rather than loop
+                raise
+            log("WARNING: trying the next ladder rung")
     log(f"compile: {time.perf_counter() - t0:.1f}s")
 
     # Effective config via the solver's own resolution rules (the shared
@@ -472,14 +509,18 @@ def main():
     # degraded record is self-describing: selection='auto' resolves by
     # backend (approx on TPU, exact elsewhere) and any canary/compile
     # fallback's engine change shows up here, not just as stderr text.
-    from tpusvm.solver.blocked import resolve_solver_config
-
     eff_q, eff_inner, eff_wss, eff_selection = resolve_solver_config(
         Xd.shape[0],
         q=static_kwargs["q"],
         inner=static_kwargs.get("inner", "auto"),
         wss=static_kwargs.get("wss", 1),
         selection=static_kwargs.get("selection", "auto"),
+    )
+    eff_fused = resolve_fused_fupdate(
+        Xd.shape[0], Xd.shape[1],
+        q=static_kwargs["q"],
+        fused=static_kwargs.get("fused_fupdate", "auto"),
+        matmul_precision=static_kwargs.get("matmul_precision"),
     )
 
     # Force the H2D transfer of X/Y to COMPLETE before the timed region
@@ -568,6 +609,10 @@ def main():
                         "selection": eff_selection,
                         "max_inner": static_kwargs["max_inner"],
                         "max_outer": static_kwargs["max_outer"],
+                        # fused f-update contraction (round-4 adoption:
+                        # 'auto' = on for TPU at this shape); False on a
+                        # compile-fallback rung or off-TPU
+                        "fused_fupdate": eff_fused,
                     },
                     # True: the engine above was canary-vetted (or is the
                     # reference XLA engine); False: the canary harness

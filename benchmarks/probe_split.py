@@ -73,11 +73,19 @@ n_sv = int((np.asarray(r.alpha) > 1e-8).sum())
 # what actually ran (q clamps to n; selection='auto' resolves by backend)
 q_eff, inner_eff, wss_eff, selection_eff = resolve_solver_config(
     Xd.shape[0], q=q, wss=wss, selection=selection)
+from tpusvm.solver.blocked import resolve_fused_fupdate  # noqa: E402
+
+# the harness passes an explicit bool, so fused_eff == fused today; the
+# field exists so a future 'auto' probe row stays self-describing
+fused_eff = resolve_fused_fupdate(
+    Xd.shape[0], Xd.shape[1], q=q, fused=fused,
+    matmul_precision=precision)
 print(json.dumps({"q": q, "max_inner": max_inner, "wss": wss,
                   "precision": precision, "refine": refine,
                   "selection": selection, "fused": fused,
                   "q_eff": q_eff, "inner_eff": inner_eff,
                   "wss_eff": wss_eff, "selection_eff": selection_eff,
+                  "fused_eff": fused_eff,
                   "platform": jax.default_backend(),
                   "outers": out[0], "updates": out[1], "status": out[2],
                   "n_sv": n_sv, "b": float(np.asarray(r.b)),

@@ -82,6 +82,7 @@ def test_bench_plain_cpu_uses_xla_engine(bench_mod):
         "selection": "exact",
         "max_inner": 32768,  # the deeper CPU-fallback inner budget
         "max_outer": 5000,
+        "fused_fupdate": False,  # 'auto' resolves off on a CPU backend
     }
 
 
@@ -119,15 +120,20 @@ def test_bench_canary_total_fault_degrades_to_xla(bench_mod, fake_tpu,
 
 def test_bench_canary_harness_crash_marks_unvetted(bench_mod, fake_tpu,
                                                    monkeypatch):
-    import tpusvm.solver.blocked as blocked_mod
+    import tpusvm.ops.rbf as rbf_mod
 
-    def broken_oracle(*a, **kw):
+    def broken_rbf_cross(*a, **kw):
         raise RuntimeError("synthetic canary-harness fault")
 
-    # _inner_smo breaking fails the harness BEFORE the per-layout loop:
+    # rbf_cross breaking fails the harness BEFORE the per-layout loop:
     # the distinct-marker path (ADVICE r2) — engine stays the intended
-    # config but the record must say it ran unvetted
-    monkeypatch.setattr(blocked_mod, "_inner_smo", broken_oracle)
+    # config but the record must say it ran unvetted. The canary imports
+    # rbf_cross freshly inside main() (module-attribute lookup -> sees the
+    # patch) while the solver bound its own reference at import time and
+    # keeps working — a genuinely canary-only fault, unlike breaking
+    # _inner_smo, which is the XLA inner engine itself (that only ever
+    # "passed" here by cache-hitting a sibling test's jit lowering)
+    monkeypatch.setattr(rbf_mod, "rbf_cross", broken_rbf_cross)
     d = _run(bench_mod)
     assert d["canary_passed"] is False
     assert "canary harness failed" in d["compile_fallback"]

@@ -346,3 +346,29 @@ def test_resolve_solver_config_matches_solver_behavior():
     _, inner, wss, _ = resolve_solver_config(
         60000, 2048, inner="pallas", wss=2, selection="approx")
     assert (inner, wss) == ("pallas", 2)
+
+
+def test_resolve_fused_fupdate_rules():
+    """round-4 adoption: fused_fupdate='auto' must resolve OFF on a CPU
+    backend (the kernel would interpret), pass explicit bools through,
+    reject junk, and gate on the kernel's VMEM feasibility model."""
+    from tpusvm.ops.pallas.fused_fupdate import fused_feasible
+    from tpusvm.solver.blocked import resolve_fused_fupdate
+
+    # this suite runs on CPU: auto is always off here
+    assert resolve_fused_fupdate(60000, 784, q=2048) is False
+    # explicit requests pass through regardless of backend
+    assert resolve_fused_fupdate(60000, 784, q=2048, fused=True) is True
+    assert resolve_fused_fupdate(60000, 784, q=2048, fused=False) is False
+    with pytest.raises(ValueError, match="fused_fupdate must be"):
+        resolve_fused_fupdate(60000, 784, q=2048, fused="yes")
+    # a truthy int must not sneak past as True (1 == True but 1 is not
+    # True, and the solver's bf16 rejection checks `is True`)
+    with pytest.raises(ValueError, match="fused_fupdate must be"):
+        resolve_fused_fupdate(60000, 784, q=2048, fused=1)
+    # the feasibility model the TPU-side auto gate consults: the bench
+    # shape fits; a huge resident XB^T block (q*d over the ~64 MB budget)
+    # or a tall-skinny stack-busting d does not
+    assert fused_feasible(2048, 784, 60000) is True
+    assert fused_feasible(8192, 8192) is False       # resident blowup
+    assert fused_feasible(128, 1_000_000, 8) is False  # floor-block stack

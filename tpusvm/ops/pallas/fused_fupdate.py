@@ -18,8 +18,13 @@ equivalent MXU passes), matching ops/rbf.py's DEFAULT_PRECISION="float32"
 trust anchor — NOT raw single-pass bf16. Off TPU use interpret=True
 (true f32 math).
 
-Opt-in: wired behind blocked_smo_solve(fused_fupdate=True); the XLA path
-remains the default until the fusion is measured faster on real hardware.
+Default on TPU since the round-4 hardware A/B (blocked_smo_solve's
+fused_fupdate='auto' -> solver.blocked.resolve_fused_fupdate): at the
+bench shape the fused kernel measured 0.476/0.478 s vs 0.497 s for the
+XLA contraction in the same session (benchmarks/results/tpu_capture_r4/
+fused_fixed_*.jsonl) — at-or-under the two-matmul path's time while
+removing its (n, q) HBM slab traffic. Off TPU, at bf16 precision, or on
+VMEM-infeasible / unaligned shapes, 'auto' keeps the XLA contraction.
 """
 
 from __future__ import annotations
@@ -52,6 +57,38 @@ def _kernel(gamma_ref, x_ref, sn_ref, xb_t_ref, snb_ref, coef_ref, out_ref):
     )
 
 
+def _resident_bytes(q: int, d: int) -> int:
+    """VMEM held for the whole grid: XB^T (4qd) + snB/coef (12q)."""
+    return 4 * q * d + 12 * q
+
+
+def _floor_block(n: int | None) -> int:
+    """Smallest row block the grid can step (the final-block mask lets
+    small n lower the 128-row floor)."""
+    return 128 if n is None else max(8, min(128, n))
+
+
+def _stack_bytes(block: int, q: int, d: int) -> int:
+    """Scoped-stack cost of one grid step: double-buffered (block, q)
+    f32 slab pair + the (block, d) X input block."""
+    return block * (2 * q * 8 + d * 4)
+
+
+_RESIDENT_BUDGET = 64_000_000  # half of v5e's ~128 MB VMEM
+_STACK_BUDGET_FLOOR = 15_000_000  # 16 MB Mosaic scoped stack, with margin
+
+
+def fused_feasible(q: int, d: int, n: int | None = None) -> bool:
+    """True iff the kernel's VMEM cost model admits (q, d, n).
+
+    The boolean face of _auto_block's two raise conditions (same helpers,
+    same budgets) — lets fused_fupdate='auto' resolution fall back to the
+    XLA contraction instead of raising on shapes the chip cannot hold.
+    """
+    return (_resident_bytes(q, d) <= _RESIDENT_BUDGET
+            and _stack_bytes(_floor_block(n), q, d) <= _STACK_BUDGET_FLOOR)
+
+
 def _auto_block(q: int, d: int, n: int | None = None) -> int:
     """Largest power-of-two row block whose per-step stack fits Mosaic's
     16 MB scoped-vmem limit, from the kernel's measured cost model:
@@ -67,8 +104,8 @@ def _auto_block(q: int, d: int, n: int | None = None) -> int:
     against total VMEM (~128 MB on v5e): huge q*d raises here, pointing at
     the XLA path, instead of failing as an inscrutable Mosaic compile OOM.
     """
-    resident = 4 * q * d + 12 * q
-    if resident > 64_000_000:
+    resident = _resident_bytes(q, d)
+    if resident > _RESIDENT_BUDGET:
         # budget half the chip's ~128 MB VMEM for the resident blocks,
         # leaving the rest for the scoped stack + double-buffered X/out
         raise ValueError(
@@ -77,19 +114,18 @@ def _auto_block(q: int, d: int, n: int | None = None) -> int:
             "budgeted for resident blocks (half of the chip's ~128 MB "
             "VMEM). Use the XLA contraction (fused_fupdate=False)."
         )
-    cost = lambda b: b * (2 * q * 8 + d * 4)
     # the grid never steps more than n rows, so small n lowers the floor
-    floor = 128 if n is None else max(8, min(128, n))
-    if cost(floor) > 15_000_000:
+    floor = _floor_block(n)
+    if _stack_bytes(floor, q, d) > _STACK_BUDGET_FLOOR:
         # tall-skinny XB: even the floor block's slab pair busts the stack
         raise ValueError(
             f"fused f-update cannot fit VMEM at q={q}, d={d}: the minimum "
-            f"{floor}-row step needs {cost(floor) / 1e6:.1f} MB of the "
-            "16 MB scoped stack. Use the XLA contraction "
+            f"{floor}-row step needs {_stack_bytes(floor, q, d) / 1e6:.1f} "
+            "MB of the 16 MB scoped stack. Use the XLA contraction "
             "(fused_fupdate=False)."
         )
     block = floor
-    while block < 1024 and cost(2 * block) <= 12_000_000:
+    while block < 1024 and _stack_bytes(2 * block, q, d) <= 12_000_000:
         block *= 2
     return block
 

@@ -51,6 +51,11 @@ from tpusvm.status import Status
 _PALLAS_LANE = 128
 
 
+def _clamp_q(n: int, q: int) -> int:
+    """q clamps to the (even) training-set size; tiny n floors at 2."""
+    return min(q, n if n % 2 == 0 else n - 1) if n >= 2 else 2
+
+
 def resolve_solver_config(n: int, q: int = 1024, inner: str = "auto",
                           wss: int = 1, selection: str = "auto"):
     """Effective (q, inner, wss, selection) blocked_smo_solve will run.
@@ -68,13 +73,54 @@ def resolve_solver_config(n: int, q: int = 1024, inner: str = "auto",
     it layers its own validation errors (explicit inner='pallas' with
     unaligned q) on top.
     """
-    q = min(q, n if n % 2 == 0 else n - 1) if n >= 2 else 2
+    q = _clamp_q(n, q)
     if selection == "auto":
         selection = "approx" if jax.default_backend() == "tpu" else "exact"
     if inner == "auto":
         inner = ("pallas" if jax.default_backend() == "tpu"
                  and q % _PALLAS_LANE == 0 else "xla")
     return q, inner, wss, selection
+
+
+def resolve_fused_fupdate(n: int, d: int, *, q: int = 1024,
+                          fused="auto", matmul_precision=None) -> bool:
+    """Effective fused_fupdate flag blocked_smo_solve will run.
+
+    Companion to resolve_solver_config (same contract: benchmarks that
+    record per-row effective config derive it from here, and the solver
+    itself resolves through this helper). 'auto' — the default since the
+    round-4 hardware A/B (benchmarks/results/tpu_capture_r4/
+    fused_fixed_*.jsonl: fused 0.476/0.478 s vs unfused 0.497 s
+    same-session at the bench shape, plus the eliminated (n, q) HBM
+    slabs) — resolves to True exactly when the kernel can actually run:
+    on a real TPU backend (off-TPU the kernel would interpret, orders of
+    magnitude slower than the XLA contraction), at full-f32 precision
+    (matmul_precision='default' requests bf16, which the fused dot does
+    not implement), and when the (q, d) shape fits the kernel's VMEM
+    model (fused_feasible). Explicit True keeps the current behavior:
+    raise on bf16 or VMEM-infeasible shapes rather than silently running
+    something else. q is clamped to n the same way resolve_solver_config
+    clamps it.
+    """
+    # identity checks, not membership: `1 in (True, False, 'auto')` is
+    # True (1 == True), which would let a truthy int bypass the bf16
+    # rejection the solver applies only to `fused is True`
+    if fused is True or fused is False:
+        return fused
+    if fused != "auto":
+        raise ValueError(
+            f"fused_fupdate must be True, False or 'auto', got {fused!r}"
+        )
+    if jax.default_backend() != "tpu" or matmul_precision == "default":
+        return False
+    from tpusvm.ops.pallas.fused_fupdate import fused_feasible
+
+    q = _clamp_q(n, q)
+    # lane-aligned q only, mirroring the inner-engine 'auto' gate:
+    # every hardware proof of this kernel (A/B, canary shapes) ran
+    # lane-aligned; unaligned-q problems are small ones where the
+    # XLA contraction is already cheap
+    return q % _PALLAS_LANE == 0 and fused_feasible(q, d, n)
 
 
 class _OuterState(NamedTuple):
@@ -251,7 +297,7 @@ def blocked_smo_solve(
     wss: int = 1,
     matmul_precision: Optional[str] = None,
     selection: str = "auto",
-    fused_fupdate: bool = False,
+    fused_fupdate="auto",
     pallas_layout: str = "packed",
 ) -> SMOResult:
     """Train to the reference's stopping criterion with blocked working sets.
@@ -319,15 +365,19 @@ def blocked_smo_solve(
     round that would progress under exact selection progresses under
     approx too (no spurious STALLED terminations).
 
-    fused_fupdate (static, experimental): route the O(n*d*q) error-vector
-    contraction through the fused Pallas kernel
-    (ops/pallas/fused_fupdate.py) — distance matmul, exp, and coefficient
-    matvec in one VMEM pipeline, eliminating the (n, q) intermediate
-    slabs the XLA path materialises in HBM between its two matmuls. The
-    fused dot runs at precision=HIGHEST (the full-f32 trust-anchor tier);
-    combining with matmul_precision="default" (raw bf16) raises. Refine
-    reconstructions keep the XLA path either way (rare, off the hot
-    loop). Default off until measured faster on real hardware.
+    fused_fupdate (static): route the O(n*d*q) error-vector contraction
+    through the fused Pallas kernel (ops/pallas/fused_fupdate.py) —
+    distance matmul, exp, and coefficient matvec in one VMEM pipeline,
+    eliminating the (n, q) intermediate slabs the XLA path materialises
+    in HBM between its two matmuls. "auto" (default since the round-4
+    hardware A/B measured the fused kernel at/under the XLA path's time
+    while cutting its HBM slab traffic; see resolve_fused_fupdate) =
+    fused on TPU when the (q, d) shape fits the kernel's VMEM model,
+    XLA contraction otherwise. The fused dot runs at precision=HIGHEST
+    (the full-f32 trust-anchor tier); explicit True combined with
+    matmul_precision="default" (raw bf16) raises, while "auto" simply
+    resolves to the XLA path there. Refine reconstructions keep the XLA
+    path either way (rare, off the hot loop).
 
     pallas_layout (static): vector layout inside the fused inner kernel —
     "packed" = sublane-packed (q//128, 128) full-vreg layout, "flat" =
@@ -375,13 +425,17 @@ def blocked_smo_solve(
         raise ValueError(
             f"pallas_layout must be packed|flat, got {pallas_layout!r}"
         )
-    if fused_fupdate and matmul_precision == "default":
+    if fused_fupdate is True and matmul_precision == "default":
         raise ValueError(
             "fused_fupdate runs the contraction at the full-f32 trust-"
             "anchor tier (precision=HIGHEST) and cannot honour "
             "matmul_precision='default' (raw bf16); use the XLA path for "
             "reduced precision"
         )
+    fused_fupdate = resolve_fused_fupdate(
+        n, X.shape[1], q=q, fused=fused_fupdate,
+        matmul_precision=matmul_precision,
+    )
     if matmul_precision == "default" and (refine <= 0 or max_refines < 1):
         raise ValueError(
             "matmul_precision='default' (raw bf16 MXU passes) accumulates "
