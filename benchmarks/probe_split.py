@@ -36,7 +36,20 @@ if precision in ("", "none", "None"):
     precision = None  # lets later positional args be passed explicitly
 refine = int(sys.argv[6]) if len(sys.argv) > 6 else 0
 selection = sys.argv[7] if len(sys.argv) > 7 else "auto"
-fused = len(sys.argv) > 8 and sys.argv[8] in ("1", "fused", "true")
+if len(sys.argv) > 8:
+    _ftok = sys.argv[8]
+    if _ftok in ("1", "fused", "true"):
+        fused = True
+    elif _ftok in ("0", "false"):
+        fused = False
+    elif _ftok == "auto":
+        fused = "auto"  # the TPU-default resolution (round-4 adoption)
+    else:
+        raise SystemExit(
+            f"fused argument must be 1|fused|true|0|false|auto, got {_ftok!r}"
+        )
+else:
+    fused = False
 
 # DELIBERATELY the headline benchmark's frozen recipe (bench.py — see its
 # docstring: noise=30/label_noise=0.005, kept for cross-round

@@ -1,0 +1,75 @@
+#!/usr/bin/env bash
+# Post-adoption TPU refresh batch (round 4, after fused_fupdate became the
+# TPU default): re-capture the artifacts whose committed rows predate the
+# tuned solver config, plus a repeated headline under the new default.
+#
+#   scripts/capture_tpu_refresh.sh [outdir]   # default: benchmarks/results/tpu_refresh_<utc>
+#
+# Same operating constraints as capture_tpu_round.sh (verify skill):
+# one heavy measurement per process, pre-flight the relay/backend, bound
+# every step, tolerate per-step failure, pause between processes.
+set -uo pipefail
+cd "$(dirname "$0")/.."
+
+OUT=${1:-benchmarks/results/tpu_refresh_$(date -u +%Y%m%dT%H%M%SZ)}
+mkdir -p "$OUT"
+echo "capturing to $OUT" >&2
+
+if ! pgrep -f relay.py >/dev/null 2>&1; then
+  if python - <<'EOF'
+import importlib.util, sys
+sys.exit(0 if importlib.util.find_spec("axon") else 1)
+EOF
+  then
+    echo "FATAL: axon tunnel relay process is dead — backend init would" \
+         "hang. See the verify skill root-cause check." >&2
+    exit 2
+  fi
+fi
+if ! timeout 240 python -c "import jax; assert jax.devices()[0].platform == 'tpu', jax.devices()"; then
+  echo "FATAL: TPU backend did not initialise as platform=tpu within 240s" >&2
+  exit 2
+fi
+echo "pre-flight OK: TPU backend live" >&2
+sleep 10
+
+step () {  # step <name> <logfile> <cmd...>
+  local name=$1 log=$2; shift 2
+  echo "=== $name ===" >&2
+  if timeout 1800 "$@" >"$log" 2>"$log.err"; then
+    echo "$name OK -> $log" >&2
+  else
+    echo "WARNING: $name failed/hung (rc=$?); continuing — see $log.err" >&2
+  fi
+  sleep 30
+}
+
+# (a) headline under the adopted fused default, three repeats for a
+#     noise-banded quote (the committed single capture sits in a ~12%
+#     run-to-run band)
+for i in 1 2 3; do
+  step "headline_fused_$i" "$OUT/bench_headline_fused_$i.json" python bench.py
+done
+
+# (b) n-sweep refresh (B3): the committed sweep_n_tpu_v5e.jsonl rows are
+#     round-1 (q=1024/max_inner=1024/wss=1, pre-tuning); harness defaults
+#     are now the tuned config. One size per process.
+for n in 10000 20000 30000 40000 50000 60000; do
+  step "sweep_n_$n" "$OUT/sweep_n_$n.jsonl" \
+    python benchmarks/sweep_n.py --sizes "$n"
+done
+
+# (c) 10-class OVR refresh: the committed ovr_10class_tpu_v5e.jsonl row is
+#     round-1 (27.8 s train, pre-tuning)
+step ovr_10class "$OUT/ovr_10class.jsonl" python benchmarks/ovr_10class.py
+
+# (d) fast-edge grid probes under the adopted fused kernel (the r4 grid's
+#     two fastest rows measured unfused; args: q mi max_outer wss
+#     precision refine selection fused)
+step probe_q2048_mi8192_fused "$OUT/probe_q2048_mi8192_fused.jsonl" \
+  python benchmarks/probe_split.py 2048 8192 5000 2 none 0 approx fused
+step probe_q1536_mi8192_fused "$OUT/probe_q1536_mi8192_fused.jsonl" \
+  python benchmarks/probe_split.py 1536 8192 5000 2 none 0 approx fused
+
+echo "capture complete: $OUT — merge sweep rows, update" \
+     "benchmarks/results/README.md + README.md headline quotes" >&2
