@@ -88,8 +88,8 @@ q_eff, inner_eff, wss_eff, selection_eff = resolve_solver_config(
     Xd.shape[0], q=q, wss=wss, selection=selection)
 from tpusvm.solver.blocked import resolve_fused_fupdate  # noqa: E402
 
-# the harness passes an explicit bool, so fused_eff == fused today; the
-# field exists so a future 'auto' probe row stays self-describing
+# for explicit-bool rows fused_eff == fused; for fused='auto' rows this
+# is the backend-time resolution, making the row self-describing
 fused_eff = resolve_fused_fupdate(
     Xd.shape[0], Xd.shape[1], q=q, fused=fused,
     matmul_precision=precision)

@@ -372,6 +372,7 @@ def test_resolve_fused_fupdate_rules():
     assert fused_feasible(2048, 784, 60000) is True
     assert fused_feasible(8192, 8192) is False       # resident blowup
     # stack branch in isolation: resident fits (7.7 MB) but the 128-row
-    # floor block's slab pair (15.49 MB) busts the 15 MB scoped budget
+    # floor block's per-step stack (15.49 MB — dominated by the
+    # (block, d) X input block at this wide d) busts the 15 MB budget
     assert fused_feasible(64, 30000) is False
     assert fused_feasible(128, 1_000_000, 8) is False  # both budgets blown
