@@ -11,8 +11,10 @@ Opt-in because the float64 NumPy oracle takes minutes at n=2048:
 
     TPUSVM_RUN_MIDSCALE=1 python -m pytest tests/test_midscale_parity.py
 
-The committed capture of the same harness at n ∈ {2048, 4096, 8192}
-lives in benchmarks/results/midscale_parity_cpu.jsonl.
+The committed capture of the same harness at n ∈ {2048, 4096, 8192,
+16384} lives in benchmarks/results/midscale_parity_cpu.jsonl (the 16384
+rows: identical SV sets on all six engines; two f32 engines sit at
+0.0034% b drift — see the results README for the |b|-scale context).
 """
 
 import os
