@@ -20,10 +20,21 @@ refine discussion) — observed spread there is ~0.005-0.01% relative,
 identical for exact and approx selection (so it is precision, not
 selection), while the f64 pair solver stays within 1e-4 absolute.
 
-Usage: python benchmarks/fuzz_parity.py [n_cases] [base_seed]
+Usage: python benchmarks/fuzz_parity.py [n_cases] [base_seed] [mode]
 Emits one JSON line per case with per-engine verdicts, then a summary
 line {cases, engines, violations}. A committed run lives in
 benchmarks/results/fuzz_parity_cpu.jsonl.
+
+mode='pallas' fuzzes the PALLAS inner engine instead (the kernel every
+TPU headline runs; interpret mode off-TPU — true f32 math, same
+program): inner='pallas' at q=128 across the wss grid, with the
+instance n range floored at 160 so the clamped q stays lane-aligned
+(128 | q). The kernel's deviations from the XLA loop are documented in
+ops/pallas/inner_smo.py (f32 subproblem, shrinking instead of bail-out)
+and covered by the same tau-band SV allowance; its committed run lives
+in benchmarks/results/fuzz_parity_pallas_cpu.jsonl. Keeps its own
+seed-for-seed reproduction contract (the default mode's committed rows
+predate this flag and are unchanged).
 """
 import json
 import os
@@ -59,11 +70,30 @@ ENGINES = [
     ("blocked-approx-wss2", dict(selection="approx", wss=2), False),
 ]
 
+# mode='pallas': the single-launch kernel across the wss grid (selection
+# exact keeps the working-set pick deterministic; the kernel itself is
+# the thing under test). q=128 (lane-aligned, R=1 — the flat-equivalent
+# packed layout) with n floored at 160 so clamping never unaligns q.
+PALLAS_ENGINES = [
+    ("pair-f64", None, True),
+    ("blocked-pallas-wss1",
+     dict(selection="exact", wss=1, inner="pallas"), False),
+    ("blocked-pallas-wss2",
+     dict(selection="exact", wss=2, inner="pallas"), False),
+]
 
-def run_case(seed: int):
+
+def engines_for(mode: str):
+    return PALLAS_ENGINES if mode == "pallas" else ENGINES
+
+
+def run_case(seed: int, mode: str = "xla"):
+    engines = engines_for(mode)
+    n_range = (160, 640) if mode == "pallas" else (96, 640)
+    q = 128 if mode == "pallas" else 256
     rng = np.random.default_rng(seed)
     gen_name, n, X, Y, C, gamma = random_instance(
-        rng, seed, (96, 640), (2, 24), [1.0, 10.0, 100.0],
+        rng, seed, n_range, (2, 24), [1.0, 10.0, 100.0],
         [0.125, 0.5, 2.0, 10.0])
     Xs = MinMaxScaler().fit_transform(X)
     cfg = SVMConfig(C=C, gamma=gamma)
@@ -85,14 +115,16 @@ def run_case(seed: int):
     # one jit cache entry per (n, d) shape per engine config; the fuzz
     # intentionally varies shapes, so expect recompiles — correctness run,
     # not a timing run
-    for name, opts, f64 in ENGINES:
+    for name, opts, f64 in engines:
         if opts is None:
             r = smo_solve(jnp.asarray(Xs, jnp.float64), jnp.asarray(Y),
                           **common)
         else:
+            opts = dict(opts)
+            inner = opts.pop("inner", "xla")
             r = blocked_smo_solve(
                 jnp.asarray(Xs, jnp.float32), jnp.asarray(Y),
-                q=256, max_inner=1024, max_outer=2000, inner="xla",
+                q=q, max_inner=1024, max_outer=2000, inner=inner,
                 **opts, **common)
         sv = set(get_sv_indices(np.asarray(r.alpha)).tolist())
         sym = len(sv ^ sv_o)
@@ -113,17 +145,22 @@ def run_case(seed: int):
     return rec
 
 
-def main(n_cases: int = 64, base_seed: int = 1000) -> int:
+def main(n_cases: int = 64, base_seed: int = 1000,
+         mode: str = "xla") -> int:
+    if mode not in ("xla", "pallas"):
+        raise SystemExit(f"mode must be xla|pallas, got {mode!r}")
     violations = 0
     skipped = 0
     for i in range(n_cases):
-        rec = run_case(base_seed + i)
+        rec = run_case(base_seed + i, mode=mode)
         print(json.dumps(rec), flush=True)
         skipped += int(bool(rec.get("skipped")))
         violations += len(rec["violations"])
     print(json.dumps({
         "summary": True, "cases": n_cases, "skipped_degenerate": skipped,
-        "engines": [e[0] for e in ENGINES], "violations": violations,
+        "mode": mode,
+        "engines": [e[0] for e in engines_for(mode)],
+        "violations": violations,
         "platform": jax.default_backend(),
     }), flush=True)
     return 0 if violations == 0 else 1
@@ -131,4 +168,5 @@ def main(n_cases: int = 64, base_seed: int = 1000) -> int:
 
 if __name__ == "__main__":
     sys.exit(main(int(sys.argv[1]) if len(sys.argv) > 1 else 64,
-                  int(sys.argv[2]) if len(sys.argv) > 2 else 1000))
+                  int(sys.argv[2]) if len(sys.argv) > 2 else 1000,
+                  sys.argv[3] if len(sys.argv) > 3 else "xla"))

@@ -100,6 +100,27 @@ def test_fuzz_parity_smoke_schema(capsys):
             assert verdict["ok"]
 
 
+def test_fuzz_parity_pallas_mode_smoke(capsys):
+    # one random instance through the PALLAS inner engine (interpret off
+    # TPU — the kernel every TPU headline runs) vs the oracle: keeps the
+    # mode='pallas' fuzz path runnable (committed 64-case batch in
+    # benchmarks/results/fuzz_parity_pallas_cpu.jsonl)
+    from benchmarks import fuzz_parity
+
+    rc = fuzz_parity.main(1, 5000, "pallas")
+    recs = _records(capsys)
+    assert len(recs) == 2  # 1 case + summary
+    summary = recs[-1]
+    assert summary["mode"] == "pallas"
+    assert rc == 0 and summary["violations"] == 0
+    rec = recs[0]
+    if not rec.get("skipped"):
+        assert set(rec["engines"]) == {
+            "pair-f64", "blocked-pallas-wss1", "blocked-pallas-wss2"}
+        for verdict in rec["engines"].values():
+            assert verdict["ok"]
+
+
 def test_fuzz_cascade_smoke_schema(capsys):
     # one random instance through tree AND star vs a direct solve: keeps
     # the cascade fuzz harness runnable (committed 24-case run in
