@@ -12,9 +12,10 @@ Opt-in because the float64 NumPy oracle takes minutes at n=2048:
     TPUSVM_RUN_MIDSCALE=1 python -m pytest tests/test_midscale_parity.py
 
 The committed capture of the same harness at n ∈ {2048, 4096, 8192,
-16384} lives in benchmarks/results/midscale_parity_cpu.jsonl (the 16384
-rows: identical SV sets on all six engines; two f32 engines sit at
-0.0034% b drift — see the results README for the |b|-scale context).
+16384, 32768} lives in benchmarks/results/midscale_parity_cpu.jsonl
+(16384/32768 rows: f64 pair exact at every size; f32 engines identical
+SV sets except one 32768 boundary flip, absolute b agreement ≤1.4e-4 —
+see the results README for the |b|-scale context on the strict band).
 """
 
 import os
