@@ -29,12 +29,17 @@ mode='pallas' fuzzes the PALLAS inner engine instead (the kernel every
 TPU headline runs; interpret mode off-TPU — true f32 math, same
 program): inner='pallas' at q=128 across the wss grid, with the
 instance n range floored at 160 so the clamped q stays lane-aligned
-(128 | q). The kernel's deviations from the XLA loop are documented in
-ops/pallas/inner_smo.py (f32 subproblem, shrinking instead of bail-out)
-and covered by the same tau-band SV allowance; its committed run lives
-in benchmarks/results/fuzz_parity_pallas_cpu.jsonl. Keeps its own
-seed-for-seed reproduction contract (the default mode's committed rows
-predate this flag and are unchanged).
+(128 | q). mode='pallas-packed' raises q to 256 (n floored at 288) —
+the smallest GENUINE multi-row packed layout (R=2: cross-sublane index
+mapping and reductions, the lowering the q=2048 headline runs at R=16;
+q=128 is R=1, bitwise the flat layout). The kernel's deviations from
+the XLA loop are documented in ops/pallas/inner_smo.py (f32 subproblem,
+shrinking instead of bail-out) and covered by the same tau-band SV
+allowance; committed runs live in
+benchmarks/results/fuzz_parity_pallas_cpu.jsonl (one batch per mode;
+the summary rows carry the mode). Each mode keeps its own seed-for-seed
+reproduction contract (the default mode's committed rows predate this
+flag and are unchanged).
 """
 import json
 import os
@@ -70,10 +75,10 @@ ENGINES = [
     ("blocked-approx-wss2", dict(selection="approx", wss=2), False),
 ]
 
-# mode='pallas': the single-launch kernel across the wss grid (selection
-# exact keeps the working-set pick deterministic; the kernel itself is
-# the thing under test). q=128 (lane-aligned, R=1 — the flat-equivalent
-# packed layout) with n floored at 160 so clamping never unaligns q.
+# the pallas modes: the single-launch kernel across the wss grid
+# (selection exact keeps the working-set pick deterministic; the kernel
+# itself is the thing under test). Which layout the kernel runs — and
+# the q / n-floor that selects it — is per-mode, in MODES below.
 PALLAS_ENGINES = [
     ("pair-f64", None, True),
     ("blocked-pallas-wss1",
@@ -83,14 +88,25 @@ PALLAS_ENGINES = [
 ]
 
 
+# mode -> (engines, instance n range, working-set size q). The two
+# pallas modes differ in which kernel layout the clamped q exercises:
+# q=128 is R=1 (bitwise the flat layout), q=256 is the smallest GENUINE
+# multi-row packed layout (R=2 — cross-sublane index mapping and
+# reductions, the lowering the q=2048 headline runs at R=16); each
+# floors n so clamping never unaligns q.
+MODES = {
+    "xla": (ENGINES, (96, 640), 256),
+    "pallas": (PALLAS_ENGINES, (160, 640), 128),
+    "pallas-packed": (PALLAS_ENGINES, (288, 768), 256),
+}
+
+
 def engines_for(mode: str):
-    return PALLAS_ENGINES if mode == "pallas" else ENGINES
+    return MODES[mode][0]
 
 
 def run_case(seed: int, mode: str = "xla"):
-    engines = engines_for(mode)
-    n_range = (160, 640) if mode == "pallas" else (96, 640)
-    q = 128 if mode == "pallas" else 256
+    engines, n_range, q = MODES[mode]
     rng = np.random.default_rng(seed)
     gen_name, n, X, Y, C, gamma = random_instance(
         rng, seed, n_range, (2, 24), [1.0, 10.0, 100.0],
@@ -147,8 +163,9 @@ def run_case(seed: int, mode: str = "xla"):
 
 def main(n_cases: int = 64, base_seed: int = 1000,
          mode: str = "xla") -> int:
-    if mode not in ("xla", "pallas"):
-        raise SystemExit(f"mode must be xla|pallas, got {mode!r}")
+    if mode not in MODES:
+        raise SystemExit(
+            f"mode must be one of {sorted(MODES)}, got {mode!r}")
     violations = 0
     skipped = 0
     for i in range(n_cases):

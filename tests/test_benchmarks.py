@@ -100,18 +100,21 @@ def test_fuzz_parity_smoke_schema(capsys):
             assert verdict["ok"]
 
 
-def test_fuzz_parity_pallas_mode_smoke(capsys):
+@pytest.mark.parametrize("mode,seed", [("pallas", 5000),
+                                       ("pallas-packed", 7000)])
+def test_fuzz_parity_pallas_mode_smoke(capsys, mode, seed):
     # one random instance through the PALLAS inner engine (interpret off
-    # TPU — the kernel every TPU headline runs) vs the oracle: keeps the
-    # mode='pallas' fuzz path runnable (committed 64-case batch in
-    # benchmarks/results/fuzz_parity_pallas_cpu.jsonl)
+    # TPU — the kernel every TPU headline runs) vs the oracle: keeps both
+    # pallas fuzz modes runnable — q=128 (R=1, flat-equivalent) and
+    # q=256 (R=2, the genuine multi-row packed layout) — committed
+    # 64-case batches in benchmarks/results/fuzz_parity_pallas_cpu.jsonl
     from benchmarks import fuzz_parity
 
-    rc = fuzz_parity.main(1, 5000, "pallas")
+    rc = fuzz_parity.main(1, seed, mode)
     recs = _records(capsys)
     assert len(recs) == 2  # 1 case + summary
     summary = recs[-1]
-    assert summary["mode"] == "pallas"
+    assert summary["mode"] == mode
     assert rc == 0 and summary["violations"] == 0
     rec = recs[0]
     if not rec.get("skipped"):
