@@ -28,7 +28,12 @@ jax.config.update("jax_enable_x64", True)
 
 import numpy as np  # noqa: E402
 
-from benchmarks.common import emit, log, pin_platform  # noqa: E402
+from benchmarks.common import (  # noqa: E402
+    emit,
+    log,
+    pin_platform,
+    workload_record,
+)
 
 pin_platform()  # TPUSVM_PROBE_PLATFORM=cpu -> CPU backend (see helper)
 
@@ -75,8 +80,8 @@ def main(argv=None) -> int:
     total = args.n + args.n_test
     from tpusvm.data.synthetic import BENCH_NOISE_MULTICLASS
 
-    X, labels = mnist_like_multiclass(n=total, d=args.d,
-                                      noise=BENCH_NOISE_MULTICLASS)
+    wl = dict(n=total, d=args.d, noise=BENCH_NOISE_MULTICLASS)
+    X, labels = mnist_like_multiclass(**wl)
     Xtr, ytr = X[: args.n], labels[: args.n]
     Xte, yte = X[args.n :], labels[args.n :]
 
@@ -117,6 +122,9 @@ def main(argv=None) -> int:
     emit({
         "n": args.n,
         "d": args.d,
+        # SYNTHETIC MNIST-shaped multiclass instance, not real MNIST;
+        # derived from the generator call (n = train+test generated rows)
+        "workload": workload_record(mnist_like_multiclass, **wl),
         "classes": len(model.classes_),
         "solver": args.solver,
         # requested blocked-solver knobs ({} for pair); the solver resolves

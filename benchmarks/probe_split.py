@@ -1,9 +1,11 @@
 """One-shot probe: time the blocked solver at a given (q, max_inner, max_outer).
 
 Usage: python benchmarks/probe_split.py <q> <max_inner> <max_outer> \
-           [wss] [matmul_precision] [refine] [selection] [fused]
+           [wss] [matmul_precision] [refine] [selection] [fused] [layout]
 Prints one JSON line {q, max_inner, ..., n_sv, b, time_s}. One heavy
 measurement per process (axon runtime faults on repeats — see verify skill).
+layout (packed|flat) reaches blocked_smo_solve's pallas_layout — needed to
+reproduce the round-1 shipping config (flat) for same-session A/Bs.
 """
 import json
 import os
@@ -16,7 +18,7 @@ import jax
 
 jax.config.update("jax_enable_x64", True)
 
-from benchmarks.common import pin_platform  # noqa: E402
+from benchmarks.common import pin_platform, workload_record  # noqa: E402
 
 pin_platform()  # TPUSVM_PROBE_PLATFORM=cpu -> CPU backend (see helper)
 
@@ -50,6 +52,9 @@ if len(sys.argv) > 8:
         )
 else:
     fused = False
+layout = sys.argv[9] if len(sys.argv) > 9 else "packed"
+if layout not in ("packed", "flat"):
+    raise SystemExit(f"layout argument must be packed|flat, got {layout!r}")
 
 # DELIBERATELY the headline benchmark's frozen recipe (bench.py — see its
 # docstring: noise=30/label_noise=0.005, kept for cross-round
@@ -58,7 +63,8 @@ else:
 # Different seed from bench.py (0 vs 587): tuning on a sibling instance
 # of the same distribution guards against overfitting knobs to the
 # measured instance.
-X, Y = mnist_like(n=60000, d=784, seed=0, noise=30, label_noise=0.005)
+_WL = dict(n=60000, d=784, seed=0, noise=30.0, label_noise=0.005)
+X, Y = mnist_like(**_WL)
 Xs = MinMaxScaler().fit_transform(X)
 Xd = jnp.asarray(Xs, jnp.float32)
 Yd = jnp.asarray(Y, jnp.int32)
@@ -69,7 +75,7 @@ solve = jax.jit(
         q=q, max_inner=max_inner, max_outer=max_outer, wss=wss,
         accum_dtype=jnp.float64, matmul_precision=precision,
         refine=refine, max_refines=4, selection=selection,
-        fused_fupdate=fused,
+        fused_fupdate=fused, pallas_layout=layout,
     )
 )
 lowered = solve.lower(Xd, Yd).compile()
@@ -96,6 +102,8 @@ fused_eff = resolve_fused_fupdate(
 print(json.dumps({"q": q, "max_inner": max_inner, "wss": wss,
                   "precision": precision, "refine": refine,
                   "selection": selection, "fused": fused,
+                  "layout": layout,
+                  "workload": workload_record(mnist_like, **_WL),
                   "q_eff": q_eff, "inner_eff": inner_eff,
                   "wss_eff": wss_eff, "selection_eff": selection_eff,
                   "fused_eff": fused_eff,

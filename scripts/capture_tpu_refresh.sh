@@ -46,9 +46,26 @@ step () {  # step <name> <logfile> <cmd...>
 
 # (a) headline under the adopted fused default, three repeats for a
 #     noise-banded quote (the committed single capture sits in a ~12%
-#     run-to-run band)
+#     run-to-run band) — INTERLEAVED with same-session A/B rows:
+#       ab_tuned    = the shipping config (q=2048/mi=4096/wss=2/approx/
+#                     fused-auto/packed) via probe_split (fixed seed-0
+#                     sibling instance of the headline workload)
+#       ab_round1   = the exact round-1 shipping config (q=1024/mi=1024/
+#                     wss=1/exact/unfused/FLAT layout) — settles the
+#                     open tuned-vs-untuned question (round-1's 0.4133 s
+#                     vs round-4's 0.46-0.53 s has never been measured
+#                     in one session)
+#       ab_fusedoff = tuned config with fused f-update OFF — the round-4
+#                     fused adoption rested on a single unfused sample;
+#                     three interleaved repeats give it a noise band
 for i in 1 2 3; do
   step "headline_fused_$i" "$OUT/bench_headline_fused_$i.json" python bench.py
+  step "ab_tuned_$i" "$OUT/ab_tuned_$i.jsonl" \
+    python benchmarks/probe_split.py 2048 4096 5000 2 none 0 approx auto packed
+  step "ab_round1_$i" "$OUT/ab_round1_$i.jsonl" \
+    python benchmarks/probe_split.py 1024 1024 5000 1 none 0 exact 0 flat
+  step "ab_fusedoff_$i" "$OUT/ab_fusedoff_$i.jsonl" \
+    python benchmarks/probe_split.py 2048 4096 5000 2 none 0 approx 0 packed
 done
 
 # (b) n-sweep refresh (B3): the committed sweep_n_tpu_v5e.jsonl rows are
@@ -56,6 +73,15 @@ done
 #     are now the tuned config. One size per process.
 for n in 10000 20000 30000 40000 50000 60000; do
   step "sweep_n_$n" "$OUT/sweep_n_$n.jsonl" \
+    python benchmarks/sweep_n.py --sizes "$n"
+done
+
+# (b2) BEYOND the reference's 60k ceiling (gpu_svm_main4.cu:487-498 caps
+#      its sweep there): show the solver leaving the ~1%-of-HBM
+#      latency-bound regime as the O(n*d*q) contraction grows. f32 X at
+#      480k x 784 is ~1.5 GB — comfortably HBM-resident on one v5e chip.
+for n in 120000 240000 480000; do
+  step "sweep_n_big_$n" "$OUT/sweep_n_big_$n.jsonl" \
     python benchmarks/sweep_n.py --sizes "$n"
 done
 

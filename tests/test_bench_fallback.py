@@ -39,6 +39,11 @@ def _run(bench):
         bench.main()
     rec = json.loads(buf.getvalue().strip().splitlines()[-1])
     assert np.isfinite(rec["value"])
+    # every record self-describes its data provenance (VERDICT r4 #4):
+    # the headline trains a SYNTHETIC instance and must say so in the
+    # one JSON line a dashboard ingests
+    assert rec["workload"]["synthetic"] is True
+    assert rec["workload"]["gen"] == "mnist_like"
     return rec["detail"]
 
 
@@ -339,6 +344,7 @@ def test_bench_reexec_emits_last_resort_record_when_child_dies(
     out = capsys.readouterr().out
     rec = json.loads(out.strip().splitlines()[-1])
     assert rec["value"] is None
+    assert rec["workload"]["synthetic"] is True
     assert rec["detail"]["init_fallback"] == "synthetic: total backend outage"
     assert rec["detail"]["cpu_child_rc"] == 3
 
@@ -362,6 +368,7 @@ def test_bench_cpu_fallback_child_end_to_end():
     assert p.returncode == 0, p.stderr[-2000:]
     rec = json.loads(p.stdout.strip().splitlines()[-1])
     assert np.isfinite(rec["value"])
+    assert rec["workload"]["synthetic"] is True
     d = rec["detail"]
     assert d["platform"] == "cpu"
     assert d["engine"] == "xla"

@@ -83,7 +83,8 @@ def resolve_solver_config(n: int, q: int = 1024, inner: str = "auto",
 
 
 def resolve_fused_fupdate(n: int, d: int, *, q: int = 1024,
-                          fused="auto", matmul_precision=None) -> bool:
+                          fused="auto", matmul_precision=None,
+                          backend: Optional[str] = None) -> bool:
     """Effective fused_fupdate flag blocked_smo_solve will run.
 
     Companion to resolve_solver_config (same contract: benchmarks that
@@ -105,13 +106,32 @@ def resolve_fused_fupdate(n: int, d: int, *, q: int = 1024,
     # identity checks, not membership: `1 in (True, False, 'auto')` is
     # True (1 == True), which would let a truthy int bypass the bf16
     # rejection the solver applies only to `fused is True`
-    if fused is True or fused is False:
-        return fused
+    if fused is True:
+        # mirror blocked_smo_solve's validation: explicit fused=True with
+        # bf16 matmuls is a config the solver REJECTS, so the helper must
+        # not report fused_eff=True for it (a benchmark deriving its
+        # recorded "effective config" from here would otherwise describe
+        # a run that cannot exist)
+        if matmul_precision == "default":
+            raise ValueError(
+                "fused_fupdate=True cannot honour matmul_precision="
+                "'default' (raw bf16); blocked_smo_solve rejects this "
+                "combination — use fused='auto' or the XLA path"
+            )
+        return True
+    if fused is False:
+        return False
     if fused != "auto":
         raise ValueError(
             f"fused_fupdate must be True, False or 'auto', got {fused!r}"
         )
-    if jax.default_backend() != "tpu" or matmul_precision == "default":
+    # backend override: callers that have already established which
+    # platform the run targets (bench.py's canary gate, which must agree
+    # with its own devices[0].platform detection rather than re-derive it)
+    # can pin it; None = the live default backend, which is what the
+    # solver itself and effective-config records use
+    if (backend or jax.default_backend()) != "tpu" \
+            or matmul_precision == "default":
         return False
     from tpusvm.ops.pallas.fused_fupdate import fused_feasible
 
@@ -425,13 +445,9 @@ def blocked_smo_solve(
         raise ValueError(
             f"pallas_layout must be packed|flat, got {pallas_layout!r}"
         )
-    if fused_fupdate is True and matmul_precision == "default":
-        raise ValueError(
-            "fused_fupdate runs the contraction at the full-f32 trust-"
-            "anchor tier (precision=HIGHEST) and cannot honour "
-            "matmul_precision='default' (raw bf16); use the XLA path for "
-            "reduced precision"
-        )
+    # fused=True + bf16 matmuls is rejected INSIDE resolve_fused_fupdate
+    # (single source of truth; the fused contraction runs at the full-f32
+    # trust-anchor tier and cannot honour matmul_precision='default')
     fused_fupdate = resolve_fused_fupdate(
         n, X.shape[1], q=q, fused=fused_fupdate,
         matmul_precision=matmul_precision,
