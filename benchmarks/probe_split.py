@@ -1,11 +1,16 @@
 """One-shot probe: time the blocked solver at a given (q, max_inner, max_outer).
 
 Usage: python benchmarks/probe_split.py <q> <max_inner> <max_outer> \
-           [wss] [matmul_precision] [refine] [selection] [fused] [layout]
+           [wss] [matmul_precision] [refine] [selection] [fused] [layout] \
+           [eta_exclude] [multipair]
 Prints one JSON line {q, max_inner, ..., n_sv, b, time_s}. One heavy
 measurement per process (axon runtime faults on repeats — see verify skill).
 layout (packed|flat) reaches blocked_smo_solve's pallas_layout — needed to
 reproduce the round-1 shipping config (flat) for same-session A/Bs.
+eta_exclude (0|1) reaches pallas_eta_exclude — the VERDICT r4 #5 unified
+selection rule's hardware cost measurement (wss=2 only).
+multipair (int, default 1) reaches pallas_multipair — the batched
+slot-pair kernel (VERDICT r4 #3); requires wss=1 and lane-aligned slots.
 """
 import json
 import os
@@ -55,6 +60,8 @@ else:
 layout = sys.argv[9] if len(sys.argv) > 9 else "packed"
 if layout not in ("packed", "flat"):
     raise SystemExit(f"layout argument must be packed|flat, got {layout!r}")
+eta_exclude = bool(int(sys.argv[10])) if len(sys.argv) > 10 else False
+multipair = int(sys.argv[11]) if len(sys.argv) > 11 else 1
 
 # DELIBERATELY the headline benchmark's frozen recipe (bench.py — see its
 # docstring: noise=30/label_noise=0.005, kept for cross-round
@@ -76,6 +83,7 @@ solve = jax.jit(
         accum_dtype=jnp.float64, matmul_precision=precision,
         refine=refine, max_refines=4, selection=selection,
         fused_fupdate=fused, pallas_layout=layout,
+        pallas_eta_exclude=eta_exclude, pallas_multipair=multipair,
     )
 )
 lowered = solve.lower(Xd, Yd).compile()
@@ -102,7 +110,8 @@ fused_eff = resolve_fused_fupdate(
 print(json.dumps({"q": q, "max_inner": max_inner, "wss": wss,
                   "precision": precision, "refine": refine,
                   "selection": selection, "fused": fused,
-                  "layout": layout,
+                  "layout": layout, "eta_exclude": eta_exclude,
+                  "multipair": multipair,
                   "workload": workload_record(mnist_like, **_WL),
                   "q_eff": q_eff, "inner_eff": inner_eff,
                   "wss_eff": wss_eff, "selection_eff": selection_eff,

