@@ -30,7 +30,8 @@ Engines compared against the float64 NumPy oracle (tpusvm.oracle.smo):
                     selection auto->exact) so the headline-producing
                     configuration itself is oracle-anchored
 
-Usage: python benchmarks/midscale_parity.py [--anchor oracle|pair] [n ...]
+Usage: python benchmarks/midscale_parity.py \
+           [--anchor oracle|pair|blocked64] [n ...]
 (default: oracle anchor, sizes 2048 4096)
 Emits one JSON line per (n, engine) with n_sv / b / accuracy / timings and
 per-engine deltas vs the anchor, then one summary line per n. Rows are
@@ -45,6 +46,14 @@ solver reproduced the oracle's SV set EXACTLY with b to <= 5e-12%, so at
 60k it stands in as the serial-precision anchor (the role the
 reference's own n=60k comparison gives its CPU build). Delta/summary
 fields carry the anchor name ('..._vs_pair', summary.anchor).
+
+--anchor blocked64 (round 5) goes one rung further for sizes beyond the
+reference's 60k ceiling where even the pair solver is prohibitive
+(~a week at n=480000 single-core): an f64-end-to-end BLOCKED solve
+anchors, cross-checking production f32 precision at scale; the
+working-set schedule itself stays anchored transitively by the
+committed oracle -> pair -> blocked chain (exact SV sets through
+n=60000). See run_size's docstring for the full caveat.
 """
 import json
 import os
@@ -113,9 +122,23 @@ def run_size(n: int, anchor: str = "oracle"):
     oracle's SV set EXACTLY with b to <= 5e-12% — it is the oracle's
     trajectory twin, so at 60k it stands in as the serial-precision
     anchor the reference's own comparison used its CPU build for.
-    Delta/summary field names carry the anchor ('..._vs_pair')."""
-    if anchor not in ("oracle", "pair"):
-        raise SystemExit(f"anchor must be oracle|pair, got {anchor!r}")
+    Delta/summary field names carry the anchor ('..._vs_pair').
+
+    anchor='blocked64': a BLOCKED solve with float64 features AND f64
+    accumulators (exact selection, wss=2) anchors, and both the oracle
+    and the pair solver are skipped — for sizes beyond the reference's
+    60k ceiling where even the pair solver is prohibitive (its 60k run
+    took 10039 s single-core; at 480k the O(n*d) per-update stream and
+    the grown update count put it around a WEEK). This is a weaker
+    anchor than oracle/pair — same algorithm family as the engines under
+    test, so it cross-checks PRECISION (f64 end-to-end vs production
+    f32+f64), not the working-set schedule; the schedule itself is
+    anchored transitively by the committed chain (oracle -> pair ->
+    blocked, exact SV sets through n=60000). Field names carry
+    '..._vs_blocked64'."""
+    if anchor not in ("oracle", "pair", "blocked64"):
+        raise SystemExit(
+            f"anchor must be oracle|pair|blocked64, got {anchor!r}")
     # train/test from sibling seeds of the frozen recipe (bench.py uses
     # seed=587 at n=60k; a different seed here guards against tuning any
     # tolerance to the measured instance)
@@ -152,26 +175,47 @@ def run_size(n: int, anchor: str = "oracle"):
             f"acc_delta_vs_{anchor}": round(acc - acc_a, 6),
         }
 
-    # --- pair solver, f64 features: the oracle's trajectory twin ---
-    t0 = time.perf_counter()
-    j = smo_solve(jnp.asarray(Xs, jnp.float64), jnp.asarray(Y), C=CFG.C,
-                  gamma=CFG.gamma, eps=CFG.eps, tau=CFG.tau,
-                  max_iter=CFG.max_iter)
-    a_j = np.asarray(j.alpha)
-    j_s = time.perf_counter() - t0
-    sv_j = get_sv_indices(a_j)
-    acc_j = _accuracy(a_j, j.b, jnp.float64)
-    if anchor == "pair":
-        sv_a, b_a, acc_a = sv_j, float(j.b), acc_j
-        pair_extra = {"iterations": int(j.n_iter), "is_anchor": True}
+    rows = {}
+    if anchor != "blocked64":
+        # --- pair solver, f64 features: the oracle's trajectory twin ---
+        t0 = time.perf_counter()
+        j = smo_solve(jnp.asarray(Xs, jnp.float64), jnp.asarray(Y),
+                      C=CFG.C, gamma=CFG.gamma, eps=CFG.eps, tau=CFG.tau,
+                      max_iter=CFG.max_iter)
+        a_j = np.asarray(j.alpha)
+        j_s = time.perf_counter() - t0
+        sv_j = get_sv_indices(a_j)
+        acc_j = _accuracy(a_j, j.b, jnp.float64)
+        if anchor == "pair":
+            sv_a, b_a, acc_a = sv_j, float(j.b), acc_j
+            pair_extra = {"iterations": int(j.n_iter), "is_anchor": True}
+        else:
+            pair_extra = {"iterations": int(j.n_iter),
+                          **_deltas(sv_j, float(j.b), acc_j)}
+        _row(n, "pair-f64", j.status, len(sv_j), float(j.b), acc_j, j_s,
+             sv_j, pair_extra)
+        rows["pair-f64"] = (sv_j, float(j.b), acc_j)
     else:
-        pair_extra = {"iterations": int(j.n_iter),
-                      **_deltas(sv_j, float(j.b), acc_j)}
-    _row(n, "pair-f64", j.status, len(sv_j), float(j.b), acc_j, j_s, sv_j,
-         pair_extra)
+        # --- f64-end-to-end blocked anchor (see docstring) ---
+        t0 = time.perf_counter()
+        jb = blocked_smo_solve(
+            jnp.asarray(Xs, jnp.float64), jnp.asarray(Y), C=CFG.C,
+            gamma=CFG.gamma, eps=CFG.eps, tau=CFG.tau,
+            max_iter=CFG.max_iter, q=2048, max_inner=8192, wss=2,
+            selection="exact", max_outer=5000, inner="xla",
+            accum_dtype=jnp.float64)
+        a_jb = np.asarray(jb.alpha)
+        jb_s = time.perf_counter() - t0
+        sv_jb = get_sv_indices(a_jb)
+        acc_jb = _accuracy(a_jb, float(jb.b), jnp.float64)
+        sv_a, b_a, acc_a = sv_jb, float(jb.b), acc_jb
+        _row(n, "blocked64", jb.status, len(sv_jb), float(jb.b), acc_jb,
+             jb_s, sv_jb,
+             {"updates": int(jb.n_iter), "n_outer": int(jb.n_outer),
+              "is_anchor": True})
+        rows["blocked64"] = (sv_jb, float(jb.b), acc_jb)
 
     # --- blocked solver, production precision, exact + approx selection ---
-    rows = {"pair-f64": (sv_j, float(j.b), acc_j)}
     if anchor == "oracle":
         rows = {"oracle": (sv_o, float(o.b), acc_o), **rows}
     grid = [
@@ -206,7 +250,8 @@ def run_size(n: int, anchor: str = "oracle"):
         rows[name] = (sv_r, float(r.b), acc_r)
 
     # --- summary: the reference's parity criterion, stated per engine ---
-    anchor_name = "oracle" if anchor == "oracle" else "pair-f64"
+    anchor_name = {"oracle": "oracle", "pair": "pair-f64",
+                   "blocked64": "blocked64"}[anchor]
     summary = {"n": n, "engine": "summary", "anchor": anchor_name,
                "platform": jax.default_backend(),
                "criterion": "identical SV set / b within 0.003% / equal "
@@ -234,7 +279,8 @@ if __name__ == "__main__":
     if "--anchor" in args:
         i = args.index("--anchor")
         if i + 1 >= len(args):
-            raise SystemExit("--anchor needs a value: oracle|pair")
+            raise SystemExit(
+                "--anchor needs a value: oracle|pair|blocked64")
         anchor = args[i + 1]
         del args[i:i + 2]
     for a in args:

@@ -62,7 +62,7 @@ from tpusvm.solver.predict import predict as device_predict  # noqa: E402
 from tpusvm.status import Status  # noqa: E402
 
 
-def run_size(n, Xs, Y, Xt, Yt, solver_opts, gamma):
+def run_size(n, Xs, Y, Xt, Yt, solver_opts, gamma, all_n_predict=True):
     # effective config from the solver's own resolution rules (shared
     # helper) so a result row cannot silently claim an engine/wss/selection
     # it did not run if those rules ever change
@@ -107,19 +107,25 @@ def run_size(n, Xs, Y, Xt, Yt, solver_opts, gamma):
     predict_s = time.perf_counter() - t0
 
     # like-for-like timing vs the reference's GPU predict (C16): sum over
-    # ALL n train points, zeros included — same FLOP count as the baseline
-    ad = jax.device_put(jnp.asarray(alpha, Xd.dtype))
-    pred_all_exe = pred_fn.lower(Xtd, Xd, Yd, ad).compile()
-    h2d_sync(ad)
-    t0 = time.perf_counter()
-    yp_all = np.asarray(pred_all_exe(Xtd, Xd, Yd, ad))
-    predict_all_n_s = time.perf_counter() - t0
-    # the two paths are algebraically identical but reduce in different
-    # orders/sizes, so near-boundary points may flip sign within f32 noise
-    mismatch = int((yp_all != yp).sum())
-    if mismatch:
-        log(f"note: {mismatch} test points flip sign between SV-compacted "
-            "and all-n predict (f32 accumulation-order noise)")
+    # ALL n train points, zeros included — same FLOP count as the baseline.
+    # Skippable for big-n CPU runs (O(m*n*d) on one host core is ~13 min
+    # at n=480k — pure harness wall-clock, no signal off-TPU).
+    predict_all_n_s = None
+    if all_n_predict:
+        ad = jax.device_put(jnp.asarray(alpha, Xd.dtype))
+        pred_all_exe = pred_fn.lower(Xtd, Xd, Yd, ad).compile()
+        h2d_sync(ad)
+        t0 = time.perf_counter()
+        yp_all = np.asarray(pred_all_exe(Xtd, Xd, Yd, ad))
+        predict_all_n_s = time.perf_counter() - t0
+        # the two paths are algebraically identical but reduce in
+        # different orders/sizes, so near-boundary points may flip sign
+        # within f32 noise
+        mismatch = int((yp_all != yp).sum())
+        if mismatch:
+            log(f"note: {mismatch} test points flip sign between "
+                "SV-compacted and all-n predict (f32 accumulation-order "
+                "noise)")
 
     # Roofline attribution (same model as tpu_capture_r4/ROOFLINE.md): the
     # solver's dominant HBM traffic is one full f32 X stream per outer
@@ -142,7 +148,8 @@ def run_size(n, Xs, Y, Xt, Yt, solver_opts, gamma):
         "train_s": round(train_s, 4),
         "hbm_peak_fraction_est": hbm_frac,
         "predict_s": round(predict_s, 4),
-        "predict_all_n_s": round(predict_all_n_s, 4),
+        "predict_all_n_s": (round(predict_all_n_s, 4)
+                            if predict_all_n_s is not None else None),
         "accuracy": float((yp == Yt).mean()),
         "n_sv": int(len(get_sv_indices(alpha))),
         "iterations": int(res.n_iter),
@@ -158,7 +165,9 @@ def run_size(n, Xs, Y, Xt, Yt, solver_opts, gamma):
         # includes an ~n/n_sv fewer-FLOPs factor on top of framework speed
         "vs_gpu_predict_sv": round(GPU_PREDICT_S[n] / predict_s, 2) if n in GPU_PREDICT_S else None,
         # same all-n semantics as the baseline: the framework comparison
-        "vs_gpu_predict_all_n": round(GPU_PREDICT_S[n] / predict_all_n_s, 2) if n in GPU_PREDICT_S else None,
+        "vs_gpu_predict_all_n": (
+            round(GPU_PREDICT_S[n] / predict_all_n_s, 2)
+            if n in GPU_PREDICT_S and predict_all_n_s is not None else None),
     }
 
 
@@ -185,6 +194,11 @@ def main(argv=None) -> int:
     ap.add_argument("--selection", default="auto",
                     choices=("auto", "exact", "approx"),
                     help="outer working-set selection engine")
+    ap.add_argument("--skip-all-n-predict", action="store_true",
+                    help="skip the all-n-train-points predict timing "
+                    "(the reference-comparison row); use for big-n CPU "
+                    "runs where the O(m*n*d) single-core pass is pure "
+                    "harness wall-clock")
     args = ap.parse_args(argv)
 
     if args.smoke:
@@ -220,7 +234,8 @@ def main(argv=None) -> int:
                                label_noise=BENCH_LABEL_NOISE)
     for n in args.sizes:
         log(f"--- n = {n} ---")
-        row = run_size(n, Xs, Y[:n_max], Xt, Yt, solver_opts, args.gamma)
+        row = run_size(n, Xs, Y[:n_max], Xt, Yt, solver_opts, args.gamma,
+                       all_n_predict=not args.skip_all_n_predict)
         row["workload"] = dict(workload, n=n)
         emit(row)
     return 0

@@ -1,9 +1,17 @@
 #!/usr/bin/env bash
-# Post-adoption TPU refresh batch (round 4, after fused_fupdate became the
-# TPU default): re-capture the artifacts whose committed rows predate the
-# tuned solver config, plus a repeated headline under the new default.
+# TPU refresh batch (rounds 4-5): re-capture artifacts whose committed
+# rows predate the tuned solver config, settle the open A/B questions
+# (tuned vs round-1, fused noise band, eta_exclude cost, multipair
+# adopt-or-kill), and extend the n-sweep past the reference's ceiling.
 #
 #   scripts/capture_tpu_refresh.sh [outdir]   # default: benchmarks/results/tpu_refresh_<utc>
+#
+# ORDERED FOR A SHORT HARDWARE WINDOW (round-4's was ~40 min before the
+# tunnel wedged): pass 1 captures ONE row of every question — headline,
+# the three headline A/B configs, the two new-kernel A/Bs — so even a
+# brief window settles each question with at least one sample; pass 2+
+# adds repeats for noise bands; the long tail (sweeps, OVR, probes) runs
+# last.
 #
 # Same operating constraints as capture_tpu_round.sh (verify skill):
 # one heavy measurement per process, pre-flight the relay/backend, bound
@@ -44,20 +52,19 @@ step () {  # step <name> <logfile> <cmd...>
   sleep 30
 }
 
-# (a) headline under the adopted fused default, three repeats for a
-#     noise-banded quote (the committed single capture sits in a ~12%
-#     run-to-run band) — INTERLEAVED with same-session A/B rows:
-#       ab_tuned    = the shipping config (q=2048/mi=4096/wss=2/approx/
-#                     fused-auto/packed) via probe_split (fixed seed-0
-#                     sibling instance of the headline workload)
-#       ab_round1   = the exact round-1 shipping config (q=1024/mi=1024/
-#                     wss=1/exact/unfused/FLAT layout) — settles the
-#                     open tuned-vs-untuned question (round-1's 0.4133 s
-#                     vs round-4's 0.46-0.53 s has never been measured
-#                     in one session)
-#       ab_fusedoff = tuned config with fused f-update OFF — the round-4
-#                     fused adoption rested on a single unfused sample;
-#                     three interleaved repeats give it a noise band
+# probe_split args: q mi max_outer wss precision refine selection fused
+#                   [layout] [eta_exclude] [multipair]
+#   ab_tuned    = shipping config (q=2048/mi=4096/wss=2/approx/fused-auto)
+#   ab_round1   = exact round-1 shipping config (q=1024/mi=1024/wss=1/
+#                 exact/unfused/FLAT) — settles tuned-vs-untuned
+#                 (round-1's 0.4133 s vs round-4's 0.46-0.53 s has never
+#                 been measured in one session)
+#   ab_fusedoff = tuned config, fused f-update OFF (the round-4 adoption
+#                 rested on ONE unfused sample — ADVICE r4 #1)
+#   etax_on/off = VERDICT r4 #5: cost of the unified degenerate-partner
+#                 exclusion (one extra cross-lane reduction per iteration)
+#   mp{8,4,1}   = VERDICT r4 #3 adopt-or-kill: batched slot-pair kernel
+#                 vs the sequential kernel, wss=1 rows (mp1 = control)
 for i in 1 2 3; do
   step "headline_fused_$i" "$OUT/bench_headline_fused_$i.json" python bench.py
   step "ab_tuned_$i" "$OUT/ab_tuned_$i.jsonl" \
@@ -66,6 +73,16 @@ for i in 1 2 3; do
     python benchmarks/probe_split.py 1024 1024 5000 1 none 0 exact 0 flat
   step "ab_fusedoff_$i" "$OUT/ab_fusedoff_$i.jsonl" \
     python benchmarks/probe_split.py 2048 4096 5000 2 none 0 approx 0 packed
+  step "etax_on_$i" "$OUT/etax_on_$i.jsonl" \
+    python benchmarks/probe_split.py 2048 4096 5000 2 none 0 approx auto packed 1
+  step "etax_off_$i" "$OUT/etax_off_$i.jsonl" \
+    python benchmarks/probe_split.py 2048 4096 5000 2 none 0 approx auto packed 0
+  step "mp8_$i" "$OUT/mp8_$i.jsonl" \
+    python benchmarks/probe_split.py 2048 4096 5000 1 none 0 approx auto packed 0 8
+  step "mp4_$i" "$OUT/mp4_$i.jsonl" \
+    python benchmarks/probe_split.py 2048 4096 5000 1 none 0 approx auto packed 0 4
+  step "mp1_$i" "$OUT/mp1_$i.jsonl" \
+    python benchmarks/probe_split.py 2048 4096 5000 1 none 0 approx auto packed 0 1
 done
 
 # (b) n-sweep refresh (B3): the committed sweep_n_tpu_v5e.jsonl rows are
@@ -90,37 +107,11 @@ done
 step ovr_10class "$OUT/ovr_10class.jsonl" python benchmarks/ovr_10class.py
 
 # (d) fast-edge grid probes under the adopted fused kernel (the r4 grid's
-#     two fastest rows measured unfused; args: q mi max_outer wss
-#     precision refine selection fused [layout] [eta_exclude])
+#     two fastest rows measured unfused)
 step probe_q2048_mi8192_fused "$OUT/probe_q2048_mi8192_fused.jsonl" \
   python benchmarks/probe_split.py 2048 8192 5000 2 none 0 approx fused
 step probe_q1536_mi8192_fused "$OUT/probe_q1536_mi8192_fused.jsonl" \
   python benchmarks/probe_split.py 1536 8192 5000 2 none 0 approx fused
-
-# (e) eta_exclude A/B at the shipping config (VERDICT r4 #5): the cost of
-#     folding the XLA engine's degenerate-partner exclusion into the
-#     kernel's gain selection — one extra cross-lane reduction per inner
-#     iteration. Two repeats each, interleaved, for a noise check.
-for i in 1 2; do
-  step "etax_on_$i" "$OUT/etax_on_$i.jsonl" \
-    python benchmarks/probe_split.py 2048 4096 5000 2 none 0 approx auto packed 1
-  step "etax_off_$i" "$OUT/etax_off_$i.jsonl" \
-    python benchmarks/probe_split.py 2048 4096 5000 2 none 0 approx auto packed 0
-done
-
-# (f) multipair A/B (VERDICT r4 #3, adopt-or-kill): the batched slot-pair
-#     kernel vs the sequential kernel at the same first-order config.
-#     Interpret-mode counts: p=8 converges in ~2.4x fewer kernel
-#     iterations at ~3.7x the updates on a q=2048 subproblem — whether
-#     that wins wall-clock depends on the slot work pipelining against
-#     the global step's reduction latency, measurable only on hardware.
-#     wss=1 rows (multipair requires first-order); mp1 = control.
-for i in 1 2; do
-  for mp in 8 4 1; do
-    step "mp${mp}_$i" "$OUT/mp${mp}_$i.jsonl" \
-      python benchmarks/probe_split.py 2048 4096 5000 1 none 0 approx auto packed 0 "$mp"
-  done
-done
 
 echo "capture complete: $OUT — merge sweep rows, update" \
      "benchmarks/results/README.md + README.md headline quotes" >&2
