@@ -88,6 +88,13 @@ from tpusvm.status import Status  # noqa: E402
 
 # the headline recipe's hyperparameters (bench.py)
 CFG = SVMConfig(C=10.0, gamma=0.00125, eps=1e-12, tau=1e-5, max_iter=10**6)
+# --max-iter overrides CFG.max_iter for EVERY engine (anchor included):
+# the safety bound, not the stopping rule. The committed <=60k rows ran
+# the 1e6 default; beyond-60k blocked64 runs need more (the sweep's
+# q=2048/mi=32768 config alone spends 447k updates at n=120k, and the
+# grid's q=1024/mi=4096 engines spend several times that) — comparing
+# MAX_ITER-truncated trajectories would not be parity evidence, so
+# run_size REFUSES to print a summary row when any engine truncated.
 N_TEST = 2000
 
 
@@ -112,7 +119,7 @@ def _row(n, engine, status, n_sv, b, acc, train_s, sv, extra=None):
     return rec
 
 
-def run_size(n: int, anchor: str = "oracle"):
+def run_size(n: int, anchor: str = "oracle", max_iter: int = None):
     """anchor='oracle' (default): the float64 NumPy oracle anchors every
     comparison — the committed n <= 32768 rows. anchor='pair': the f64
     PAIR SOLVER anchors instead and the NumPy oracle is skipped — for
@@ -139,6 +146,10 @@ def run_size(n: int, anchor: str = "oracle"):
     if anchor not in ("oracle", "pair", "blocked64"):
         raise SystemExit(
             f"anchor must be oracle|pair|blocked64, got {anchor!r}")
+    global CFG
+    if max_iter is not None:
+        CFG = SVMConfig(C=CFG.C, gamma=CFG.gamma, eps=CFG.eps, tau=CFG.tau,
+                        max_iter=max_iter)
     # train/test from sibling seeds of the frozen recipe (bench.py uses
     # seed=587 at n=60k; a different seed here guards against tuning any
     # tolerance to the measured instance)
@@ -165,6 +176,8 @@ def run_size(n: int, anchor: str = "oracle"):
                        == Yt).mean())
         _row(n, "oracle", o.status, len(sv_o), o.b, acc_o, o_s, sv_o,
              {"iterations": int(o.n_iter)})
+        if int(o.status) == Status.MAX_ITER:
+            truncated.append("oracle")
         sv_a, b_a, acc_a = sv_o, float(o.b), acc_o
 
     def _deltas(sv, b, acc):
@@ -176,6 +189,7 @@ def run_size(n: int, anchor: str = "oracle"):
         }
 
     rows = {}
+    truncated = []  # engines that hit the max_iter safety bound
     if anchor != "blocked64":
         # --- pair solver, f64 features: the oracle's trajectory twin ---
         t0 = time.perf_counter()
@@ -195,6 +209,8 @@ def run_size(n: int, anchor: str = "oracle"):
         _row(n, "pair-f64", j.status, len(sv_j), float(j.b), acc_j, j_s,
              sv_j, pair_extra)
         rows["pair-f64"] = (sv_j, float(j.b), acc_j)
+        if int(j.status) == Status.MAX_ITER:
+            truncated.append("pair-f64")
     else:
         # --- f64-end-to-end blocked anchor (see docstring) ---
         t0 = time.perf_counter()
@@ -214,6 +230,8 @@ def run_size(n: int, anchor: str = "oracle"):
              {"updates": int(jb.n_iter), "n_outer": int(jb.n_outer),
               "is_anchor": True})
         rows["blocked64"] = (sv_jb, float(jb.b), acc_jb)
+        if int(jb.status) == Status.MAX_ITER:
+            truncated.append("blocked64")
 
     # --- blocked solver, production precision, exact + approx selection ---
     if anchor == "oracle":
@@ -248,8 +266,22 @@ def run_size(n: int, anchor: str = "oracle"):
                                 "max_inner": opts["max_inner"]},
               **_deltas(sv_r, float(r.b), acc_r)})
         rows[name] = (sv_r, float(r.b), acc_r)
+        if int(r.status) == Status.MAX_ITER:
+            truncated.append(name)
 
     # --- summary: the reference's parity criterion, stated per engine ---
+    # REFUSED when any engine hit the safety bound: two MAX_ITER-truncated
+    # trajectories agreeing (or not) says nothing about the converged
+    # optima — re-run with a larger --max-iter instead
+    if truncated:
+        refusal = {"n": n, "engine": "summary", "refused": True,
+                   "max_iter": CFG.max_iter, "truncated": truncated,
+                   "platform": jax.default_backend(),
+                   "reason": "engines hit the max_iter safety bound; "
+                             "parity verdicts on truncated trajectories "
+                             "are not evidence — raise --max-iter"}
+        print(json.dumps(refusal), flush=True)
+        return rows, refusal
     anchor_name = {"oracle": "oracle", "pair": "pair-f64",
                    "blocked64": "blocked64"}[anchor]
     summary = {"n": n, "engine": "summary", "anchor": anchor_name,
@@ -288,6 +320,18 @@ if __name__ == "__main__":
             anchor = a.split("=", 1)[1]
             args.remove(a)
             break
+    max_iter = None
+    if "--max-iter" in args:
+        i = args.index("--max-iter")
+        if i + 1 >= len(args):
+            raise SystemExit("--max-iter needs an integer value")
+        max_iter = int(args[i + 1])
+        del args[i:i + 2]
+    for a in args:
+        if a.startswith("--max-iter="):
+            max_iter = int(a.split("=", 1)[1])
+            args.remove(a)
+            break
     sizes = [int(a) for a in args] or [2048, 4096]
     for n in sizes:
-        run_size(n, anchor=anchor)
+        run_size(n, anchor=anchor, max_iter=max_iter)

@@ -62,7 +62,8 @@ from tpusvm.solver.predict import predict as device_predict  # noqa: E402
 from tpusvm.status import Status  # noqa: E402
 
 
-def run_size(n, Xs, Y, Xt, Yt, solver_opts, gamma, all_n_predict=True):
+def run_size(n, Xs, Y, Xt, Yt, solver_opts, gamma, all_n_predict=True,
+             max_iter=10**6):
     # effective config from the solver's own resolution rules (shared
     # helper) so a result row cannot silently claim an engine/wss/selection
     # it did not run if those rules ever change
@@ -72,7 +73,17 @@ def run_size(n, Xs, Y, Xt, Yt, solver_opts, gamma, all_n_predict=True):
     )
     Xd = jax.device_put(jnp.asarray(Xs[:n]))
     Yd = jax.device_put(jnp.asarray(Y[:n]))
-    traced = dict(C=10.0, gamma=gamma, eps=1e-12, tau=1e-5)
+    # max_iter is a SAFETY bound, not part of the stopping rule (bench.py
+    # carries the same note): the blocked default of 1e5 total updates is
+    # comfortable at the reference sizes but the beyond-60k sweep
+    # legitimately spends more — the first 120k-480k CPU capture came
+    # back MAX_ITER at ~1.05e5 updates across all three sizes, and the
+    # recipe's convergence tail keeps growing with n (240k ran a full 1e6
+    # without closing the strict Keerthi gap on one core). Beyond-60k
+    # captures should pass --max-iter 10000000 where the platform can
+    # afford it (TPU: minutes); a MAX_ITER row still records accuracy.
+    traced = dict(C=10.0, gamma=gamma, eps=1e-12, tau=1e-5,
+                  max_iter=max_iter)
 
     compiled = blocked_smo_solve.lower(Xd, Yd, **traced, **solver_opts).compile()
     # the upload is the dev tunnel, not TPU DMA — keep it out of the timer
@@ -153,6 +164,10 @@ def run_size(n, Xs, Y, Xt, Yt, solver_opts, gamma, all_n_predict=True):
         "accuracy": float((yp == Yt).mean()),
         "n_sv": int(len(get_sv_indices(alpha))),
         "iterations": int(res.n_iter),
+        # the bound the run was configured with, so a MAX_ITER row
+        # self-describes which ceiling (1e6 default / 1e7 TPU capture)
+        # it hit — same convention as the effective-config fields
+        "max_iter": max_iter,
         "status": Status(int(res.status)).name,
         # effective solver config via blocked.resolve_solver_config — the
         # solver's own resolution, not a re-implementation
@@ -194,6 +209,10 @@ def main(argv=None) -> int:
     ap.add_argument("--selection", default="auto",
                     choices=("auto", "exact", "approx"),
                     help="outer working-set selection engine")
+    ap.add_argument("--max-iter", type=int, default=10**6,
+                    help="total-update safety bound (NOT part of the "
+                    "stopping rule); raise to 1e7 for beyond-60k sizes "
+                    "on platforms that can afford it")
     ap.add_argument("--skip-all-n-predict", action="store_true",
                     help="skip the all-n-train-points predict timing "
                     "(the reference-comparison row); use for big-n CPU "
@@ -235,7 +254,8 @@ def main(argv=None) -> int:
     for n in args.sizes:
         log(f"--- n = {n} ---")
         row = run_size(n, Xs, Y[:n_max], Xt, Yt, solver_opts, args.gamma,
-                       all_n_predict=not args.skip_all_n_predict)
+                       all_n_predict=not args.skip_all_n_predict,
+                       max_iter=args.max_iter)
         row["workload"] = dict(workload, n=n)
         emit(row)
     return 0

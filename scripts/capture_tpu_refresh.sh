@@ -97,9 +97,11 @@ done
 #      its sweep there): show the solver leaving the ~1%-of-HBM
 #      latency-bound regime as the O(n*d*q) contraction grows. f32 X at
 #      480k x 784 is ~1.5 GB — comfortably HBM-resident on one v5e chip.
+#      The recipe's strict-stop tail outgrows the 1e6 update bound by
+#      240k (CPU evidence rows); 1e7 costs only minutes at TPU rates.
 for n in 120000 240000 480000; do
   step "sweep_n_big_$n" "$OUT/sweep_n_big_$n.jsonl" \
-    python benchmarks/sweep_n.py --sizes "$n"
+    python benchmarks/sweep_n.py --sizes "$n" --max-iter 10000000
 done
 
 # (c) 10-class OVR refresh: the committed ovr_10class_tpu_v5e.jsonl row is
