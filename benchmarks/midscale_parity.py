@@ -31,8 +31,12 @@ Engines compared against the float64 NumPy oracle (tpusvm.oracle.smo):
                     configuration itself is oracle-anchored
 
 Usage: python benchmarks/midscale_parity.py \
-           [--anchor oracle|pair|blocked64] [n ...]
-(default: oracle anchor, sizes 2048 4096)
+           [--anchor oracle|pair|blocked64] [--grid full|bench] \
+           [--max-iter N] [n ...]
+(default: oracle anchor, full grid, max_iter 1e6, sizes 2048 4096;
+--grid bench = the two shipping configs only — required for meaningful
+beyond-60k summaries, see the grid construction comment; --max-iter
+raises the safety bound for every engine, anchor included)
 Emits one JSON line per (n, engine) with n_sv / b / accuracy / timings and
 per-engine deltas vs the anchor, then one summary line per n. Rows are
 appended to benchmarks/results/midscale_parity_cpu.jsonl by hand after a
@@ -119,7 +123,8 @@ def _row(n, engine, status, n_sv, b, acc, train_s, sv, extra=None):
     return rec
 
 
-def run_size(n: int, anchor: str = "oracle", max_iter: int = None):
+def run_size(n: int, anchor: str = "oracle", max_iter: int = None,
+             grid_mode: str = "full"):
     """anchor='oracle' (default): the float64 NumPy oracle anchors every
     comparison — the committed n <= 32768 rows. anchor='pair': the f64
     PAIR SOLVER anchors instead and the NumPy oracle is skipped — for
@@ -146,6 +151,8 @@ def run_size(n: int, anchor: str = "oracle", max_iter: int = None):
     if anchor not in ("oracle", "pair", "blocked64"):
         raise SystemExit(
             f"anchor must be oracle|pair|blocked64, got {anchor!r}")
+    if grid_mode not in ("full", "bench"):
+        raise SystemExit(f"grid_mode must be full|bench, got {grid_mode!r}")
     global CFG
     if max_iter is not None:
         CFG = SVMConfig(C=CFG.C, gamma=CFG.gamma, eps=CFG.eps, tau=CFG.tau,
@@ -166,6 +173,8 @@ def run_size(n: int, anchor: str = "oracle", max_iter: int = None):
             gamma=CFG.gamma)
         return float((np.asarray(yp) == Yt).mean())
 
+    rows = {}
+    truncated = []  # engines that hit the max_iter safety bound
     if anchor == "oracle":
         # --- oracle (float64 NumPy, the correctness anchor) ---
         t0 = time.perf_counter()
@@ -188,8 +197,6 @@ def run_size(n: int, anchor: str = "oracle", max_iter: int = None):
             f"acc_delta_vs_{anchor}": round(acc - acc_a, 6),
         }
 
-    rows = {}
-    truncated = []  # engines that hit the max_iter safety bound
     if anchor != "blocked64":
         # --- pair solver, f64 features: the oracle's trajectory twin ---
         t0 = time.perf_counter()
@@ -236,15 +243,28 @@ def run_size(n: int, anchor: str = "oracle", max_iter: int = None):
     # --- blocked solver, production precision, exact + approx selection ---
     if anchor == "oracle":
         rows = {"oracle": (sv_o, float(o.b), acc_o), **rows}
-    grid = [
-        (f"blocked-{sel}" + ("-wss2" if wss == 2 else ""),
-         dict(q=1024, max_inner=4096, wss=wss, selection=sel))
-        for sel, wss in (("exact", 1), ("approx", 1),
-                         ("exact", 2), ("approx", 2))
-    ]
-    # the exact shipping CPU-fallback config (bench.py off-TPU)
-    grid.append(("blocked-cpu-bench-config",
-                 dict(q=2048, max_inner=32768, wss=2, selection="auto")))
+    # the exact shipping CPU-fallback config (bench.py off-TPU), shared
+    # by both grid modes — ONE definition so the copies cannot drift
+    cpu_bench_cfg = ("blocked-cpu-bench-config",
+                     dict(q=2048, max_inner=32768, wss=2, selection="auto"))
+    if grid_mode == "bench":
+        # shipping configs only (the TPU bench shape + the CPU-fallback
+        # shape): at beyond-60k sizes the historical q=1024/mi=4096 grid
+        # rows' strict-stop tails outgrow any feasible single-core
+        # budget (blocked-exact wss1 ran 4e6 updates at n=120k without
+        # closing) — comparing the configs that actually ship keeps the
+        # summary meaningful there
+        grid = [("blocked-tpu-bench-config",
+                 dict(q=2048, max_inner=4096, wss=2, selection="approx")),
+                cpu_bench_cfg]
+    else:
+        grid = [
+            (f"blocked-{sel}" + ("-wss2" if wss == 2 else ""),
+             dict(q=1024, max_inner=4096, wss=wss, selection=sel))
+            for sel, wss in (("exact", 1), ("approx", 1),
+                             ("exact", 2), ("approx", 2))
+        ]
+        grid.append(cpu_bench_cfg)
     for name, opts in grid:
         q_eff, inner_eff, wss_eff, sel_eff = resolve_solver_config(
             n, q=opts["q"], inner="xla", wss=opts["wss"],
@@ -320,6 +340,20 @@ if __name__ == "__main__":
             anchor = a.split("=", 1)[1]
             args.remove(a)
             break
+    grid_mode = "full"
+    if "--grid" in args:
+        i = args.index("--grid")
+        if i + 1 >= len(args):
+            raise SystemExit("--grid needs a value: full|bench")
+        grid_mode = args[i + 1]
+        del args[i:i + 2]
+    for a in args:
+        if a.startswith("--grid="):
+            grid_mode = a.split("=", 1)[1]
+            args.remove(a)
+            break
+    if grid_mode not in ("full", "bench"):
+        raise SystemExit(f"--grid must be full|bench, got {grid_mode!r}")
     max_iter = None
     if "--max-iter" in args:
         i = args.index("--max-iter")
@@ -334,4 +368,4 @@ if __name__ == "__main__":
             break
     sizes = [int(a) for a in args] or [2048, 4096]
     for n in sizes:
-        run_size(n, anchor=anchor, max_iter=max_iter)
+        run_size(n, anchor=anchor, max_iter=max_iter, grid_mode=grid_mode)
