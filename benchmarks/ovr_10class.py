@@ -26,8 +26,6 @@ import jax
 
 jax.config.update("jax_enable_x64", True)
 
-import numpy as np  # noqa: E402
-
 from benchmarks.common import (  # noqa: E402
     emit,
     log,
