@@ -68,6 +68,7 @@ if os.environ.get(_FORCE_CPU_ENV) == "1":
 import jax.numpy as jnp  # noqa: E402
 import numpy as np  # noqa: E402
 
+from tpusvm.config import PALLAS_FLAG_RULES  # noqa: E402
 from tpusvm.data import MinMaxScaler, mnist_like  # noqa: E402
 from tpusvm.solver.blocked import blocked_smo_solve  # noqa: E402
 from tpusvm.status import Status  # noqa: E402
@@ -423,7 +424,16 @@ def main():
             else:
                 canary_passed = True
                 if picked != "packed":
-                    static_kwargs = dict(static_kwargs, pallas_layout=picked)
+                    # pin inner explicitly alongside the layout: the
+                    # solver REJECTS an active pallas_layout whose
+                    # resolved engine is not pallas (shared
+                    # flag-compatibility table) instead of silently
+                    # ignoring it, and the canary has just vetted the
+                    # pallas engine — on a real TPU inner='auto' resolves
+                    # to pallas anyway, so this only makes the recorded
+                    # config self-consistent
+                    static_kwargs = dict(static_kwargs, pallas_layout=picked,
+                                         inner="pallas")
                     engine = f"pallas-{picked}"
         except Exception as ce:  # noqa: BLE001 — canary harness broke
             log(f"WARNING: kernel canary harness failed; proceeding with "
@@ -528,7 +538,15 @@ def main():
     if engine == "pallas-packed":
         ladder.append((dict(base, pallas_layout="flat"), "pallas-flat"))
     if engine != "xla":
-        ladder.append((dict(base, inner="xla"), "xla"))
+        # the XLA rung must drop any active pallas_* flags: the solver now
+        # REJECTS active kernel flags on a non-pallas engine (shared
+        # flag-compatibility table, tpusvm.config.PALLAS_FLAG_RULES)
+        # instead of silently ignoring them, so a canary-picked flat
+        # layout must not ride along into the fallback config
+        xla_kw = dict(base, inner="xla")
+        for flag in PALLAS_FLAG_RULES:
+            xla_kw.pop(flag, None)
+        ladder.append((xla_kw, "xla"))
     for i, (kw, eng) in enumerate(ladder):
         try:
             compiled = blocked_smo_solve.lower(

@@ -59,6 +59,7 @@ working-set schedule itself stays anchored transitively by the
 committed oracle -> pair -> blocked chain (exact SV sets through
 n=60000). See run_size's docstring for the full caveat.
 """
+import dataclasses
 import json
 import os
 import sys
@@ -100,6 +101,20 @@ CFG = SVMConfig(C=10.0, gamma=0.00125, eps=1e-12, tau=1e-5, max_iter=10**6)
 # MAX_ITER-truncated trajectories would not be parity evidence, so
 # run_size REFUSES to print a summary row when any engine truncated.
 N_TEST = 2000
+
+
+def effective_cfg(max_iter=None):
+    """CFG with the optional --max-iter override applied, as a LOCAL copy.
+
+    run_size used to `global CFG` and mutate the module config in place,
+    so a later run_size call without max_iter silently inherited the
+    previous override (ADVICE r5) — library/test callers could get parity
+    rows under an unintended iteration bound. A dataclasses.replace copy
+    keeps the module-level recipe constant immutable.
+    """
+    if max_iter is None:
+        return CFG
+    return dataclasses.replace(CFG, max_iter=max_iter)
 
 
 def _sv_crc(sv: np.ndarray) -> int:
@@ -153,10 +168,7 @@ def run_size(n: int, anchor: str = "oracle", max_iter: int = None,
             f"anchor must be oracle|pair|blocked64, got {anchor!r}")
     if grid_mode not in ("full", "bench"):
         raise SystemExit(f"grid_mode must be full|bench, got {grid_mode!r}")
-    global CFG
-    if max_iter is not None:
-        CFG = SVMConfig(C=CFG.C, gamma=CFG.gamma, eps=CFG.eps, tau=CFG.tau,
-                        max_iter=max_iter)
+    cfg = effective_cfg(max_iter)
     # train/test from sibling seeds of the frozen recipe (bench.py uses
     # seed=587 at n=60k; a different seed here guards against tuning any
     # tolerance to the measured instance)
@@ -170,7 +182,7 @@ def run_size(n: int, anchor: str = "oracle", max_iter: int = None,
         yp = device_predict(
             jnp.asarray(Xq, dtype), jnp.asarray(Xs, dtype), jnp.asarray(Y),
             jnp.asarray(alpha, dtype), jnp.asarray(b, dtype),
-            gamma=CFG.gamma)
+            gamma=cfg.gamma)
         return float((np.asarray(yp) == Yt).mean())
 
     rows = {}
@@ -181,7 +193,7 @@ def run_size(n: int, anchor: str = "oracle", max_iter: int = None,
         o = smo_train(Xs, Y, CFG)
         o_s = time.perf_counter() - t0
         sv_o = get_sv_indices(o.alpha)
-        acc_o = float((oracle_predict(Xq, Xs, Y, o.alpha, o.b, CFG.gamma)
+        acc_o = float((oracle_predict(Xq, Xs, Y, o.alpha, o.b, cfg.gamma)
                        == Yt).mean())
         _row(n, "oracle", o.status, len(sv_o), o.b, acc_o, o_s, sv_o,
              {"iterations": int(o.n_iter)})
@@ -201,8 +213,8 @@ def run_size(n: int, anchor: str = "oracle", max_iter: int = None,
         # --- pair solver, f64 features: the oracle's trajectory twin ---
         t0 = time.perf_counter()
         j = smo_solve(jnp.asarray(Xs, jnp.float64), jnp.asarray(Y),
-                      C=CFG.C, gamma=CFG.gamma, eps=CFG.eps, tau=CFG.tau,
-                      max_iter=CFG.max_iter)
+                      C=cfg.C, gamma=cfg.gamma, eps=cfg.eps, tau=cfg.tau,
+                      max_iter=cfg.max_iter)
         a_j = np.asarray(j.alpha)
         j_s = time.perf_counter() - t0
         sv_j = get_sv_indices(a_j)
@@ -222,9 +234,9 @@ def run_size(n: int, anchor: str = "oracle", max_iter: int = None,
         # --- f64-end-to-end blocked anchor (see docstring) ---
         t0 = time.perf_counter()
         jb = blocked_smo_solve(
-            jnp.asarray(Xs, jnp.float64), jnp.asarray(Y), C=CFG.C,
-            gamma=CFG.gamma, eps=CFG.eps, tau=CFG.tau,
-            max_iter=CFG.max_iter, q=2048, max_inner=8192, wss=2,
+            jnp.asarray(Xs, jnp.float64), jnp.asarray(Y), C=cfg.C,
+            gamma=cfg.gamma, eps=cfg.eps, tau=cfg.tau,
+            max_iter=cfg.max_iter, q=2048, max_inner=8192, wss=2,
             selection="exact", max_outer=5000, inner="xla",
             accum_dtype=jnp.float64)
         a_jb = np.asarray(jb.alpha)
@@ -271,9 +283,9 @@ def run_size(n: int, anchor: str = "oracle", max_iter: int = None,
             selection=opts["selection"])
         t0 = time.perf_counter()
         r = blocked_smo_solve(
-            jnp.asarray(Xs, jnp.float32), jnp.asarray(Y), C=CFG.C,
-            gamma=CFG.gamma, eps=CFG.eps, tau=CFG.tau,
-            max_iter=CFG.max_iter,
+            jnp.asarray(Xs, jnp.float32), jnp.asarray(Y), C=cfg.C,
+            gamma=cfg.gamma, eps=cfg.eps, tau=cfg.tau,
+            max_iter=cfg.max_iter,
             max_outer=5000, inner="xla", accum_dtype=jnp.float64, **opts)
         a_r = np.asarray(r.alpha)
         r_s = time.perf_counter() - t0
@@ -295,7 +307,7 @@ def run_size(n: int, anchor: str = "oracle", max_iter: int = None,
     # optima — re-run with a larger --max-iter instead
     if truncated:
         refusal = {"n": n, "engine": "summary", "refused": True,
-                   "max_iter": CFG.max_iter, "truncated": truncated,
+                   "max_iter": cfg.max_iter, "truncated": truncated,
                    "platform": jax.default_backend(),
                    "reason": "engines hit the max_iter safety bound; "
                              "parity verdicts on truncated trajectories "

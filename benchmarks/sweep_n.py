@@ -256,7 +256,13 @@ def main(argv=None) -> int:
         row = run_size(n, Xs, Y[:n_max], Xt, Yt, solver_opts, args.gamma,
                        all_n_predict=not args.skip_all_n_predict,
                        max_iter=args.max_iter)
-        row["workload"] = dict(workload, n=n)
+        # keep the GENERATOR'S n in the record: mnist_like is not
+        # prefix-stable in n (per-class allocation and the final
+        # permutation both depend on it), so overriding n with the trained
+        # prefix size would describe a generator call that produces
+        # DIFFERENT data than what was trained (ADVICE r5). n_train is the
+        # prefix of that instance this row actually trained on.
+        row["workload"] = dict(workload, n_train=n)
         emit(row)
     return 0
 

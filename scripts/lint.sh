@@ -1,0 +1,17 @@
+#!/usr/bin/env bash
+# Local mirror of the CI lint gate (.github/workflows/ci.yml):
+#   scripts/lint.sh            lint the shipping trees
+#   scripts/lint.sh --format json | jq .counts
+# Extra args pass straight through to `python -m tpusvm.analysis`.
+# ruff is run too when available (CI installs it; the dev container may
+# not have it — the tpusvm linter is the part with no extra deps).
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+if command -v ruff >/dev/null 2>&1; then
+    ruff check .
+else
+    echo "lint.sh: ruff not installed; skipping style tier (CI runs it)" >&2
+fi
+
+PYTHONPATH=. exec python -m tpusvm.analysis tpusvm/ benchmarks/ scripts/ bench.py "$@"

@@ -1,0 +1,31 @@
+# known-GOOD: the TPU-native versions of every corpus hazard; the linter
+# must report nothing here (tests/test_analysis.py::test_clean_corpus).
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+@functools.partial(jax.jit, static_argnames=("mode", "steps"))
+def solve(x, y, mode="fast", steps=8):
+    if mode == "fast":  # fine: static argname
+        y = y * 2.0
+    if x is None:  # fine: trace-time identity check
+        return y
+    n = x.shape[0]
+    if n > 128:  # fine: shapes are static under jit
+        y = y[:128]
+    acc = jnp.zeros((n,), jnp.float32)  # fine: explicit dtype
+    mask = x > 0
+    pos = jnp.where(mask, x, 0.0)  # fine: three-arg where
+    branch = lax.cond(x.sum() > 0, lambda a: a, lambda a: -a, pos)
+    for _ in range(steps):  # fine: static Python loop bound
+        acc = acc + branch
+    return acc
+
+
+def host_driver(batches):
+    results = [solve(b, b) for b in batches]
+    # fine: one sync after the loop, not one per iteration
+    return [r.block_until_ready() for r in results][-1]

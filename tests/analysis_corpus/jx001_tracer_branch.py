@@ -1,0 +1,22 @@
+# known-bad: Python control flow on traced values (JX001)
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+@jax.jit
+def relu_or_neg(x):
+    if x.sum() > 0:  # JX001: tracer-dependent `if`
+        return x
+    while x[0] > 0:  # JX001: tracer-dependent `while`
+        x = x - 1.0
+    return -x
+
+
+def countdown(x0):
+    def body(x):
+        if jnp.any(x > 0):  # JX001: tracer branch inside while_loop body
+            x = x - 1
+        return x
+
+    return lax.while_loop(lambda x: x[0] > 0, body, x0)

@@ -1,0 +1,18 @@
+# known-bad: implicit host-device synchronisation (JX002)
+import jax
+import numpy as np
+
+
+@jax.jit
+def step(x):
+    lr = float(x[0])  # JX002: float() concretises a tracer
+    host = np.asarray(x)  # JX002: host materialisation under jit
+    return x * lr + host.sum()
+
+
+def poll(batches):
+    total = 0.0
+    for b in batches:
+        total += b.sum().item()  # JX002: per-iteration device sync
+        b.block_until_ready()  # JX002: per-iteration device sync
+    return total

@@ -1,0 +1,12 @@
+# known-bad: data-dependent output shapes under jit (JX003)
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def support_vectors(alpha, X):
+    live = alpha > 0
+    sv_rows = X[live]  # JX003: boolean-mask indexing
+    idx = jnp.where(alpha > 0)  # JX003: one-arg jnp.where
+    labels = jnp.unique(alpha)  # JX003: unique without size=
+    return sv_rows, idx, labels, X[alpha > 0]  # JX003: inline mask

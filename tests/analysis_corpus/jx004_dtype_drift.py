@@ -1,0 +1,11 @@
+# known-bad: dtype drift from implicit-dtype constructors (JX004)
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def accumulate(x):
+    acc = jnp.zeros(x.shape)  # JX004: no dtype= — depends on x64 flip
+    scale = jnp.array(0.5)  # JX004: bare float literal
+    steps = jnp.arange(8)  # JX004: no dtype=
+    return acc + scale * x + steps.sum()

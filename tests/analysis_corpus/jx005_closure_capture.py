@@ -1,0 +1,10 @@
+# known-bad: jitted function closing over a module-level ndarray (JX005)
+import jax
+import numpy as np
+
+PROJECTION = np.random.randn(1024, 1024)
+
+
+@jax.jit
+def project(x):
+    return x @ PROJECTION  # JX005: constant-folded into the jaxpr

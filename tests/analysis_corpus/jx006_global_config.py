@@ -1,0 +1,14 @@
+# known-bad: mutated module-global config read under tracing (JX006)
+import jax
+
+GAMMA = 0.00125
+
+
+def set_gamma(g):
+    global GAMMA
+    GAMMA = g
+
+
+@jax.jit
+def kernel_row(d2):
+    return jax.numpy.exp(-GAMMA * d2)  # JX006: frozen at first trace

@@ -1,0 +1,10 @@
+# known-bad: leftover debug hooks on a kernel path (JX007)
+# tpusvm: kernel-path
+import jax
+
+
+@jax.jit
+def inner_update(f, i):
+    jax.debug.print("f[{}] = {}", i, f[i])  # JX007: host callback
+    breakpoint()  # JX007: hangs non-interactive runs
+    return f.at[i].add(1.0)

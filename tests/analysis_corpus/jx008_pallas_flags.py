@@ -1,0 +1,19 @@
+# known-bad: pallas_* flags the resolved solver config ignores (JX008)
+from tpusvm.solver.blocked import blocked_smo_solve
+
+
+def mislabeled_ab_run(X, Y):
+    # JX008: eta_exclude recorded while the XLA engine runs
+    return blocked_smo_solve(X, Y, inner="xla", wss=2,
+                             pallas_eta_exclude=True)
+
+
+def wrong_selection_order(X, Y):
+    # JX008: multipair is a first-order (wss=1) kernel
+    return blocked_smo_solve(X, Y, inner="pallas", wss=2,
+                             pallas_multipair=2)
+
+
+def layout_without_kernel(X, Y):
+    # JX008: layout only reaches the pallas engine
+    return blocked_smo_solve(X, Y, inner="xla", pallas_layout="flat")

@@ -1,0 +1,319 @@
+"""Tests for tpusvm.analysis — the JAX tracing-safety & TPU-hazard linter.
+
+Three contracts:
+  * every rule JX001-JX008 fires on its known-bad corpus snippet
+    (tests/analysis_corpus/) and stays quiet on the known-good one;
+  * the repo's own trees lint clean (modulo the checked-in baseline) —
+    the CI gate, run in-process here so a regression fails tier-1 too;
+  * the CLI surface is stable: JSON reporter schema, suppression
+    comments, baseline round-trip, exit codes.
+
+The linter is pure stdlib ast (no JAX import), so these tests are cheap.
+"""
+
+import json
+from pathlib import Path
+
+import pytest
+
+from tpusvm.analysis import all_rules, lint_file, lint_paths, lint_source
+
+REPO = Path(__file__).resolve().parent.parent
+CORPUS = REPO / "tests" / "analysis_corpus"
+RULE_IDS = ("JX001", "JX002", "JX003", "JX004", "JX005", "JX006",
+            "JX007", "JX008")
+
+
+# ---------------------------------------------------------------- registry
+def test_registry_has_all_rules():
+    rules = all_rules()
+    assert tuple(sorted(rules)) == RULE_IDS
+    for rid, rule in rules.items():
+        assert rule.id == rid
+        assert rule.summary
+
+
+def test_registry_rejects_unknown_select():
+    from tpusvm.analysis.registry import select_rules
+
+    with pytest.raises(ValueError, match="unknown rule"):
+        select_rules(select={"JX999"})
+
+
+# ------------------------------------------------------------------ corpus
+@pytest.mark.parametrize("rule_id", RULE_IDS)
+def test_rule_fires_on_its_corpus_snippet(rule_id):
+    matches = sorted(CORPUS.glob(f"{rule_id.lower()}_*.py"))
+    assert matches, f"no corpus file for {rule_id}"
+    findings, _ = lint_file(matches[0])
+    fired = {f.rule for f in findings}
+    assert rule_id in fired, (
+        f"{rule_id} did not fire on {matches[0].name}; got {fired}"
+    )
+    # corpus snippets are single-hazard by construction: no OTHER rule
+    # may fire, so a precision regression in any rule shows up here
+    assert fired == {rule_id}, (
+        f"extra rules fired on {matches[0].name}: {fired - {rule_id}}"
+    )
+
+
+def test_clean_corpus_is_clean():
+    findings, suppressed = lint_file(CORPUS / "clean.py")
+    assert findings == []
+    assert suppressed == []
+
+
+def test_every_corpus_finding_is_located():
+    for f in CORPUS.glob("jx*.py"):
+        findings, _ = lint_file(f)
+        for finding in findings:
+            assert finding.line >= 1 and finding.col >= 1
+            assert finding.snippet  # points at real source text
+            assert finding.fingerprint and len(finding.fingerprint) == 12
+
+
+# ----------------------------------------------------------- repo is clean
+def test_repo_lints_clean():
+    """The CI gate, in-process: tpusvm/ + benchmarks/ + scripts/ + bench.py
+    produce zero unsuppressed findings (this repo carries no baseline
+    entries — deliberate syncs are annotated inline where they live)."""
+    result = lint_paths([str(REPO / "tpusvm"), str(REPO / "benchmarks"),
+                         str(REPO / "scripts"), str(REPO / "bench.py")])
+    assert result.findings == [], "\n".join(
+        f.render() for f in result.findings
+    )
+    assert result.files_scanned > 50  # the walk actually found the tree
+
+
+def test_corpus_excluded_from_directory_walk():
+    # linting tests/ must NOT pick up the known-bad corpus
+    result = lint_paths([str(REPO / "tests")])
+    corpus_paths = {f.path for f in result.findings
+                    if "analysis_corpus" in f.path}
+    assert corpus_paths == set()
+
+
+# ------------------------------------------------------------- suppression
+def test_inline_suppression():
+    src = (
+        "import jax\n"
+        "@jax.jit\n"
+        "def f(x):\n"
+        "    if x.sum() > 0:  # tpusvm: disable=JX001\n"
+        "        x = -x\n"
+        "    return x\n"
+    )
+    findings, suppressed = lint_source(src)
+    assert findings == []
+    assert [f.rule for f in suppressed] == ["JX001"]
+
+
+def test_standalone_comment_suppression_and_disable_all():
+    src = (
+        "import jax\n"
+        "@jax.jit\n"
+        "def f(x):\n"
+        "    # tpusvm: disable=all\n"
+        "    if x.sum() > 0:\n"
+        "        x = -x\n"
+        "    return x\n"
+    )
+    findings, suppressed = lint_source(src)
+    assert findings == [] and len(suppressed) == 1
+
+
+def test_file_level_suppression():
+    src = (
+        "# tpusvm: disable-file=JX001\n"
+        "import jax\n"
+        "@jax.jit\n"
+        "def f(x):\n"
+        "    if x.sum() > 0:\n"
+        "        x = -x\n"
+        "    return x\n"
+    )
+    findings, suppressed = lint_source(src)
+    assert findings == [] and len(suppressed) == 1
+
+
+def test_suppression_is_rule_specific():
+    src = (
+        "import jax\n"
+        "@jax.jit\n"
+        "def f(x):\n"
+        "    if x.sum() > 0:  # tpusvm: disable=JX002\n"
+        "        x = -x\n"
+        "    return x\n"
+    )
+    findings, _ = lint_source(src)
+    assert [f.rule for f in findings] == ["JX001"]
+
+
+# ------------------------------------------------------------ syntax error
+def test_parse_failure_is_a_finding():
+    findings, _ = lint_source("def broken(:\n")
+    assert [f.rule for f in findings] == ["JX000"]
+    assert "does not parse" in findings[0].message
+
+
+# ---------------------------------------------------------------- baseline
+def test_baseline_roundtrip(tmp_path):
+    from tpusvm.analysis.baseline import load_baseline, write_baseline
+
+    target = CORPUS / "jx001_tracer_branch.py"
+    findings, _ = lint_file(target)
+    assert findings
+    bl_path = tmp_path / "baseline.json"
+    write_baseline(bl_path, findings)
+    baseline = load_baseline(bl_path)
+    assert len(baseline) == len(findings)
+    result = lint_paths([str(target)], baseline=baseline)
+    assert result.findings == []
+    assert len(result.baselined) == len(findings)
+    assert result.exit_code == 0
+
+
+def test_baseline_missing_file_is_empty(tmp_path):
+    from tpusvm.analysis.baseline import load_baseline
+
+    assert load_baseline(tmp_path / "nope.json") == set()
+
+
+def test_baseline_rejects_unknown_version(tmp_path):
+    from tpusvm.analysis.baseline import load_baseline
+
+    p = tmp_path / "bad.json"
+    p.write_text('{"version": 99, "findings": []}')
+    with pytest.raises(ValueError, match="baseline version"):
+        load_baseline(p)
+
+
+def test_fingerprint_survives_line_drift():
+    src = (
+        "import jax\n"
+        "@jax.jit\n"
+        "def f(x):\n"
+        "    if x.sum() > 0:\n"
+        "        x = -x\n"
+        "    return x\n"
+    )
+    f1, _ = lint_source(src)
+    shifted = "# a new comment line\n" + src
+    f2, _ = lint_source(shifted)
+    assert f1[0].fingerprint == f2[0].fingerprint
+    assert f1[0].line != f2[0].line
+
+
+# --------------------------------------------------------------------- CLI
+def test_cli_json_report_schema(capsys):
+    from tpusvm.analysis.cli import main
+
+    rc = main([str(CORPUS / "jx003_dynamic_shape.py"), "--format", "json",
+               "--no-baseline"])
+    assert rc == 1
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["version"] == 1
+    assert doc["tool"] == "tpusvm.analysis"
+    assert doc["files_scanned"] == 1
+    assert set(doc["rules"]) == set(RULE_IDS)
+    assert isinstance(doc["suppressed"], int)
+    assert isinstance(doc["baselined"], int)
+    assert doc["counts"]["JX003"] == len(doc["findings"])
+    for finding in doc["findings"]:
+        assert set(finding) == {"rule", "path", "line", "col", "message",
+                                "snippet", "fingerprint"}
+        assert finding["rule"] == "JX003"
+        assert isinstance(finding["line"], int)
+
+
+def test_cli_clean_exit_zero(capsys):
+    from tpusvm.analysis.cli import main
+
+    rc = main([str(CORPUS / "clean.py"), "--no-baseline"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "clean" in out
+
+
+def test_cli_select_restricts_rules(capsys):
+    from tpusvm.analysis.cli import main
+
+    rc = main([str(CORPUS / "jx001_tracer_branch.py"),
+               "--select", "JX007", "--no-baseline"])
+    capsys.readouterr()
+    assert rc == 0  # JX001 snippet is clean under a JX007-only run
+
+
+def test_cli_unknown_path_is_usage_error(capsys):
+    from tpusvm.analysis.cli import main
+
+    rc = main(["definitely/not/a/path.py"])
+    assert rc == 2
+
+
+def test_cli_write_baseline_then_clean(tmp_path, capsys):
+    from tpusvm.analysis.cli import main
+
+    bl = tmp_path / "bl.json"
+    target = str(CORPUS / "jx004_dtype_drift.py")
+    assert main([target, "--baseline", str(bl), "--write-baseline"]) == 0
+    capsys.readouterr()
+    assert main([target, "--baseline", str(bl)]) == 0
+    out = capsys.readouterr().out
+    assert "in baseline" in out
+
+
+def test_cli_list_rules(capsys):
+    from tpusvm.analysis.cli import main
+
+    assert main(["--list-rules"]) == 0
+    out = capsys.readouterr().out
+    for rid in RULE_IDS:
+        assert rid in out
+
+
+# ----------------------------------------------- shared flag table (JX008)
+def test_pallas_flag_table_matches_solver_kwargs():
+    """Every pallas_* kwarg of blocked_smo_solve has a row in the shared
+    flag-compatibility table, so a new flag cannot dodge validation."""
+    import inspect
+
+    from tpusvm.config import PALLAS_FLAG_RULES
+    from tpusvm.solver.blocked import blocked_smo_solve
+
+    sig = inspect.signature(blocked_smo_solve.__wrapped__)
+    pallas_kwargs = {n for n in sig.parameters if n.startswith("pallas_")}
+    assert pallas_kwargs == set(PALLAS_FLAG_RULES)
+    # and the declared inactive values ARE the solver's defaults
+    for name, spec in PALLAS_FLAG_RULES.items():
+        assert sig.parameters[name].default == spec["inactive"], name
+
+
+def test_pallas_flag_errors_helper():
+    from tpusvm.config import pallas_flag_errors
+
+    # inactive values never error, any engine
+    assert pallas_flag_errors("xla", 1, {"pallas_multipair": 1,
+                                         "pallas_eta_exclude": False,
+                                         "pallas_layout": "packed"}) == []
+    # active flag on a non-pallas engine
+    errs = pallas_flag_errors("xla", 2, {"pallas_eta_exclude": True})
+    assert len(errs) == 1 and "pallas-engine feature" in errs[0]
+    # wss mismatch on the right engine
+    errs = pallas_flag_errors("pallas", 1, {"pallas_eta_exclude": True})
+    assert len(errs) == 1 and "requires wss=2" in errs[0]
+    # unknown dimensions are skipped (static analysis knows only literals)
+    assert pallas_flag_errors(None, None, {"pallas_multipair": 4}) == []
+
+
+# --------------------------------------------- satellite regression guards
+def test_midscale_effective_cfg_does_not_mutate_module_config():
+    # conftest already pins CPU + x64, so the module import is side-effect
+    # compatible with the rest of the suite
+    from benchmarks.midscale_parity import CFG, effective_cfg
+
+    before = CFG.max_iter
+    cfg = effective_cfg(123)
+    assert cfg.max_iter == 123
+    assert CFG.max_iter == before  # the module global is untouched
+    assert effective_cfg(None) is CFG

@@ -54,6 +54,12 @@ def test_sweep_n_smoke_schema(capsys):
     assert len(recs) == 1
     r = recs[0]
     assert r["n"] == 384
+    # provenance contract (ADVICE r5): workload.n stays the GENERATOR'S n
+    # (mnist_like is not prefix-stable in n), and n_train records the
+    # trained prefix separately
+    assert r["workload"]["synthetic"] is True
+    assert r["workload"]["n"] == 384 + 128  # n_max + n_test, as generated
+    assert r["workload"]["n_train"] == 384
     assert r["train_s"] > 0 and r["predict_s"] > 0
     assert r["predict_all_n_s"] > 0  # the like-for-like C16 semantics time
     assert 0.0 <= r["accuracy"] <= 1.0

@@ -1,5 +1,6 @@
 """Pallas kernel tests (interpret mode — runs on the CPU test mesh)."""
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
@@ -288,7 +289,36 @@ def test_blocked_multipair_rejects_xla_engine():
     X = jnp.zeros((16, 4), jnp.float32)
     Y = jnp.asarray([1, -1] * 8, jnp.int32)
     with pytest.raises(ValueError, match="pallas-engine feature"):
-        blocked_smo_solve(X, Y, inner="xla", pallas_multipair=4)
+        # deliberate invalid combo under pytest.raises
+        blocked_smo_solve(X, Y, inner="xla",  # tpusvm: disable=JX008
+                          pallas_multipair=4)
+
+
+def test_blocked_eta_exclude_rejects_xla_engine():
+    """ADVICE r5: pallas_eta_exclude=True resolving to a non-pallas inner
+    engine used to be silently ignored — an A/B run could record
+    eta_exclude=true while measuring the plain XLA engine. Now it raises
+    via the shared flag-compatibility table (tpusvm.config)."""
+    X = jnp.zeros((16, 4), jnp.float32)
+    Y = jnp.asarray([1, -1] * 8, jnp.int32)
+    with pytest.raises(ValueError, match="pallas-engine feature"):
+        # deliberate invalid combo under pytest.raises
+        blocked_smo_solve(X, Y, inner="xla", wss=2,  # tpusvm: disable=JX008
+                          pallas_eta_exclude=True)
+    # inner='auto' off-TPU resolves to xla — same rejection, so a
+    # CPU-pinned probe cannot mislabel its rows
+    if jax.default_backend() != "tpu":
+        with pytest.raises(ValueError, match="pallas-engine feature"):
+            blocked_smo_solve(X, Y, wss=2, pallas_eta_exclude=True)
+
+
+def test_blocked_layout_rejects_xla_engine():
+    X = jnp.zeros((16, 4), jnp.float32)
+    Y = jnp.asarray([1, -1] * 8, jnp.int32)
+    with pytest.raises(ValueError, match="pallas-engine feature"):
+        # deliberate invalid combo under pytest.raises
+        blocked_smo_solve(X, Y, inner="xla",  # tpusvm: disable=JX008
+                          pallas_layout="flat")
 
 
 def test_inner_smo_eta_exclude_rejects_wss1():

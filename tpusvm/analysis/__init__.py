@@ -1,0 +1,44 @@
+"""tpusvm.analysis — JAX tracing-safety & TPU-hazard linter.
+
+An AST-based static analyzer purpose-built for this codebase's failure
+classes: silent recompilation, host-device sync, dtype drift, and solver
+flags the config resolver would ignore. Run it with
+
+    python -m tpusvm.analysis tpusvm/ benchmarks/
+
+Rules (see README "Static analysis" for the full contract):
+
+  JX001  Python if/while on a traced value inside jit/scan bodies
+  JX002  implicit host-device sync (.item(), float(), np.asarray,
+         .block_until_ready() in hot loops)
+  JX003  data-dependent shapes under jit (boolean-mask indexing,
+         one-arg jnp.where, nonzero/unique without size=)
+  JX004  dtype drift (constructors without dtype=, bare float literals
+         on kernel paths)
+  JX005  jitted functions closing over module-level ndarrays
+  JX006  mutated module-global config read inside a traced function
+  JX007  leftover jax.debug.print/breakpoint() on kernel paths
+  JX008  pallas_* flag combinations the resolved solver config ignores
+         (driven by tpusvm.config.PALLAS_FLAG_RULES)
+
+The package imports no JAX: it is stdlib `ast` over source text, so the
+CI lint gate runs without accelerator dependencies.
+"""
+
+from tpusvm.analysis.core import Finding  # noqa: F401
+from tpusvm.analysis.lint import (  # noqa: F401
+    LintResult,
+    lint_file,
+    lint_paths,
+    lint_source,
+)
+from tpusvm.analysis.registry import all_rules  # noqa: F401
+
+__all__ = [
+    "Finding",
+    "LintResult",
+    "all_rules",
+    "lint_file",
+    "lint_paths",
+    "lint_source",
+]

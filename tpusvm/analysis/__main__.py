@@ -1,0 +1,8 @@
+"""Entry point: `python -m tpusvm.analysis [paths...]`."""
+
+import sys
+
+from tpusvm.analysis.cli import main
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,55 @@
+"""Checked-in baseline: grandfathered findings that do not fail the gate.
+
+The baseline file is JSON; entries match findings by
+(rule, path, fingerprint) — fingerprints hash the rule + path + source
+snippet (NOT the line number), so unrelated edits above a grandfathered
+finding do not invalidate it, while editing the flagged line itself
+does. Regenerate with `python -m tpusvm.analysis ... --write-baseline`.
+
+An empty or missing baseline means the tree must lint fully clean — the
+state this repo ships in.
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+from typing import List, Set, Tuple
+
+from tpusvm.analysis.core import Finding
+
+BASELINE_VERSION = 1
+DEFAULT_BASELINE_NAME = ".tpusvm-lint-baseline.json"
+
+Key = Tuple[str, str, str]  # (rule, path, fingerprint)
+
+
+def load_baseline(path) -> Set[Key]:
+    p = Path(path)
+    if not p.exists():
+        return set()
+    doc = json.loads(p.read_text(encoding="utf-8"))
+    if doc.get("version") != BASELINE_VERSION:
+        raise ValueError(
+            f"unsupported baseline version {doc.get('version')!r} in "
+            f"{path} (expected {BASELINE_VERSION})"
+        )
+    return {(e["rule"], e["path"], e["fingerprint"])
+            for e in doc.get("findings", [])}
+
+
+def write_baseline(path, findings: List[Finding]) -> None:
+    doc = {
+        "version": BASELINE_VERSION,
+        "tool": "tpusvm.analysis",
+        "findings": [
+            {"rule": f.rule, "path": f.path, "fingerprint": f.fingerprint,
+             # line + snippet are informational for the human reviewer;
+             # matching uses only (rule, path, fingerprint)
+             "line": f.line, "snippet": f.snippet}
+            for f in sorted(findings,
+                            key=lambda f: (f.path, f.line, f.rule))
+        ],
+    }
+    Path(path).write_text(json.dumps(doc, indent=2) + "\n",
+                          encoding="utf-8")

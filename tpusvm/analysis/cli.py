@@ -1,0 +1,94 @@
+"""CLI for the tpusvm linter: `python -m tpusvm.analysis [paths...]`.
+
+Exit codes: 0 = clean (modulo baseline), 1 = findings, 2 = usage error.
+The linter itself imports no JAX — it is pure stdlib `ast` over source
+text — so the CI lint job runs without accelerator deps installed.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from pathlib import Path
+
+from tpusvm.analysis.baseline import (
+    DEFAULT_BASELINE_NAME,
+    load_baseline,
+    write_baseline,
+)
+from tpusvm.analysis.core import _parse_rule_list
+from tpusvm.analysis.lint import lint_paths
+from tpusvm.analysis.registry import all_rules
+from tpusvm.analysis.report import render_json, render_text
+
+DEFAULT_PATHS = ("tpusvm", "benchmarks", "scripts", "bench.py")
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m tpusvm.analysis",
+        description=("JAX tracing-safety & TPU-hazard linter for the "
+                     "tpusvm tree (rules JX001-JX008)"),
+    )
+    p.add_argument("paths", nargs="*", default=list(DEFAULT_PATHS),
+                   help="files/directories to lint "
+                        f"(default: {' '.join(DEFAULT_PATHS)})")
+    p.add_argument("--format", choices=("text", "json"), default="text")
+    p.add_argument("--select", default="",
+                   help="comma-separated rule ids to run (default: all)")
+    p.add_argument("--ignore", default="",
+                   help="comma-separated rule ids to skip")
+    p.add_argument("--baseline", default=DEFAULT_BASELINE_NAME,
+                   help="baseline file of grandfathered findings "
+                        f"(default: {DEFAULT_BASELINE_NAME}; missing "
+                        "file = empty baseline)")
+    p.add_argument("--no-baseline", action="store_true",
+                   help="ignore the baseline file entirely")
+    p.add_argument("--write-baseline", action="store_true",
+                   help="write all current findings to the baseline "
+                        "file and exit 0")
+    p.add_argument("--list-rules", action="store_true",
+                   help="print the rule registry and exit")
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+
+    if args.list_rules:
+        for rid, rule in all_rules().items():
+            print(f"{rid}  {rule.summary}")
+        return 0
+
+    select = _parse_rule_list(args.select) or None
+    ignore = _parse_rule_list(args.ignore) or None
+    baseline = None
+    if not args.no_baseline and not args.write_baseline:
+        try:
+            baseline = load_baseline(args.baseline) or None
+        except ValueError as e:
+            print(f"tpusvm-lint: {e}", file=sys.stderr)
+            return 2
+
+    missing = [p for p in args.paths if not Path(p).exists()]
+    if missing:
+        print(f"tpusvm-lint: no such path(s): {', '.join(missing)}",
+              file=sys.stderr)
+        return 2
+
+    try:
+        result = lint_paths(args.paths, select=select, ignore=ignore,
+                            baseline=baseline)
+    except ValueError as e:  # unknown rule ids in --select/--ignore
+        print(f"tpusvm-lint: {e}", file=sys.stderr)
+        return 2
+
+    if args.write_baseline:
+        write_baseline(args.baseline, result.findings)
+        print(f"tpusvm-lint: wrote {len(result.findings)} finding(s) to "
+              f"{args.baseline}")
+        return 0
+
+    print(render_json(result) if args.format == "json"
+          else render_text(result))
+    return result.exit_code

@@ -1,0 +1,433 @@
+"""Per-module AST analysis context shared by every lint rule.
+
+One ModuleContext is built per file; it resolves import aliases to
+canonical dotted names, discovers which functions are JAX-traced (jit /
+pmap decorators, `jax.jit(f)` wrapping, lax control-flow combinator
+bodies, and functions nested inside any of those), and infers which names
+inside each traced function hold tracers — the seed for rules JX001-JX006.
+
+The taint model is deliberately a lexical over/under-approximation tuned
+for a low false-positive rate on this repo, not a type checker:
+
+  * parameters of a traced function are tracers unless listed in the
+    jit decorator's static_argnames/static_argnums;
+  * names assigned from expressions that involve a tracer, or from calls
+    into array namespaces (jax.numpy, jax.lax, ...), become tracers;
+  * `.shape` / `.ndim` / `.dtype` / `.size` attribute reads, `len()`,
+    `isinstance()` and `is`/`is not` comparisons are static under
+    tracing and never taint.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Dict, List, Optional, Set
+
+from tpusvm.analysis.core import is_kernel_path
+
+# call results from these namespaces are traced arrays inside a traced fn
+ARRAY_NAMESPACES = (
+    "jax.numpy.",
+    "jax.lax.",
+    "jax.nn.",
+    "jax.scipy.",
+    "jax.random.",
+    "jax.image.",
+)
+
+# decorators / wrappers that make a function a tracing entry point
+TRACING_WRAPPERS = {
+    "jax.jit",
+    "jax.pmap",
+    "jax.vmap",
+    "jax.pjit",
+    "jax.experimental.pjit.pjit",
+}
+
+# lax combinators whose function-valued arguments are traced; every
+# Lambda or locally-defined function passed to one is marked (position
+# conventions vary per combinator, so argument slots are not tracked)
+LAX_COMBINATORS = {
+    "jax.lax.scan",
+    "jax.lax.while_loop",
+    "jax.lax.fori_loop",
+    "jax.lax.cond",
+    "jax.lax.switch",
+    "jax.lax.map",
+    "jax.lax.associative_scan",
+    "jax.experimental.shard_map.shard_map",
+    "jax.checkpoint",
+    "jax.remat",
+}
+
+# attribute reads that are STATIC under tracing (never taint)
+STATIC_ATTRS = frozenset(
+    {"shape", "ndim", "dtype", "size", "itemsize", "nbytes", "weak_type",
+     "sharding", "aval", "__name__"}
+)
+
+# calls whose results are static/host values regardless of arguments
+STATIC_CALLS = frozenset(
+    {"len", "isinstance", "hasattr", "callable", "type", "id", "repr",
+     "str", "format", "getattr"}
+)
+
+
+@dataclasses.dataclass
+class TracedFunction:
+    """A function whose body executes under JAX tracing."""
+
+    node: ast.AST                 # FunctionDef | AsyncFunctionDef | Lambda
+    name: str
+    reason: str                   # human-readable: how tracing was detected
+    static_names: Set[str]
+    tracer_names: Set[str] = dataclasses.field(default_factory=set)
+    own_nodes: List[ast.AST] = dataclasses.field(default_factory=list)
+    parent: Optional["TracedFunction"] = None
+
+    @property
+    def lineno(self) -> int:
+        return getattr(self.node, "lineno", 1)
+
+
+def _own_nodes(fn_node: ast.AST) -> List[ast.AST]:
+    """Descendants of a function, stopping at nested function boundaries.
+
+    Nested functions are traced entries of their own, so their bodies are
+    excluded here to keep every node owned by exactly one traced function.
+    """
+    out: List[ast.AST] = []
+    stack = list(ast.iter_child_nodes(fn_node))
+    while stack:
+        node = stack.pop()
+        out.append(node)
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+    return out
+
+
+def _param_names(fn_node: ast.AST) -> List[str]:
+    a = fn_node.args
+    params = [p.arg for p in a.posonlyargs + a.args + a.kwonlyargs]
+    if a.vararg:
+        params.append(a.vararg.arg)
+    if a.kwarg:
+        params.append(a.kwarg.arg)
+    return params
+
+
+class ModuleContext:
+    """Everything the rules need to know about one source file."""
+
+    def __init__(self, path: str, source: str):
+        self.path = path
+        self.source = source
+        self.tree = ast.parse(source)
+        self.lines = source.splitlines()
+        self.aliases = self._collect_aliases()
+        self.kernel_path = is_kernel_path(path, source)
+        # name -> every FunctionDef with that name, in source order; a
+        # reference like `lax.while_loop(cond, body, ...)` resolves to the
+        # NEAREST PRECEDING definition, so same-named bodies in different
+        # functions (e.g. the inner and outer `body` of a two-level
+        # solver) each bind to their own combinator call
+        self.functions: Dict[str, List[ast.AST]] = {}
+        for n in ast.walk(self.tree):
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.functions.setdefault(n.name, []).append(n)
+        for defs in self.functions.values():
+            defs.sort(key=lambda d: d.lineno)
+        self.traced_functions: List[TracedFunction] = []
+        self._discover_traced()
+        self._infer_tracers()
+        self.traced_node_ids: Set[int] = set()
+        for fn in self.traced_functions:
+            self.traced_node_ids.add(id(fn.node))
+            self.traced_node_ids.update(id(n) for n in fn.own_nodes)
+
+    # ---------------------------------------------------------------- alias
+    def _collect_aliases(self) -> Dict[str, str]:
+        aliases: Dict[str, str] = {}
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    if a.asname:
+                        aliases[a.asname] = a.name
+                    else:
+                        # `import jax.numpy` binds `jax`; attribute chains
+                        # resolve naturally from the root name
+                        root = a.name.split(".", 1)[0]
+                        aliases[root] = root
+            elif isinstance(node, ast.ImportFrom) and node.module:
+                for a in node.names:
+                    if a.name == "*":
+                        continue
+                    aliases[a.asname or a.name] = f"{node.module}.{a.name}"
+        return aliases
+
+    def resolve(self, node: ast.AST) -> Optional[str]:
+        """Canonical dotted name of a Name/Attribute chain, via aliases."""
+        parts: List[str] = []
+        while isinstance(node, ast.Attribute):
+            parts.append(node.attr)
+            node = node.value
+        if isinstance(node, ast.Name):
+            parts.append(self.aliases.get(node.id, node.id))
+            return ".".join(reversed(parts))
+        return None
+
+    def resolve_call(self, call: ast.Call) -> Optional[str]:
+        return self.resolve(call.func)
+
+    # ------------------------------------------------------------ discovery
+    def _jit_decorator_statics(self, fn: ast.AST):
+        """(is_traced, reason, static_names) from a function's decorators."""
+        for dec in getattr(fn, "decorator_list", []):
+            target, call = dec, None
+            if isinstance(dec, ast.Call):
+                call = dec
+                target = dec.func
+                resolved = self.resolve(target)
+                # functools.partial(jax.jit, static_argnames=...)
+                if resolved == "functools.partial" and dec.args:
+                    inner = self.resolve(dec.args[0])
+                    if inner in TRACING_WRAPPERS:
+                        return True, f"@partial({inner}, ...)", \
+                            self._static_names(call, fn)
+            resolved = self.resolve(target)
+            if resolved in TRACING_WRAPPERS:
+                reason = f"@{resolved}"
+                statics = self._static_names(call, fn) if call else set()
+                return True, reason, statics
+        return False, "", set()
+
+    def _static_names(self, call: ast.Call, fn: ast.AST) -> Set[str]:
+        statics: Set[str] = set()
+        params = _param_names(fn)
+        for kw in call.keywords:
+            if kw.arg == "static_argnames":
+                v = kw.value
+                if isinstance(v, ast.Constant) and isinstance(v.value, str):
+                    statics.add(v.value)
+                elif isinstance(v, (ast.Tuple, ast.List)):
+                    statics |= {e.value for e in v.elts
+                                if isinstance(e, ast.Constant)
+                                and isinstance(e.value, str)}
+            elif kw.arg == "static_argnums":
+                v = kw.value
+                nums = []
+                if isinstance(v, ast.Constant) and isinstance(v.value, int):
+                    nums = [v.value]
+                elif isinstance(v, (ast.Tuple, ast.List)):
+                    nums = [e.value for e in v.elts
+                            if isinstance(e, ast.Constant)
+                            and isinstance(e.value, int)]
+                statics |= {params[i] for i in nums if 0 <= i < len(params)}
+        return statics
+
+    def _mark(self, node: ast.AST, reason: str, statics: Set[str],
+              marked: Dict[int, TracedFunction]) -> None:
+        if id(node) in marked:
+            return
+        name = getattr(node, "name", "<lambda>")
+        marked[id(node)] = TracedFunction(
+            node=node, name=name, reason=reason, static_names=set(statics)
+        )
+
+    def _discover_traced(self) -> None:
+        marked: Dict[int, TracedFunction] = {}
+
+        for node in ast.walk(self.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                traced, reason, statics = self._jit_decorator_statics(node)
+                if traced:
+                    self._mark(node, reason, statics, marked)
+            elif isinstance(node, ast.Call):
+                resolved = self.resolve_call(node)
+                if resolved in TRACING_WRAPPERS:
+                    # jax.jit(f) / jax.jit(lambda ...: ...)
+                    for arg in node.args[:1]:
+                        fn = self._as_function(arg, node.lineno)
+                        if fn is not None:
+                            self._mark(fn, f"{resolved}(...)",
+                                       self._call_statics(node, fn), marked)
+                elif resolved in LAX_COMBINATORS:
+                    for arg in list(node.args) + [k.value
+                                                  for k in node.keywords]:
+                        fn = self._as_function(arg, node.lineno)
+                        if fn is not None:
+                            self._mark(fn, f"{resolved} body", set(), marked)
+
+        # nested functions inside a traced function are traced too; walk
+        # top-down so parents are marked before children
+        roots = list(marked.values())
+        for tf in roots:
+            for sub in ast.walk(tf.node):
+                if sub is tf.node:
+                    continue
+                if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                    ast.Lambda)):
+                    self._mark(sub, f"nested in traced {tf.name!r}", set(),
+                               marked)
+
+        # parent links (lexical nesting among traced functions)
+        by_id = marked
+        for tf in by_id.values():
+            for sub in ast.walk(tf.node):
+                if sub is tf.node:
+                    continue
+                child = by_id.get(id(sub))
+                if child is not None and child.parent is None:
+                    child.parent = tf
+
+        for tf in by_id.values():
+            tf.own_nodes = _own_nodes(tf.node)
+        # outer-before-inner so taint inference can seed children from
+        # parents
+        self.traced_functions = sorted(
+            by_id.values(), key=lambda t: (t.lineno, _depth(t))
+        )
+
+    def _call_statics(self, call: ast.Call, fn: ast.AST) -> Set[str]:
+        try:
+            return self._static_names(call, fn)
+        except Exception:
+            return set()
+
+    def _as_function(self, arg: ast.AST,
+                     at_line: int) -> Optional[ast.AST]:
+        if isinstance(arg, ast.Lambda):
+            return arg
+        if isinstance(arg, ast.Name):
+            defs = self.functions.get(arg.id, [])
+            preceding = [d for d in defs if d.lineno <= at_line]
+            if preceding:
+                return preceding[-1]
+            return defs[0] if defs else None
+        return None
+
+    # ---------------------------------------------------------------- taint
+    def _infer_tracers(self) -> None:
+        for tf in self.traced_functions:
+            tracers: Set[str] = set()
+            if tf.parent is not None:
+                # closed-over tracers from the enclosing traced function
+                tracers |= tf.parent.tracer_names
+            tracers |= {p for p in _param_names(tf.node)}
+            tracers -= tf.static_names
+            # fixed point over this function's own assignments
+            for _ in range(10):
+                before = len(tracers)
+                for node in tf.own_nodes:
+                    if isinstance(node, ast.Assign):
+                        if self.expr_taints(node.value, tracers):
+                            for t in node.targets:
+                                tracers |= _target_names(t)
+                    elif isinstance(node, ast.AugAssign):
+                        if self.expr_taints(node.value, tracers):
+                            tracers |= _target_names(node.target)
+                    elif isinstance(node, ast.AnnAssign) and node.value:
+                        if self.expr_taints(node.value, tracers):
+                            tracers |= _target_names(node.target)
+                    elif isinstance(node, ast.NamedExpr):
+                        if self.expr_taints(node.value, tracers):
+                            tracers |= _target_names(node.target)
+                    elif isinstance(node, ast.For):
+                        if self.expr_taints(node.iter, tracers):
+                            tracers |= _target_names(node.target)
+                if len(tracers) == before:
+                    break
+            tf.tracer_names = tracers
+
+    def expr_taints(self, node: ast.AST, tracers: Set[str],
+                    test_position: bool = False) -> bool:
+        """Does evaluating `node` involve a traced value?
+
+        test_position=True applies the extra exemptions that make a
+        BRANCH on the value legal under tracing (`is`/`is not`
+        comparisons, isinstance, membership tests against literal
+        tuples of constants).
+        """
+        if node is None:
+            return False
+        if isinstance(node, ast.Name):
+            return node.id in tracers
+        if isinstance(node, ast.Constant):
+            return False
+        if isinstance(node, ast.Attribute):
+            if node.attr in STATIC_ATTRS:
+                return False
+            return self.expr_taints(node.value, tracers, test_position)
+        if isinstance(node, ast.Subscript):
+            # x[i] carries x's taint; a host container indexed by a tracer
+            # is a different bug class (concretization) left to runtime
+            return self.expr_taints(node.value, tracers, test_position)
+        if isinstance(node, ast.Compare):
+            if all(isinstance(op, (ast.Is, ast.IsNot)) for op in node.ops):
+                # `x is None` on a tracer-or-None parameter is a static
+                # trace-time branch, never a traced-value branch
+                return False
+            if test_position and all(
+                isinstance(op, (ast.In, ast.NotIn)) for op in node.ops
+            ) and all(_is_const_container(c) for c in node.comparators):
+                # `mode in ("a", "b")` — membership against literal
+                # constants is (almost always) a static-config check
+                return False
+            return any(
+                self.expr_taints(c, tracers, test_position)
+                for c in [node.left] + node.comparators
+            )
+        if isinstance(node, ast.Call):
+            resolved = self.resolve_call(node)
+            if resolved in STATIC_CALLS:
+                return False
+            if resolved and resolved.startswith(ARRAY_NAMESPACES):
+                return True
+            children = [node.func] + list(node.args) + \
+                [kw.value for kw in node.keywords]
+            if isinstance(node.func, ast.Name):
+                # plain helper call: taints iff its arguments do
+                children = list(node.args) + \
+                    [kw.value for kw in node.keywords]
+            return any(self.expr_taints(c, tracers, test_position)
+                       for c in children)
+        if isinstance(node, ast.Lambda):
+            return False
+        # generic structural recursion (BoolOp, BinOp, UnaryOp, IfExp,
+        # Tuple, List, Dict, Starred, comprehensions, f-strings, ...)
+        return any(
+            self.expr_taints(child, tracers, test_position)
+            for child in ast.iter_child_nodes(node)
+        )
+
+    # ------------------------------------------------------------- queries
+    def host_nodes(self) -> List[ast.AST]:
+        """Module nodes NOT owned by any traced function."""
+        return [n for n in ast.walk(self.tree)
+                if id(n) not in self.traced_node_ids]
+
+
+def _depth(tf: TracedFunction) -> int:
+    d, cur = 0, tf.parent
+    while cur is not None:
+        d, cur = d + 1, cur.parent
+    return d
+
+
+def _target_names(target: ast.AST) -> Set[str]:
+    names: Set[str] = set()
+    for node in ast.walk(target):
+        if isinstance(node, ast.Name):
+            names.add(node.id)
+    return names
+
+
+def _is_const_container(node: ast.AST) -> bool:
+    if isinstance(node, ast.Constant):
+        return True
+    if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+        return all(isinstance(e, ast.Constant) for e in node.elts)
+    return False

@@ -1,0 +1,153 @@
+"""Core data model of the tpusvm static analyzer.
+
+A Finding is one rule violation at one source location; this module also
+owns the cross-cutting source-comment conventions (suppressions and the
+kernel-path pragma) and file discovery, so rules and the CLI share one
+definition of each.
+
+Comment conventions (documented in README "Static analysis"):
+
+  # tpusvm: disable=JX001            suppress on this line (or the line
+                                     directly below, when the comment
+                                     stands alone)
+  # tpusvm: disable=JX001,JX004      several rules
+  # tpusvm: disable=all              every rule on this line
+  # tpusvm: disable-file=JX002       suppress a rule for the whole file
+  # tpusvm: kernel-path              treat this file as a kernel path
+                                     (ops/solver) for path-scoped rules
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import re
+from pathlib import Path
+from typing import Dict, Iterable, List, Optional, Set
+
+# path prefixes (posix, repo-relative) whose files are "kernel paths" for
+# the path-scoped rules (JX004 outside traced code, JX007)
+KERNEL_PATH_PARTS = ("tpusvm/ops", "tpusvm/solver")
+
+# directories never descended into during discovery: the known-bad lint
+# corpus (it exists to FAIL the rules), caches, committed results, and the
+# non-Python native tree
+DEFAULT_EXCLUDE_DIRS = frozenset(
+    {"analysis_corpus", "__pycache__", ".git", "results", "native",
+     ".github"}
+)
+
+_DISABLE_RE = re.compile(r"#\s*tpusvm:\s*disable=([A-Za-z0-9_,\s]+)")
+_DISABLE_FILE_RE = re.compile(r"#\s*tpusvm:\s*disable-file=([A-Za-z0-9_,\s]+)")
+_KERNEL_PRAGMA_RE = re.compile(r"#\s*tpusvm:\s*kernel-path\b")
+_COMMENT_ONLY_RE = re.compile(r"^\s*#")
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One rule violation at one source location (1-based line/col)."""
+
+    rule: str
+    path: str
+    line: int
+    col: int
+    message: str
+    snippet: str = ""
+    fingerprint: str = ""
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}:{self.col}: {self.rule} {self.message}"
+
+
+def fingerprint_findings(findings: List[Finding]) -> List[Finding]:
+    """Attach stable fingerprints: hash of (rule, path, snippet, occurrence).
+
+    Line numbers are deliberately excluded so a checked-in baseline
+    survives unrelated edits above the finding; the occurrence index
+    disambiguates identical snippets within one file.
+    """
+    seen: Dict[str, int] = {}
+    out = []
+    for f in findings:
+        key = f"{f.rule}|{f.path}|{f.snippet.strip()}"
+        occ = seen.get(key, 0)
+        seen[key] = occ + 1
+        digest = hashlib.sha1(f"{key}|{occ}".encode()).hexdigest()[:12]
+        out.append(dataclasses.replace(f, fingerprint=digest))
+    return out
+
+
+def snippet_at(lines: List[str], lineno: int) -> str:
+    """The stripped source line at 1-based `lineno` ('' when out of range)."""
+    if 0 < lineno <= len(lines):
+        return lines[lineno - 1].strip()
+    return ""
+
+
+def _parse_rule_list(raw: str) -> Set[str]:
+    return {tok.strip().upper() for tok in raw.split(",") if tok.strip()}
+
+
+def file_suppressions(lines: List[str]) -> Set[str]:
+    """Rule ids disabled for the whole file via `# tpusvm: disable-file=`."""
+    rules: Set[str] = set()
+    for ln in lines:
+        m = _DISABLE_FILE_RE.search(ln)
+        if m:
+            rules |= _parse_rule_list(m.group(1))
+    return rules
+
+
+def line_suppressions(lines: List[str], lineno: int) -> Set[str]:
+    """Rule ids disabled for 1-based line `lineno`.
+
+    A trailing comment on the line itself wins; a comment-ONLY line
+    directly above also applies (for statements too long to annotate
+    inline).
+    """
+    rules: Set[str] = set()
+    for idx in (lineno - 1, lineno - 2):
+        if not (0 <= idx < len(lines)):
+            continue
+        m = _DISABLE_RE.search(lines[idx])
+        if m and (idx == lineno - 1 or _COMMENT_ONLY_RE.match(lines[idx])):
+            rules |= _parse_rule_list(m.group(1))
+    return rules
+
+
+def is_suppressed(finding: Finding, lines: List[str],
+                  file_rules: Optional[Set[str]] = None) -> bool:
+    if file_rules is None:
+        file_rules = file_suppressions(lines)
+    active = file_rules | line_suppressions(lines, finding.line)
+    return finding.rule in active or "ALL" in active
+
+
+def has_kernel_pragma(source: str) -> bool:
+    return bool(_KERNEL_PRAGMA_RE.search(source))
+
+
+def is_kernel_path(path: str, source: str = "") -> bool:
+    posix = Path(path).as_posix()
+    if any(part in posix for part in KERNEL_PATH_PARTS):
+        return True
+    return bool(source) and has_kernel_pragma(source)
+
+
+def iter_python_files(paths: Iterable[str]) -> List[Path]:
+    """Expand files/directories into a sorted, deduped list of .py files."""
+    found: Set[Path] = set()
+    for raw in paths:
+        p = Path(raw)
+        if p.is_dir():
+            for f in p.rglob("*.py"):
+                if not any(part in DEFAULT_EXCLUDE_DIRS for part in f.parts):
+                    found.add(f)
+        elif p.suffix == ".py":
+            # explicit file arguments bypass the exclude list (that is how
+            # the corpus self-tests lint their known-bad snippets)
+            found.add(p)
+    return sorted(found)

@@ -1,0 +1,100 @@
+"""Lint driver: run the registered rules over sources, files, or trees.
+
+Public API:
+
+  lint_source(source, path)  -> (findings, suppressed)   one string
+  lint_file(path)            -> (findings, suppressed)   one file
+  lint_paths(paths)          -> LintResult               files + dirs
+
+Suppression comments (`# tpusvm: disable=JX00x`) are honoured here — a
+rule never needs to know about them. Parse failures surface as a single
+JX000 finding so a syntactically-broken file fails the gate instead of
+silently passing.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Set, Tuple
+
+from tpusvm.analysis.core import (
+    Finding,
+    file_suppressions,
+    fingerprint_findings,
+    is_suppressed,
+    iter_python_files,
+)
+from tpusvm.analysis.registry import select_rules
+
+
+@dataclasses.dataclass
+class LintResult:
+    findings: List[Finding]
+    suppressed: List[Finding]
+    baselined: List[Finding]
+    files_scanned: int
+
+    @property
+    def exit_code(self) -> int:
+        return 1 if self.findings else 0
+
+
+def lint_source(source: str, path: str = "<string>",
+                select: Optional[Set[str]] = None,
+                ignore: Optional[Set[str]] = None,
+                ) -> Tuple[List[Finding], List[Finding]]:
+    """Lint one source string; returns (active, suppressed) findings."""
+    from tpusvm.analysis.context import ModuleContext
+
+    rules = select_rules(select, ignore)
+    try:
+        ctx = ModuleContext(path, source)
+    except SyntaxError as e:
+        return fingerprint_findings([Finding(
+            rule="JX000", path=path, line=e.lineno or 1,
+            col=(e.offset or 0) + 1,
+            message=f"file does not parse: {e.msg}",
+        )]), []
+    raw: List[Finding] = []
+    for rule in rules:
+        raw.extend(rule.check(ctx))
+    raw.sort(key=lambda f: (f.line, f.col, f.rule))
+    raw = fingerprint_findings(raw)
+    file_rules = file_suppressions(ctx.lines)
+    active, suppressed = [], []
+    for f in raw:
+        (suppressed if is_suppressed(f, ctx.lines, file_rules)
+         else active).append(f)
+    return active, suppressed
+
+
+def lint_file(path, select=None, ignore=None):
+    with open(path, encoding="utf-8") as fh:
+        source = fh.read()
+    return lint_source(source, str(path), select, ignore)
+
+
+def lint_paths(paths, select=None, ignore=None,
+               baseline: Optional[Set[Tuple[str, str, str]]] = None,
+               ) -> LintResult:
+    """Lint every .py file under `paths`.
+
+    `baseline` is a set of (rule, path, fingerprint) triples (see
+    tpusvm.analysis.baseline); matching findings are reported separately
+    and do not fail the gate.
+    """
+    findings: List[Finding] = []
+    suppressed: List[Finding] = []
+    baselined: List[Finding] = []
+    files = iter_python_files(paths)
+    for f in files:
+        active, supp = lint_file(f, select, ignore)
+        suppressed.extend(supp)
+        for finding in active:
+            key = (finding.rule, finding.path, finding.fingerprint)
+            if baseline and key in baseline:
+                baselined.append(finding)
+            else:
+                findings.append(finding)
+    return LintResult(findings=findings, suppressed=suppressed,
+                      baselined=baselined, files_scanned=len(files))

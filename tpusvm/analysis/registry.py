@@ -1,0 +1,54 @@
+"""Rule registry: every JX rule registers itself at import time.
+
+A rule is a stateless object with an `id` (JXnnn), a one-line `summary`,
+and `check(ctx) -> Iterable[Finding]` over one ModuleContext. Rules live
+in tpusvm/analysis/rules/ (one module per rule); importing
+tpusvm.analysis.rules populates the registry.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterable, List, Optional, Set
+
+RULES: Dict[str, "Rule"] = {}
+
+
+class Rule:
+    id: str = ""
+    summary: str = ""
+
+    def check(self, ctx) -> Iterable:
+        raise NotImplementedError
+
+
+def register(cls):
+    """Class decorator: instantiate and register a rule by its id."""
+    inst = cls()
+    if not inst.id:
+        raise ValueError(f"rule {cls.__name__} has no id")
+    if inst.id in RULES:
+        raise ValueError(f"duplicate rule id {inst.id}")
+    RULES[inst.id] = inst
+    return cls
+
+
+def all_rules() -> Dict[str, Rule]:
+    # importing the rules package has the side effect of registering
+    # every rule; deferred so `import tpusvm.analysis.registry` alone
+    # stays cheap and cycle-free
+    import tpusvm.analysis.rules  # noqa: F401
+
+    return dict(sorted(RULES.items()))
+
+
+def select_rules(select: Optional[Set[str]] = None,
+                 ignore: Optional[Set[str]] = None) -> List[Rule]:
+    rules = all_rules()
+    unknown = (set(select or ()) | set(ignore or ())) - set(rules)
+    if unknown:
+        raise ValueError(f"unknown rule id(s): {sorted(unknown)}; "
+                         f"known: {sorted(rules)}")
+    picked = [r for rid, r in rules.items()
+              if (not select or rid in select)
+              and (not ignore or rid not in ignore)]
+    return picked

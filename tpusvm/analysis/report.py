@@ -1,0 +1,61 @@
+"""Text and JSON reporters for lint results.
+
+The JSON document is a stable machine-readable schema (version 1, tested
+by tests/test_analysis.py::test_json_report_schema):
+
+  {
+    "version": 1,
+    "tool": "tpusvm.analysis",
+    "files_scanned": <int>,
+    "rules": {"JX001": "<summary>", ...},
+    "findings": [{"rule", "path", "line", "col", "message",
+                  "snippet", "fingerprint"}, ...],
+    "counts": {"JX001": <int>, ...},         # active findings per rule
+    "suppressed": <int>,
+    "baselined": <int>
+  }
+"""
+
+from __future__ import annotations
+
+import json
+from collections import Counter
+
+from tpusvm.analysis.lint import LintResult
+from tpusvm.analysis.registry import all_rules
+
+JSON_SCHEMA_VERSION = 1
+
+
+def render_text(result: LintResult) -> str:
+    lines = [f.render() for f in result.findings]
+    counts = Counter(f.rule for f in result.findings)
+    tail = (", ".join(f"{r}: {n}" for r, n in sorted(counts.items()))
+            or "clean")
+    extras = []
+    if result.suppressed:
+        extras.append(f"{len(result.suppressed)} suppressed inline")
+    if result.baselined:
+        extras.append(f"{len(result.baselined)} in baseline")
+    extra = f" ({'; '.join(extras)})" if extras else ""
+    lines.append(
+        f"tpusvm-lint: {len(result.findings)} finding(s) in "
+        f"{result.files_scanned} file(s) — {tail}{extra}"
+    )
+    return "\n".join(lines)
+
+
+def render_json(result: LintResult) -> str:
+    counts = Counter(f.rule for f in result.findings)
+    doc = {
+        "version": JSON_SCHEMA_VERSION,
+        "tool": "tpusvm.analysis",
+        "files_scanned": result.files_scanned,
+        "rules": {rid: rule.summary
+                  for rid, rule in all_rules().items()},
+        "findings": [f.to_dict() for f in result.findings],
+        "counts": dict(sorted(counts.items())),
+        "suppressed": len(result.suppressed),
+        "baselined": len(result.baselined),
+    }
+    return json.dumps(doc, indent=2, sort_keys=False)

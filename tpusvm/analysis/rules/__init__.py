@@ -1,0 +1,13 @@
+"""Rule modules for the tpusvm linter; importing this package registers
+every rule with tpusvm.analysis.registry."""
+
+from tpusvm.analysis.rules import (  # noqa: F401
+    jx001_tracer_branch,
+    jx002_host_sync,
+    jx003_dynamic_shape,
+    jx004_dtype_drift,
+    jx005_closure_capture,
+    jx006_global_config,
+    jx007_debug_leftover,
+    jx008_pallas_flags,
+)

@@ -1,0 +1,47 @@
+"""JX001 — Python control flow branching on a traced value.
+
+Inside a jit/pmap/lax-combinator body, a Python `if`/`while` whose test
+involves a tracer either raises ConcretizationTypeError or — worse, when
+the value happens to be concrete at trace time (a weak-typed constant, a
+shape-dependent expression that silently became data-dependent after a
+refactor) — bakes ONE branch into the compiled program and recompiles on
+every distinct value. The TPU-native fix is lax.cond / lax.select /
+jnp.where.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tpusvm.analysis.core import Finding, snippet_at
+from tpusvm.analysis.registry import Rule, register
+
+
+@register
+class TracerBranch(Rule):
+    id = "JX001"
+    summary = ("Python if/while on a traced value inside a jit/scan body "
+               "(use lax.cond/lax.select/jnp.where)")
+
+    def check(self, ctx):
+        for tf in ctx.traced_functions:
+            for node in tf.own_nodes:
+                if not isinstance(node, (ast.If, ast.While)):
+                    continue
+                if ctx.expr_taints(node.test, tf.tracer_names,
+                                   test_position=True):
+                    kind = "if" if isinstance(node, ast.If) else "while"
+                    yield Finding(
+                        rule=self.id,
+                        path=ctx.path,
+                        line=node.lineno,
+                        col=node.col_offset + 1,
+                        message=(
+                            f"Python `{kind}` branches on a traced value "
+                            f"inside {tf.name!r} ({tf.reason}); under "
+                            "tracing this either raises or freezes one "
+                            "branch into the compiled program — use "
+                            "lax.cond/lax.select/jnp.where"
+                        ),
+                        snippet=snippet_at(ctx.lines, node.lineno),
+                    )

@@ -1,0 +1,100 @@
+"""JX002 — implicit host-device synchronisation.
+
+Two variants of the same hazard:
+
+  * inside a traced function: `np.asarray`/`np.array` of a tracer,
+    `.item()` / `.tolist()` / `.block_until_ready()` on a tracer, or the
+    `float()`/`int()`/`bool()` builtins applied to one — these either
+    raise ConcretizationTypeError under jit or, in op-by-op code that
+    LOOKS jitted, silently serialize the device pipeline;
+  * in host code inside a `for`/`while` loop: `.item()` /
+    `.block_until_ready()` calls, each of which stalls the host on the
+    device — the classic accidental per-iteration sync that turns an
+    async dispatch loop into a round-trip-bound one.
+
+Deliberate synchronisation points (timing barriers in benchmark
+harnesses) carry a `# tpusvm: disable=JX002` annotation — the comment IS
+the documentation that the sync is intentional.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tpusvm.analysis.core import Finding, snippet_at
+from tpusvm.analysis.registry import Rule, register
+
+_HOST_MATERIALIZERS = {"numpy.asarray", "numpy.array", "numpy.copy"}
+_SYNC_METHODS = {"item", "tolist", "block_until_ready"}
+_CONCRETIZING_BUILTINS = {"float", "int", "bool", "complex"}
+_HOT_LOOP_METHODS = {"item", "block_until_ready"}
+
+
+@register
+class HostSync(Rule):
+    id = "JX002"
+    summary = ("implicit host-device sync: np.asarray/.item()/float() on "
+               "a tracer, or per-iteration .item()/.block_until_ready() "
+               "in a host hot loop")
+
+    def check(self, ctx):
+        yield from self._traced(ctx)
+        yield from self._host_loops(ctx)
+
+    def _traced(self, ctx):
+        for tf in ctx.traced_functions:
+            for node in tf.own_nodes:
+                if not isinstance(node, ast.Call):
+                    continue
+                resolved = ctx.resolve_call(node)
+                hit = None
+                if resolved in _HOST_MATERIALIZERS and any(
+                    ctx.expr_taints(a, tf.tracer_names) for a in node.args
+                ):
+                    hit = (f"{resolved.split('.')[-1]}() materialises a "
+                           "traced value on the host")
+                elif (isinstance(node.func, ast.Attribute)
+                        and node.func.attr in _SYNC_METHODS
+                        and ctx.expr_taints(node.func.value,
+                                            tf.tracer_names)):
+                    hit = (f".{node.func.attr}() forces a host round-trip "
+                           "on a traced value")
+                elif (isinstance(node.func, ast.Name)
+                        and node.func.id in _CONCRETIZING_BUILTINS
+                        and node.func.id not in ctx.aliases
+                        and node.args
+                        and ctx.expr_taints(node.args[0], tf.tracer_names)):
+                    hit = (f"{node.func.id}() concretises a traced value")
+                if hit:
+                    yield Finding(
+                        rule=self.id, path=ctx.path, line=node.lineno,
+                        col=node.col_offset + 1,
+                        message=(f"{hit} inside traced function "
+                                 f"{tf.name!r} ({tf.reason})"),
+                        snippet=snippet_at(ctx.lines, node.lineno),
+                    )
+
+    def _host_loops(self, ctx):
+        # lexical loop ancestry over host-only nodes
+        loops = [n for n in ctx.host_nodes()
+                 if isinstance(n, (ast.For, ast.While))]
+        seen = set()
+        for loop in loops:
+            for node in ast.walk(loop):
+                if id(node) in ctx.traced_node_ids or id(node) in seen:
+                    continue
+                if (isinstance(node, ast.Call)
+                        and isinstance(node.func, ast.Attribute)
+                        and node.func.attr in _HOT_LOOP_METHODS):
+                    seen.add(id(node))
+                    yield Finding(
+                        rule=self.id, path=ctx.path, line=node.lineno,
+                        col=node.col_offset + 1,
+                        message=(
+                            f".{node.func.attr}() inside a host loop "
+                            "synchronises with the device every "
+                            "iteration; hoist it out of the loop or "
+                            "batch the transfers"
+                        ),
+                        snippet=snippet_at(ctx.lines, node.lineno),
+                    )

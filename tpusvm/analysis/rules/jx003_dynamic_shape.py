@@ -1,0 +1,104 @@
+"""JX003 — data-dependent output shapes under tracing.
+
+XLA requires static shapes: boolean-mask indexing (`x[mask]`), one-arg
+`jnp.where(cond)`, `jnp.nonzero` & friends all produce arrays whose SIZE
+depends on runtime data, which fails to trace (or forces a host fallback).
+The TPU-native replacements are the three-arg `jnp.where(cond, a, b)`,
+masked reductions, or the `size=`/fill_value forms of nonzero/unique —
+this repo's fixed-capacity SV buffers (tpusvm/parallel/svbuffer.py) exist
+precisely because of this constraint.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tpusvm.analysis.core import Finding, snippet_at
+from tpusvm.analysis.registry import Rule, register
+
+# one-arg jnp.where is dynamic; with `size=` the *_nonzero family is fine
+_DYNAMIC_CALLS = {
+    "jax.numpy.nonzero",
+    "jax.numpy.flatnonzero",
+    "jax.numpy.argwhere",
+    "jax.numpy.unique",
+    "jax.numpy.compress",
+    "jax.numpy.extract",
+}
+
+
+@register
+class DynamicShape(Rule):
+    id = "JX003"
+    summary = ("data-dependent output shape under jit: boolean-mask "
+               "indexing, one-arg jnp.where, nonzero/unique without "
+               "size=")
+
+    def check(self, ctx):
+        for tf in ctx.traced_functions:
+            bool_names = self._bool_mask_names(ctx, tf)
+            for node in tf.own_nodes:
+                if isinstance(node, ast.Call):
+                    yield from self._check_call(ctx, tf, node)
+                elif isinstance(node, ast.Subscript):
+                    yield from self._check_subscript(ctx, tf, node,
+                                                    bool_names)
+
+    def _bool_mask_names(self, ctx, tf):
+        """Names assigned from comparison expressions (boolean masks)."""
+        names = set()
+        for node in tf.own_nodes:
+            if isinstance(node, ast.Assign) and isinstance(node.value,
+                                                           ast.Compare):
+                if ctx.expr_taints(node.value, tf.tracer_names):
+                    for t in node.targets:
+                        if isinstance(t, ast.Name):
+                            names.add(t.id)
+        return names
+
+    def _check_call(self, ctx, tf, node):
+        resolved = ctx.resolve_call(node)
+        kwargs = {kw.arg for kw in node.keywords}
+        if (resolved == "jax.numpy.where" and len(node.args) == 1
+                and not kwargs & {"x", "y"}):
+            yield Finding(
+                rule=self.id, path=ctx.path, line=node.lineno,
+                col=node.col_offset + 1,
+                message=("one-arg jnp.where(cond) returns "
+                         "data-dependent-size index arrays and fails "
+                         "under jit; use the three-arg form or "
+                         "jnp.nonzero(cond, size=...)"),
+                snippet=snippet_at(ctx.lines, node.lineno),
+            )
+        elif resolved in _DYNAMIC_CALLS and "size" not in kwargs:
+            short = resolved.replace("jax.numpy.", "jnp.")
+            yield Finding(
+                rule=self.id, path=ctx.path, line=node.lineno,
+                col=node.col_offset + 1,
+                message=(f"{short} without size= has a data-dependent "
+                         "output shape and fails under jit; pass size= "
+                         "(+ fill_value) for a static shape"),
+                snippet=snippet_at(ctx.lines, node.lineno),
+            )
+
+    def _check_subscript(self, ctx, tf, node, bool_names):
+        sl = node.slice
+        is_mask = isinstance(sl, ast.Compare) and not all(
+            isinstance(op, (ast.Is, ast.IsNot)) for op in sl.ops
+        )
+        if not is_mask and isinstance(sl, ast.Name):
+            is_mask = sl.id in bool_names
+        if not is_mask and isinstance(sl, ast.UnaryOp) \
+                and isinstance(sl.op, ast.Invert):
+            inner = sl.operand
+            is_mask = isinstance(inner, ast.Compare) or (
+                isinstance(inner, ast.Name) and inner.id in bool_names)
+        if is_mask and ctx.expr_taints(node.value, tf.tracer_names):
+            yield Finding(
+                rule=self.id, path=ctx.path, line=node.lineno,
+                col=node.col_offset + 1,
+                message=("boolean-mask indexing has a data-dependent "
+                         "result shape and fails under jit; use "
+                         "jnp.where(mask, x, fill) or masked reductions"),
+                snippet=snippet_at(ctx.lines, node.lineno),
+            )

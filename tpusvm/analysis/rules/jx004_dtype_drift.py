@@ -1,0 +1,97 @@
+"""JX004 — dtype drift from implicit-dtype constructors and bare float
+literals.
+
+This codebase runs mixed precision on purpose (f32 features + f64
+accumulators, `resolve_accum_dtype`), and flips `jax_enable_x64`
+process-globally on first use — so ANY array constructor without an
+explicit dtype produces a different dtype depending on WHEN it runs
+relative to that flip, and a bare Python float literal materialised as an
+array is f32 before the flip and f64 after. The resulting drift is the
+exact failure class the round-1 STALLED livelock came from (updates below
+f32 resolution). Scope: everywhere inside traced functions, and the whole
+file on kernel paths (tpusvm/ops/, tpusvm/solver/, or files carrying the
+`# tpusvm: kernel-path` pragma).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tpusvm.analysis.core import Finding, snippet_at
+from tpusvm.analysis.registry import Rule, register
+
+# constructor -> 0-based positional index where dtype may be passed
+_SHAPE_CONSTRUCTORS = {
+    "zeros": 1, "ones": 1, "empty": 1, "full": 2,
+    "arange": 3, "linspace": 5, "eye": 3, "identity": 1,
+}
+_CONTENT_CONSTRUCTORS = {"array": 1, "asarray": 1}
+_NAMESPACES = ("jax.numpy.", "numpy.")
+
+
+def _contains_float_literal(node: ast.AST) -> bool:
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Constant) and isinstance(sub.value, float):
+            return True
+    return False
+
+
+@register
+class DtypeDrift(Rule):
+    id = "JX004"
+    summary = ("array constructor without explicit dtype= (or a bare "
+               "float literal materialised as an array) in a traced "
+               "function or kernel path")
+
+    def check(self, ctx):
+        if ctx.kernel_path:
+            nodes = [(n, "kernel path") for n in ast.walk(ctx.tree)
+                     if isinstance(n, ast.Call)]
+        else:
+            nodes = [(n, f"traced function {tf.name!r}")
+                     for tf in ctx.traced_functions
+                     for n in tf.own_nodes if isinstance(n, ast.Call)]
+        seen = set()
+        for node, where in nodes:
+            if id(node) in seen:
+                continue
+            seen.add(id(node))
+            finding = self._check_call(ctx, node, where)
+            if finding is not None:
+                yield finding
+
+    def _check_call(self, ctx, node, where):
+        resolved = ctx.resolve_call(node)
+        if not resolved or not resolved.startswith(_NAMESPACES):
+            return None
+        name = resolved.split(".")[-1]
+        has_dtype_kw = any(kw.arg == "dtype" for kw in node.keywords)
+        if name in _SHAPE_CONSTRUCTORS:
+            dtype_pos = _SHAPE_CONSTRUCTORS[name]
+            if has_dtype_kw or len(node.args) > dtype_pos:
+                return None
+            return Finding(
+                rule=self.id, path=ctx.path, line=node.lineno,
+                col=node.col_offset + 1,
+                message=(
+                    f"{name}() without an explicit dtype= in {where}: "
+                    "the produced dtype depends on the process-global "
+                    "jax_enable_x64 flip (resolve_accum_dtype) — pin it"
+                ),
+                snippet=snippet_at(ctx.lines, node.lineno),
+            )
+        if name in _CONTENT_CONSTRUCTORS and node.args:
+            if has_dtype_kw or len(node.args) > _CONTENT_CONSTRUCTORS[name]:
+                return None
+            if _contains_float_literal(node.args[0]):
+                return Finding(
+                    rule=self.id, path=ctx.path, line=node.lineno,
+                    col=node.col_offset + 1,
+                    message=(
+                        f"{name}() over a bare float literal in {where}: "
+                        "the literal is f32 before the jax_enable_x64 "
+                        "flip and f64 after — pass dtype= explicitly"
+                    ),
+                    snippet=snippet_at(ctx.lines, node.lineno),
+                )
+        return None

@@ -1,0 +1,116 @@
+"""JX005 — jitted function closes over a module-level ndarray.
+
+An ndarray captured by closure is embedded in the jaxpr as a CONSTANT:
+it is re-hashed on every dispatch, baked into the executable
+(constant-folding bloat at kernel-table sizes), and a rebind of the
+module global silently does NOT invalidate the compiled function — three
+different bugs from one innocuous-looking capture. Arrays belong in the
+function's arguments (donate/device_put as needed).
+
+Only DIRECT tracing entry points (decorated/wrapped jitted functions) are
+checked: nested traced functions closing over their parent's tracers is
+how lax control flow is written.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tpusvm.analysis.core import Finding, snippet_at
+from tpusvm.analysis.registry import Rule, register
+
+_ARRAY_PRODUCERS = ("numpy.", "jax.numpy.", "jax.random.")
+
+
+@register
+class ClosureCapture(Rule):
+    id = "JX005"
+    summary = ("jitted function closes over a module-level ndarray "
+               "(baked into the jaxpr as a constant; pass it as an "
+               "argument instead)")
+
+    def check(self, ctx):
+        module_arrays = self._module_array_bindings(ctx)
+        if not module_arrays:
+            return
+        for tf in ctx.traced_functions:
+            if tf.parent is not None:
+                continue  # nested traced fns legitimately capture tracers
+            local = self._local_bindings(tf)
+            reported = set()
+            for node in tf.own_nodes:
+                if not (isinstance(node, ast.Name)
+                        and isinstance(node.ctx, ast.Load)):
+                    continue
+                name = node.id
+                if (name in local or name in ctx.aliases
+                        or name not in module_arrays
+                        or name in reported):
+                    continue
+                reported.add(name)
+                yield Finding(
+                    rule=self.id, path=ctx.path, line=node.lineno,
+                    col=node.col_offset + 1,
+                    message=(
+                        f"traced function {tf.name!r} ({tf.reason}) "
+                        f"closes over module-level ndarray {name!r} "
+                        f"(built at line {module_arrays[name]}); the "
+                        "array is inlined as a compile-time constant — "
+                        "pass it as an argument"
+                    ),
+                    snippet=snippet_at(ctx.lines, node.lineno),
+                )
+
+    def _module_array_bindings(self, ctx):
+        """Module-level `NAME = <array-producing call>` bindings."""
+        out = {}
+        for stmt in ctx.tree.body:
+            if not isinstance(stmt, ast.Assign):
+                continue
+            value = stmt.value
+            if not isinstance(value, ast.Call):
+                continue
+            resolved = ctx.resolve_call(value)
+            if resolved and (resolved.startswith(_ARRAY_PRODUCERS)
+                             or resolved in ("numpy.load",
+                                             "numpy.loadtxt",
+                                             "numpy.genfromtxt")):
+                for t in stmt.targets:
+                    if isinstance(t, ast.Name):
+                        out[t.id] = stmt.lineno
+        return out
+
+    def _local_bindings(self, tf):
+        """Names bound inside the function (params + assignments)."""
+        args = tf.node.args
+        names = {p.arg for p in
+                 args.posonlyargs + args.args + args.kwonlyargs}
+        if args.vararg:
+            names.add(args.vararg.arg)
+        if args.kwarg:
+            names.add(args.kwarg.arg)
+        for node in tf.own_nodes:
+            if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign,
+                                 ast.NamedExpr, ast.For)):
+                targets = (node.targets if isinstance(node, ast.Assign)
+                           else [node.target])
+                for t in targets:
+                    for sub in ast.walk(t):
+                        if isinstance(sub, ast.Name):
+                            names.add(sub.id)
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                names.add(node.name)
+            elif isinstance(node, ast.comprehension):
+                for sub in ast.walk(node.target):
+                    if isinstance(sub, ast.Name):
+                        names.add(sub.id)
+            elif isinstance(node, ast.withitem) and node.optional_vars:
+                for sub in ast.walk(node.optional_vars):
+                    if isinstance(sub, ast.Name):
+                        names.add(sub.id)
+            elif isinstance(node, ast.ExceptHandler) and node.name:
+                names.add(node.name)
+            elif isinstance(node, (ast.Import, ast.ImportFrom)):
+                for a in node.names:
+                    names.add((a.asname or a.name).split(".", 1)[0])
+        return names

@@ -1,0 +1,75 @@
+"""JX006 — mutated module-global read inside a traced function.
+
+A module global that some function rebinds via `global NAME` is a
+trace-time constant everywhere it is read under jit: the traced function
+captures the value from the FIRST trace, and later mutations silently do
+nothing (or worse, leak into some retraces and not others, depending on
+cache keys). This is the static twin of the `benchmarks/midscale_parity`
+CFG bug (ADVICE r5): config must flow through arguments or static
+argnames, not through mutable module state.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tpusvm.analysis.core import Finding, snippet_at
+from tpusvm.analysis.registry import Rule, register
+
+
+@register
+class MutatedGlobalConfig(Rule):
+    id = "JX006"
+    summary = ("module global rebound via `global` is read inside a "
+               "traced function (captured once at trace time; thread it "
+               "through arguments)")
+
+    def check(self, ctx):
+        mutated = self._mutated_globals(ctx)
+        if not mutated:
+            return
+        for tf in ctx.traced_functions:
+            reported = set()
+            for node in tf.own_nodes:
+                if (isinstance(node, ast.Name)
+                        and isinstance(node.ctx, ast.Load)
+                        and node.id in mutated
+                        and node.id not in tf.tracer_names
+                        and node.id not in reported):
+                    reported.add(node.id)
+                    yield Finding(
+                        rule=self.id, path=ctx.path, line=node.lineno,
+                        col=node.col_offset + 1,
+                        message=(
+                            f"traced function {tf.name!r} reads module "
+                            f"global {node.id!r}, which is rebound via "
+                            f"`global` in {mutated[node.id]!r}; the value "
+                            "is frozen at first trace and later "
+                            "mutations are silently ignored — pass it as "
+                            "an argument or static argname"
+                        ),
+                        snippet=snippet_at(ctx.lines, node.lineno),
+                    )
+
+    def _mutated_globals(self, ctx):
+        """Names declared `global` AND assigned inside some function."""
+        mutated = {}
+        for fn in ast.walk(ctx.tree):
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            declared = set()
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Global):
+                    declared.update(node.names)
+            if not declared:
+                continue
+            for node in ast.walk(fn):
+                targets = []
+                if isinstance(node, ast.Assign):
+                    targets = node.targets
+                elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+                    targets = [node.target]
+                for t in targets:
+                    if isinstance(t, ast.Name) and t.id in declared:
+                        mutated.setdefault(t.id, fn.name)
+        return mutated

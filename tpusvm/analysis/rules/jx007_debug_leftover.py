@@ -1,0 +1,54 @@
+"""JX007 — leftover debugging hooks on kernel paths.
+
+`jax.debug.print` / `jax.debug.callback` insert host callbacks into the
+compiled program (a device->host round trip per call — catastrophic
+inside the solver's while_loop hot path), and `breakpoint()` /
+`pdb.set_trace()` hang non-interactive runs outright. Scope: kernel-path
+files (tpusvm/ops/, tpusvm/solver/, or the `# tpusvm: kernel-path`
+pragma), where these only ever appear as forgotten debugging.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tpusvm.analysis.core import Finding, snippet_at
+from tpusvm.analysis.registry import Rule, register
+
+_DEBUG_CALLS = {
+    "jax.debug.print",
+    "jax.debug.breakpoint",
+    "jax.debug.callback",
+    "pdb.set_trace",
+    "ipdb.set_trace",
+}
+
+
+@register
+class DebugLeftover(Rule):
+    id = "JX007"
+    summary = ("leftover jax.debug.print/breakpoint()/pdb on a kernel "
+               "path (host callback in the hot loop)")
+
+    def check(self, ctx):
+        if not ctx.kernel_path:
+            return
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            resolved = ctx.resolve_call(node)
+            is_breakpoint = (isinstance(node.func, ast.Name)
+                             and node.func.id == "breakpoint"
+                             and node.func.id not in ctx.aliases)
+            if resolved in _DEBUG_CALLS or is_breakpoint:
+                what = "breakpoint()" if is_breakpoint else resolved
+                yield Finding(
+                    rule=self.id, path=ctx.path, line=node.lineno,
+                    col=node.col_offset + 1,
+                    message=(
+                        f"leftover debug hook {what} on a kernel path; "
+                        "it inserts a host round-trip (or hangs "
+                        "non-interactive runs) — remove before shipping"
+                    ),
+                    snippet=snippet_at(ctx.lines, node.lineno),
+                )

@@ -1,0 +1,80 @@
+"""JX008 — pallas_* solver flags that the resolved config would ignore.
+
+blocked_smo_solve's pallas_* kwargs configure the Pallas inner engine;
+before round 6 an active flag combined with a non-pallas engine was
+SILENTLY ignored, so an A/B run could record `eta_exclude=true` while
+measuring the plain XLA engine (ADVICE r5). The solver now raises at
+trace time; this rule catches the same class STATICALLY at call sites
+where the conflict is visible as literals — before any hardware is
+burned on a mislabeled run.
+
+The flag-compatibility table is tpusvm.config.PALLAS_FLAG_RULES — one
+source of truth shared with the solver's runtime validation, so a new
+pallas_* flag added there is linted here for free.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tpusvm.analysis.core import Finding, snippet_at
+from tpusvm.analysis.registry import Rule, register
+from tpusvm.config import PALLAS_FLAG_RULES, pallas_flag_errors
+
+_TARGET = "blocked_smo_solve"
+
+
+def _const(call: ast.Call, kwarg: str):
+    """(present, constant_value_or_None) for a literal keyword argument."""
+    for kw in call.keywords:
+        if kw.arg == kwarg:
+            if isinstance(kw.value, ast.Constant):
+                return True, kw.value.value
+            return True, None
+    return False, None
+
+
+@register
+class PallasFlagCompat(Rule):
+    id = "JX008"
+    summary = ("active pallas_* flag at a call site whose literal "
+               "inner/wss config cannot honour it (flag-compatibility "
+               "table: tpusvm.config.PALLAS_FLAG_RULES)")
+
+    def check(self, ctx):
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            resolved = ctx.resolve_call(node)
+            if not resolved or not (
+                resolved == _TARGET or resolved.endswith("." + _TARGET)
+            ):
+                continue
+            flags = {}
+            for name in PALLAS_FLAG_RULES:
+                present, value = _const(node, name)
+                # only literal values can be judged statically; a flag
+                # fed from a variable is the runtime validation's job
+                if present and value is not None:
+                    flags[name] = value
+            if not flags:
+                continue
+            has_star_kwargs = any(kw.arg is None for kw in node.keywords)
+            _, inner = _const(node, "inner")
+            wss_present, wss = _const(node, "wss")
+            if not isinstance(wss, int):
+                # an omitted wss is the statically-known default (1) —
+                # unless a **kwargs expansion could be supplying it
+                wss = 1 if not wss_present and not has_star_kwargs else None
+            # inner unspecified/non-literal means 'auto' MAY resolve to
+            # pallas — no static verdict; only literal conflicts fire
+            for err in pallas_flag_errors(
+                inner if isinstance(inner, str) else None, wss, flags,
+            ):
+                yield Finding(
+                    rule=self.id, path=ctx.path, line=node.lineno,
+                    col=node.col_offset + 1,
+                    message=(f"{err} — blocked_smo_solve raises on this "
+                             "combination at trace time"),
+                    snippet=snippet_at(ctx.lines, node.lineno),
+                )

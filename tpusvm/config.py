@@ -150,6 +150,60 @@ def resolve_accum_dtype(accum_dtype):
     return accum_dtype
 
 
+# Flag-compatibility table for blocked_smo_solve's pallas_* kwargs — the
+# single source of truth shared by the solver's runtime validation
+# (tpusvm/solver/blocked.py) and the static linter's JX008 rule
+# (tpusvm/analysis/rules/jx008_pallas_flags.py). Each entry declares the
+# value at which the flag is inactive (its default) and what the resolved
+# solver config must look like for an ACTIVE value to take effect; an
+# active flag outside its requirements used to be silently ignored
+# (ADVICE.md round 5: an A/B run could record eta_exclude=true while
+# measuring the plain XLA engine), which is exactly the hazard class the
+# linter exists to catch. Keep this table in sync with the kwargs of
+# blocked_smo_solve — a new pallas_* flag MUST add a row here, which makes
+# both the runtime raise and the lint rule pick it up for free.
+PALLAS_FLAG_RULES = {
+    # vector layout inside the fused inner kernel
+    "pallas_layout": {"inactive": "packed", "requires_wss": None},
+    # degenerate-partner exclusion folded into the kernel's gain selection
+    # (second-order selection only)
+    "pallas_eta_exclude": {"inactive": False, "requires_wss": 2},
+    # batched slot-pair kernel (first-order selection only)
+    "pallas_multipair": {"inactive": 1, "requires_wss": 1},
+}
+
+
+def pallas_flag_errors(inner, wss, flags: dict) -> list:
+    """Error strings for active pallas_* flags the resolved config ignores.
+
+    `inner`/`wss` are the RESOLVED solver config (after 'auto' resolution);
+    pass None for a dimension the caller does not know — static analysis
+    calls this with only the literals it can see in a call site, the
+    solver calls it with both fully resolved. `flags` maps flag name ->
+    passed value for whichever PALLAS_FLAG_RULES keys the caller has.
+    """
+    errors = []
+    for name, spec in PALLAS_FLAG_RULES.items():
+        if name not in flags:
+            continue
+        value = flags[name]
+        if type(value) is type(spec["inactive"]) and value == spec["inactive"]:
+            continue
+        if inner is not None and inner != "pallas":
+            errors.append(
+                f"{name}={value!r} is a pallas-engine feature; the "
+                f"effective inner engine here is {inner!r} (inner='auto' "
+                "resolves to pallas only on TPU with lane-aligned q)"
+            )
+        elif (spec["requires_wss"] is not None and wss is not None
+                and wss != spec["requires_wss"]):
+            errors.append(
+                f"{name}={value!r} requires wss={spec['requires_wss']}, "
+                f"got wss={wss}"
+            )
+    return errors
+
+
 # Named dataset presets mirroring the reference's edit-in-place dataset switch
 # (main3.cpp:308-313): each maps to (C, gamma).
 DATASET_PRESETS = {

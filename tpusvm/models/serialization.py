@@ -58,6 +58,8 @@ def load_model(path: str):
             if key.startswith("config_"):
                 name = key[len("config_"):]
                 if name in cfg_fields:
+                    # host-side numpy .item() on an npz scalar, not a
+                    # device sync  # tpusvm: disable=JX002
                     val = z[key].item()
                     ftype = SVMConfig.__dataclass_fields__[name].type
                     cfg_kwargs[name] = int(val) if ftype == "int" else float(val)

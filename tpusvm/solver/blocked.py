@@ -42,6 +42,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from tpusvm.config import pallas_flag_errors
 from tpusvm.ops.rbf import rbf_cross, rbf_cross_matvec, rbf_matvec, sq_norms
 from tpusvm.ops.selection import i_high_mask, i_low_mask
 from tpusvm.solver.analytic import pair_update
@@ -407,6 +408,13 @@ def blocked_smo_solve(
     the (1, q) layout proven on hardware in round 1. Trajectories are
     bitwise identical; flat exists as a lowering fallback.
 
+    Any ACTIVE pallas_* flag whose resolved config cannot honour it
+    raises at trace time (previously only multipair; eta_exclude/layout
+    were silently ignored — ADVICE r5): the flag-compatibility table is
+    tpusvm.config.PALLAS_FLAG_RULES, shared with the static linter's
+    JX008 rule, so recorded A/B configs cannot claim a kernel variant
+    the run never executed.
+
     pallas_eta_exclude (static, wss=2 + pallas engine only): fold the XLA
     engine's degenerate-partner (eta <= eps) exclusion into the kernel's
     in-loop gain selection, unifying the two engines' selection rule
@@ -466,12 +474,19 @@ def blocked_smo_solve(
         raise ValueError(
             f"pallas_layout must be packed|flat, got {pallas_layout!r}"
         )
-    if pallas_multipair > 1 and inner != "pallas":
-        raise ValueError(
-            "pallas_multipair > 1 is a pallas-engine feature; the "
-            f"effective inner engine here is {inner!r} (inner='auto' "
-            "resolves to pallas only on TPU with lane-aligned q)"
-        )
+    # active pallas_* flags must reach the engine they configure: an
+    # explicitly-requested kernel variant silently measuring the plain XLA
+    # engine is a recorded-config lie (ADVICE r5 — pallas_eta_exclude=True
+    # on a CPU-pinned probe resolved to inner='xla' and was ignored). The
+    # flag-compatibility table lives in tpusvm.config, shared with the
+    # static linter's JX008 rule.
+    flag_errors = pallas_flag_errors(inner, wss, {
+        "pallas_layout": pallas_layout,
+        "pallas_eta_exclude": pallas_eta_exclude,
+        "pallas_multipair": pallas_multipair,
+    })
+    if flag_errors:
+        raise ValueError("; ".join(flag_errors))
     # fused=True + bf16 matmuls is rejected INSIDE resolve_fused_fupdate
     # (single source of truth; the fused contraction runs at the full-f32
     # trust-anchor tier and cannot honour matmul_precision='default')
