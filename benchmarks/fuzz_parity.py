@@ -47,6 +47,17 @@ the batched slot-pair kernel (pallas_multipair=2 at q=512, VERDICT r4
 #3) against the sequential kernel and the oracle. Engines run after the
 rng-driven instance generation, so the added engines preserve each
 mode's seed-for-seed instance contract.
+
+Round 6 addition: mode='pallas-mp-adv' — the multipair engines on an
+ADVERSARIAL derivation of the drawn instance (ADVICE r5 #4 geometry):
+rows reordered so the +/- labels form contiguous blocks (the outer
+working-set gather then tends to place the global pair's ends in
+different slot halves, the cross-slot case whose stale-b global step
+the round-6 glob_touched guard skips) and neighbouring rows duplicated
+in place to seed eta == 0 degenerate pairs — including contradictory-
+label duplicates at the block boundary, the hardest shrink-path food.
+A NEW mode rather than a change to 'pallas-mp', so the committed
+pallas-mp rows keep their seed-for-seed instance contract.
 """
 import json
 import os
@@ -128,7 +139,26 @@ MODES = {
     "pallas": (PALLAS_ENGINES, (160, 640), 128),
     "pallas-packed": (PALLAS_ENGINES, (288, 768), 256),
     "pallas-mp": (MP_ENGINES, (520, 900), 512),
+    # the ADVICE r5 #4 adversarial family (see module docstring): same
+    # engines/q as pallas-mp, instance derivation differs
+    "pallas-mp-adv": (MP_ENGINES, (520, 900), 512),
 }
+
+
+def _adversarialize(X, Y):
+    """Block-sort the labels and duplicate neighbouring rows in place.
+
+    Contiguous +/- label blocks steer the multipair kernel's global pair
+    ends into different slot halves (the cross-slot case of ADVICE r5
+    #4); pairwise-duplicated rows seed eta == 0 pairs for the shrink
+    path, including a contradictory-label duplicate at the block
+    boundary. Label counts and the rng stream are untouched.
+    """
+    order = np.argsort(-Y, kind="stable")
+    X, Y = X[order].copy(), Y[order]
+    half = X[1::2].shape[0]
+    X[1::2] = X[: 2 * half : 2]
+    return X, Y
 
 
 def engines_for(mode: str):
@@ -141,11 +171,17 @@ def run_case(seed: int, mode: str = "xla"):
     gen_name, n, X, Y, C, gamma = random_instance(
         rng, seed, n_range, (2, 24), [1.0, 10.0, 100.0],
         [0.125, 0.5, 2.0, 10.0])
+    adversarial = mode.endswith("-adv")
+    if adversarial:
+        # AFTER the rng draws: the derivation shares the base modes'
+        # instance stream without perturbing it
+        X, Y = _adversarialize(X, Y)
     Xs = MinMaxScaler().fit_transform(X)
     cfg = SVMConfig(C=C, gamma=gamma)
 
     o = smo_train(Xs, Y, cfg)
-    rec = {"seed": seed, "gen": gen_name, "n": n, "d": Xs.shape[1],
+    rec = {"seed": seed, "gen": gen_name, "adversarial": adversarial,
+           "n": n, "d": Xs.shape[1],
            "C": C, "gamma": round(gamma, 6),
            "oracle_status": Status(int(o.status)).name,
            "n_sv": int(len(get_sv_indices(o.alpha))),
@@ -154,7 +190,18 @@ def run_case(seed: int, mode: str = "xla"):
         # degenerate instance (the oracle itself bailed): skip, recorded
         rec["skipped"] = True
         return rec
-    sv_o = set(get_sv_indices(o.alpha).tolist())
+
+    def sv_set(alpha):
+        sv = get_sv_indices(np.asarray(alpha)).tolist()
+        if adversarial:
+            # rows (2k, 2k+1) are exact duplicates: the optimum only
+            # determines the SUM of a duplicate pair's alphas, so SV
+            # identity within a pair is degenerate — compare
+            # duplicate-GROUP membership, not raw indices
+            sv = {i - (i % 2) for i in sv}
+        return set(sv)
+
+    sv_o = sv_set(o.alpha)
 
     common = dict(C=cfg.C, gamma=cfg.gamma, eps=cfg.eps, tau=cfg.tau,
                   max_iter=cfg.max_iter, accum_dtype=jnp.float64)
@@ -172,13 +219,22 @@ def run_case(seed: int, mode: str = "xla"):
                 jnp.asarray(Xs, jnp.float32), jnp.asarray(Y),
                 q=q, max_inner=1024, max_outer=2000, inner=inner,
                 **opts, **common)
-        sv = set(get_sv_indices(np.asarray(r.alpha)).tolist())
+        sv = sv_set(r.alpha)
         sym = len(sv ^ sv_o)
         db = abs(float(r.b) - o.b)
         allowed = 0 if f64 else max(2, len(sv_o) // 25)
         # scale-aware b band (see module docstring); the f64 pair solver
         # is held to the absolute floor alone
-        b_band = 2e-3 if f64 else max(2e-3, 2e-4 * abs(o.b))
+        # adversarial instances widen the f32 relative term 5x: pairwise
+        # row duplication concentrates ~2x the alpha mass at the C bound
+        # (a duplicate pair shares the optimum's mass), and the f32
+        # engines' b noise scales with sum|alpha| (see module docstring) —
+        # measured 0.07% relative at seed 9107 (|b|~40, BOTH the
+        # sequential and multipair kernels, so it is precision, not the
+        # slot schedule), vs the 0.005-0.01% of the clean families. The
+        # f64 pair solver stays on the absolute floor either way.
+        rel = 1e-3 if adversarial else 2e-4
+        b_band = 2e-3 if f64 else max(2e-3, rel * abs(o.b))
         ok = (int(r.status) == Status.CONVERGED and sym <= allowed
               and db <= b_band)
         rec["engines"][name] = {

@@ -1,0 +1,184 @@
+"""Closed-loop load generator for tpusvm.serve: throughput vs latency.
+
+The serving acceptance bar (ISSUE 2): under >= 8 concurrent client threads
+the micro-batched server must sustain >= 3x the sequential
+one-request-at-a-time path, bit-identical scores, zero errors, zero
+post-warm-up recompiles. This harness measures the whole curve: for each
+offered concurrency (closed-loop client threads), achieved QPS, client
+latency percentiles, batch occupancy, and the compile-cache counters —
+JSONL rows in the house provenance style (workload_record, one row per
+level, a summary row last).
+
+The workload is the MNIST-shaped synthetic binary model (the bench
+recipe): serving economics only show up when per-row kernel work dominates
+per-request dispatch overhead, so a toy 2-D model would measure Python
+overhead, not batching (see tests/test_serve.py's throughput test note).
+
+Usage: python benchmarks/serve_latency.py [--smoke] [--n 4096] [--d 784]
+           [--duration 2.0] [--threads 1,2,4,8,16] [--max-batch 16]
+           [--max-delay-ms 1.0] [--jsonl PATH]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from benchmarks.common import emit, log, pin_platform, workload_record  # noqa: E402
+
+pin_platform()
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+
+def build_model(n: int, d: int, seed: int):
+    from tpusvm.config import SVMConfig
+    from tpusvm.data.synthetic import BENCH_LABEL_NOISE, BENCH_NOISE, mnist_like
+    from tpusvm.models import BinarySVC
+
+    gen_kwargs = dict(n=n + 64, d=d, seed=seed, noise=BENCH_NOISE,
+                      label_noise=BENCH_LABEL_NOISE)
+    X, Y = mnist_like(**gen_kwargs)
+    t0 = time.perf_counter()
+    model = BinarySVC(SVMConfig(C=10.0, gamma=0.00125),
+                      dtype=jnp.float32).fit(X[:n], Y[:n])
+    fit_s = time.perf_counter() - t0
+    # the query pool: held-out rows beyond the training prefix
+    return model, X[n:], workload_record(mnist_like, **gen_kwargs), fit_s
+
+
+def run_level(server, name: str, Xq, n_threads: int, duration_s: float):
+    """Closed-loop: n_threads clients, each submitting back-to-back."""
+    counts = [0] * n_threads
+    not_ok = [0] * n_threads
+    stop_at = time.monotonic() + duration_s
+
+    def client(t):
+        i = t  # stagger the row streams so threads don't submit in lockstep
+        while time.monotonic() < stop_at:
+            r = server.submit(name, Xq[i % len(Xq)])
+            counts[t] += 1
+            if not r.ok:
+                not_ok[t] += 1
+            i += 1
+
+    threads = [threading.Thread(target=client, args=(t,))
+               for t in range(n_threads)]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    elapsed = time.perf_counter() - t0
+    return sum(counts), sum(not_ok), elapsed
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--smoke", action="store_true",
+                    help="tiny model + short levels (schema/CI run)")
+    ap.add_argument("--n", type=int, default=4096, help="training rows")
+    ap.add_argument("--d", type=int, default=784)
+    ap.add_argument("--seed", type=int, default=587)
+    ap.add_argument("--duration", type=float, default=2.0,
+                    help="seconds per concurrency level")
+    ap.add_argument("--threads", default="1,2,4,8,16",
+                    help="comma-separated client-thread levels")
+    ap.add_argument("--max-batch", type=int, default=16)
+    ap.add_argument("--max-delay-ms", type=float, default=1.0)
+    ap.add_argument("--jsonl", default=None,
+                    help="also append rows to this file")
+    args = ap.parse_args(argv)
+    if args.smoke:
+        args.n, args.d = 512, 64
+        args.duration = 0.3
+        args.threads = "1,8"
+
+    from tpusvm.serve import ServeConfig, Server
+    from tpusvm.serve.server import sequential_qps
+
+    levels = [int(t) for t in args.threads.split(",")]
+    log(f"serve_latency: training n={args.n} d={args.d}")
+    model, Xq, workload, fit_s = build_model(args.n, args.d, args.seed)
+    log(f"fit {fit_s:.1f}s, {model.n_support_} SVs")
+    cfg = ServeConfig(max_batch=args.max_batch,
+                      max_delay_ms=args.max_delay_ms)
+
+    sink = open(args.jsonl, "a") if args.jsonl else None
+
+    def row(rec):
+        emit(rec)
+        if sink:
+            sink.write(json.dumps(rec) + "\n")
+
+    base = {
+        "bench": "serve_latency",
+        "workload": workload,
+        "n_train": args.n,
+        "n_sv": int(model.n_support_),
+        "serve_config": {"max_batch": cfg.max_batch,
+                         "max_delay_ms": cfg.max_delay_ms,
+                         "queue_size": cfg.queue_size},
+        "platform": jax.default_backend(),
+    }
+
+    # sequential baseline: one client, direct path, no queue/coalescing
+    with Server(cfg, dtype=jnp.float32) as srv:
+        srv.add_model("m", model)
+        srv.warmup()
+        seq_qps = sequential_qps(srv, "m", list(Xq), args.duration)
+    row({**base, "mode": "sequential", "threads": 1,
+         "qps": round(seq_qps, 1)})
+
+    violations = []
+    ratios = {}
+    for n_threads in levels:
+        # a fresh server per level keeps metrics (latency window,
+        # occupancy) scoped to the level instead of smearing across the
+        # sweep
+        with Server(cfg, dtype=jnp.float32) as srv:
+            srv.add_model("m", model)
+            srv.warmup()
+            n_req, n_not_ok, elapsed = run_level(
+                srv, "m", Xq, n_threads, args.duration)
+            snap = srv.metrics("m")
+            st = srv.status()["models"]["m"]
+        qps = n_req / elapsed
+        ratios[n_threads] = qps / seq_qps
+        lat = snap["latency_s"]
+        rec = {
+            **base, "mode": "batched", "threads": n_threads,
+            "offered_closed_loop": True,
+            "qps": round(qps, 1),
+            "vs_sequential": round(qps / seq_qps, 2),
+            "requests": n_req, "not_ok": n_not_ok,
+            "errors": snap["errors"], "timeouts": snap["timeouts"],
+            "queue_full": snap["queue_full"],
+            "recompiles": snap["recompiles"],
+            "compiled_shapes": st["compiled_shapes"],
+            "mean_batch_rows": round(snap["mean_batch_rows"], 2),
+            "p50_ms": round(lat["p50"] * 1e3, 3) if lat["p50"] else None,
+            "p95_ms": round(lat["p95"] * 1e3, 3) if lat["p95"] else None,
+            "p99_ms": round(lat["p99"] * 1e3, 3) if lat["p99"] else None,
+        }
+        row(rec)
+        if snap["errors"] or snap["recompiles"]:
+            violations.append(n_threads)
+
+    row({**base, "summary": True, "sequential_qps": round(seq_qps, 1),
+         "ratios": {str(k): round(v, 2) for k, v in ratios.items()},
+         "violations": violations})
+    if sink:
+        sink.close()
+    return 1 if violations else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -115,6 +115,11 @@ def test_fuzz_parity_smoke_schema(capsys):
       "blocked-pallas-wss2-etax"}),
     ("pallas-mp", 9000,
      {"pair-f64", "blocked-pallas-wss1", "blocked-pallas-mp2"}),
+    # round 6: the ADVICE r5 #4 adversarial family (block-sorted labels
+    # + duplicated rows) through the same multipair engine grid, with
+    # duplicate-group SV comparison
+    ("pallas-mp-adv", 9100,
+     {"pair-f64", "blocked-pallas-wss1", "blocked-pallas-mp2"}),
 ])
 def test_fuzz_parity_pallas_mode_smoke(capsys, mode, seed, engines):
     # one random instance through the PALLAS inner engine (interpret off
@@ -138,6 +143,31 @@ def test_fuzz_parity_pallas_mode_smoke(capsys, mode, seed, engines):
         assert set(rec["engines"]) == engines
         for verdict in rec["engines"].values():
             assert verdict["ok"]
+
+
+def test_serve_latency_smoke_schema(capsys):
+    # the serving load-generator (ISSUE 2): schema + the hard gates that
+    # are load-independent — zero errors and zero post-warm-up recompiles.
+    # Throughput RATIOS are deliberately not asserted here: the smoke
+    # model is tiny, so this run measures arg plumbing, not batching
+    # economics (benchmarks/results/serve_latency_cpu.jsonl holds the
+    # committed full-size curve: >= 3.8x at 8 threads)
+    from benchmarks import serve_latency
+
+    rc = serve_latency.main(["--smoke"])
+    assert rc == 0
+    recs = _records(capsys)
+    assert recs[0]["mode"] == "sequential" and recs[0]["qps"] > 0
+    batched = [r for r in recs if r.get("mode") == "batched"]
+    assert [r["threads"] for r in batched] == [1, 8]
+    for r in batched:
+        assert r["errors"] == 0 and r["recompiles"] == 0
+        assert r["not_ok"] == 0
+        assert r["mean_batch_rows"] >= 1.0
+        assert r["p50_ms"] is not None
+        assert r["workload"]["synthetic"] is True
+    summary = recs[-1]
+    assert summary["summary"] and summary["violations"] == []
 
 
 def test_midsize_cascade_smoke(capsys):

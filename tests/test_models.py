@@ -107,6 +107,55 @@ def test_save_without_suffix_roundtrips(tmp_path):
     np.testing.assert_array_equal(m2.predict(X[:10]), m.predict(X[:10]))
 
 
+def _retag_npz(src: str, dst: str, version):
+    """Rewrite an npz with format_version replaced (None = dropped)."""
+    with np.load(src, allow_pickle=False) as z:
+        arrays = {k: z[k] for k in z.files if k != "format_version"}
+    if version is not None:
+        arrays["format_version"] = np.asarray(version)
+    np.savez(dst, **arrays)
+
+
+@pytest.mark.parametrize("kind", ["binary", "ovr"])
+def test_model_format_version_roundtrip_and_rejection(tmp_path, kind):
+    """Served artifacts must be forward-checkable: the saved npz carries a
+    format_version, loads of the current version round-trip bitwise, and
+    missing/unknown versions fail with a specific error — not a KeyError
+    from whichever state field is read first."""
+    from tpusvm.models.serialization import _FORMAT_VERSION, load_model
+
+    if kind == "binary":
+        X, Y = rings(n=150, seed=6)
+        m = BinarySVC(CFG, dtype=jnp.float64).fit(X, Y)
+        cls = BinarySVC
+    else:
+        X, Y = _four_class_data(n=150, seed=6)
+        m = OneVsRestSVC(SVMConfig(C=10.0, gamma=2.0),
+                         dtype=jnp.float64).fit(X, Y)
+        cls = OneVsRestSVC
+    p = str(tmp_path / "m.npz")
+    m.save(p)
+    with np.load(p, allow_pickle=False) as z:
+        assert int(z["format_version"]) == _FORMAT_VERSION
+    m2 = cls.load(p, dtype=jnp.float64)
+    np.testing.assert_array_equal(
+        m2.decision_function(X[:20]), m.decision_function(X[:20]))
+
+    unknown = str(tmp_path / "unknown.npz")
+    _retag_npz(p, unknown, version=_FORMAT_VERSION + 98)
+    with pytest.raises(ValueError, match="unsupported model format version"):
+        load_model(unknown)
+    with pytest.raises(ValueError, match="unsupported model format version"):
+        cls.load(unknown)
+
+    missing = str(tmp_path / "missing.npz")
+    _retag_npz(p, missing, version=None)
+    with pytest.raises(ValueError, match="no format_version field"):
+        load_model(missing)
+    with pytest.raises(ValueError, match="no format_version field"):
+        cls.load(missing)
+
+
 def test_fit_warns_on_non_convergence():
     import warnings as w
     X, Y = rings(n=200, seed=8)

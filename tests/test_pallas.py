@@ -270,6 +270,66 @@ def test_blocked_multipair_matches_xla_solution():
     )
 
 
+def test_inner_smo_multipair_cross_slot_global_ends():
+    """ADVICE r5 #4 adversarial case: the global pair's ends live in
+    DIFFERENT slots whose updates touch them in the same iteration.
+
+    Construction (q=512, p=2 -> 4 packed rows, one per slot-half): labels
+    +1 on [0, 384) and -1 on [384, 512) with a0 = 0 and f0 = -y put the
+    globally-worst I_high member at index 0 (slot 0's high row) and the
+    globally-worst I_low member at index 384 (slot 1's low row). Slot 0's
+    low row [256, 384) is all-positive with a = 0 — not in I_low — so
+    slot 0 idles while slot 1 pairs its own high row with index 384:
+    the global pair's low end is touched by a slot, the high end is not,
+    and the pre-fix kernel then applied the global step with
+    iteration-start b_h/b_l against the post-slot alpha at 384 — a
+    box-clipped but potentially non-ascent step that inflates the update
+    count. Post-fix the global step is skipped on such iterations, and
+    the invariants below must hold with the update count comparable to
+    the sequential kernel's, never spinning toward the 40k cap.
+
+    Duplicated points additionally seed degenerate (eta == 0) pairs, the
+    shrink path's adversarial food (fuzz-seed-4047 class)."""
+    q, d, p = 512, 6, 2
+    rng = np.random.default_rng(4047)
+    Xb = rng.random((q // 2, d)).astype(np.float32)
+    X = np.repeat(Xb, 2, axis=0)  # exact duplicates -> eta == 0 pairs
+    y = np.where(np.arange(q) < 384, 1, -1).astype(np.int32)
+    K = rbf_cross(jnp.asarray(X), jnp.asarray(X), 0.5)
+    a0 = jnp.zeros(q, jnp.float32)
+    f0 = -jnp.asarray(y, jnp.float32)
+    act = jnp.ones(q, bool)
+    C = 10.0
+    a_m, n_m, prog, r_m = inner_smo_pallas(
+        K, jnp.asarray(y), a0, f0, act, C, 1e-12, 1e-5, max_inner=40000,
+        interpret=True, multipair=p)
+    a_m = np.asarray(a_m)
+    assert bool(prog)
+    assert np.isfinite(a_m).all()
+    assert (a_m >= -5e-6).all() and (a_m <= C + 5e-6).all()
+    np.testing.assert_allclose(float(np.sum(a_m * y)), 0.0, atol=1e-3)
+    assert int(r_m) in (
+        Status.CONVERGED, Status.NO_WORKING_SET, Status.MAX_ITER
+    )
+    # the sequential kernel on the same subproblem: the multipair
+    # trajectory may legitimately cost more updates (Jacobi slots), but
+    # the pre-fix non-ascent global steps inflated it toward the cap —
+    # bound it at a small multiple, far below max_inner
+    a_1, n_1, _, _ = inner_smo_pallas(
+        K, jnp.asarray(y), a0, f0, act, C, 1e-12, 1e-5, max_inner=40000,
+        interpret=True)
+    assert int(n_m) < 6 * int(n_1), (int(n_m), int(n_1))
+    assert int(n_m) < 40000  # never rides the budget cap
+    Q = np.asarray(K) * np.outer(y, y)
+
+    def dual(a):
+        a = np.asarray(a)
+        return a.sum() - 0.5 * a @ Q @ a
+
+    assert dual(a_m) > 0.1
+    np.testing.assert_allclose(dual(a_m), dual(a_1), rtol=5e-2)
+
+
 def test_inner_smo_multipair_validation():
     K, y, a0, f0, act = _subproblem(q=256, seed=2)
     with pytest.raises(ValueError, match="multipair requires wss=1"):

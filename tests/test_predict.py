@@ -1,0 +1,75 @@
+"""Blocked decision_function edge coverage: bucket-padded serving safety.
+
+The serving subsystem (tpusvm.serve) pads coalesced batches to power-of-two
+row buckets and promises scores BIT-IDENTICAL to a direct decision_function
+call on the same rows. That promise rests on per-row independence of the
+blocked evaluator: each test row's score is its own K-row dot product, so
+neither the scan blocking (m % block != 0, block > m, block == m) nor
+zero-row padding may perturb any real row's bits. These tests pin that down
+against the unblocked single-matmul evaluation (decision_function_flat).
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpusvm.solver.predict import decision_function, decision_function_flat
+
+
+def _problem(m=100, n=256, d=16, dtype=jnp.float32, seed=0):
+    rng = np.random.default_rng(seed)
+    X_test = jnp.asarray(rng.random((m, d)), dtype)
+    X_train = jnp.asarray(rng.random((n, d)), dtype)
+    coef = jnp.asarray(rng.normal(size=n), dtype)
+    b = jnp.asarray(0.25, dtype)
+    return X_test, X_train, coef, b
+
+
+@pytest.mark.parametrize("dtype", [jnp.float32, jnp.float64])
+@pytest.mark.parametrize("block", [
+    32,    # m % block != 0 (100 = 3*32 + 4: padded final block)
+    7,     # m % block != 0 with a non-power-of-two block
+    256,   # block > m (whole set in one padded block)
+    100,   # block == m (exact fit, no padding)
+])
+def test_blocked_decisions_bit_identical_to_flat(block, dtype):
+    m = 100
+    X_test, X_train, coef, b = _problem(m=m, dtype=dtype)
+    flat = np.asarray(decision_function_flat(
+        X_test, X_train, coef, b, gamma=0.5))
+    blocked = np.asarray(decision_function(
+        X_test, X_train, coef, b, gamma=0.5, block=block))
+    assert blocked.shape == (m,)
+    np.testing.assert_array_equal(blocked, flat)
+
+
+@pytest.mark.parametrize("m", [1, 2, 3, 8, 33])
+def test_decisions_independent_of_batch_size(m):
+    """A row's score must not depend on how many rows ride the batch —
+    THE property that makes bucket-padded serving exact: serving computes
+    a (bucket, n) program over padded rows, a direct call computes
+    (m, n), and the real rows must agree bitwise either way."""
+    X_test, X_train, coef, b = _problem(m=64)
+    full = np.asarray(decision_function(
+        X_test, X_train, coef, b, gamma=0.5))
+    prefix = np.asarray(decision_function(
+        X_test[:m], X_train, coef, b, gamma=0.5))
+    np.testing.assert_array_equal(prefix, full[:m])
+    # zero-row padding, the serve bucket layout: real rows first, zero
+    # rows after — slicing the reals must recover the unpadded scores
+    Xp = jnp.concatenate([X_test[:m], jnp.zeros_like(X_test[: 8 - m % 8])])
+    padded = np.asarray(decision_function(
+        Xp, X_train, coef, b, gamma=0.5))
+    np.testing.assert_array_equal(padded[:m], full[:m])
+
+
+def test_single_row_matches_full_evaluation():
+    """The m=1 bucket (a lone request on an idle server) is the extreme
+    padding case: one real row in a block-sized program."""
+    X_test, X_train, coef, b = _problem(m=16)
+    full = np.asarray(decision_function_flat(
+        X_test, X_train, coef, b, gamma=0.5))
+    for i in range(4):
+        one = np.asarray(decision_function(
+            X_test[i:i + 1], X_train, coef, b, gamma=0.5))
+        np.testing.assert_array_equal(one, full[i:i + 1])

@@ -186,6 +186,38 @@ def _build_parser() -> argparse.ArgumentParser:
                     help="shard the test rows over the local device mesh "
                     "(zero-collective sharded serving)")
 
+    sv = sub.add_parser(
+        "serve", parents=[common],
+        help="serve saved models over HTTP with deadline-aware "
+        "micro-batching (tpusvm.serve)")
+    sv.add_argument("--model", action="append", required=True,
+                    metavar="[NAME=]NPZ", dest="models",
+                    help="model to host, repeatable; NAME defaults to the "
+                    "file stem (binary vs multiclass auto-detected)")
+    sv.add_argument("--host", default="127.0.0.1")
+    sv.add_argument("--port", type=int, default=8471,
+                    help="HTTP port (0 = ephemeral; default 8471)")
+    sv.add_argument("--max-batch", type=int, default=64,
+                    help="micro-batch coalescing cap = largest pad bucket")
+    sv.add_argument("--max-delay-ms", type=float, default=2.0,
+                    help="max latency added waiting for batch co-riders")
+    sv.add_argument("--queue-size", type=int, default=1024,
+                    help="backpressure bound; full queue fast-fails")
+    sv.add_argument("--timeout-ms", type=float, default=1000.0,
+                    help="default per-request deadline")
+    sv.add_argument("--dtype", choices=["float32", "float64"],
+                    default="float32", help="serving compute dtype")
+    sv.add_argument("--no-warmup", action="store_true",
+                    help="skip AOT-compiling the bucket executables (first "
+                    "request per bucket then pays the compile)")
+    sv.add_argument("--smoke", action="store_true",
+                    help="no HTTP: warm up, fire concurrent in-process "
+                    "requests, print metrics, exit non-zero on any error "
+                    "or post-warm-up recompile (the CI gate)")
+    sv.add_argument("--smoke-threads", type=int, default=8)
+    sv.add_argument("--smoke-requests", type=int, default=32,
+                    help="requests per smoke thread")
+
     sub.add_parser("info", parents=[common],
                    help="print device / backend information")
     return p
@@ -475,6 +507,100 @@ def _cmd_predict(args) -> int:
     return 0
 
 
+def _cmd_serve(args) -> int:
+    import json
+    import os
+
+    import jax.numpy as jnp
+
+    from tpusvm.serve import ServeConfig, Server
+
+    cfg = ServeConfig(
+        max_batch=args.max_batch,
+        max_delay_ms=args.max_delay_ms,
+        queue_size=args.queue_size,
+        timeout_ms=args.timeout_ms,
+    )
+    server = Server(cfg, dtype=getattr(jnp, args.dtype))
+    for spec in args.models:
+        name, sep, path = spec.partition("=")
+        if not sep:
+            name, path = "", spec
+        if not name:
+            name = os.path.splitext(os.path.basename(path))[0]
+        entry = server.load_model(name, path)
+        print(f"loaded {name}: {entry.kind}, {entry.n_sv} SVs, "
+              f"{entry.n_features} features")
+    if not args.no_warmup:
+        for name, n in server.warmup().items():
+            print(f"warmed {name}: {n} bucket executables compiled")
+
+    if args.smoke:
+        rc = _serve_smoke(server, args.smoke_threads, args.smoke_requests)
+        print(server.metrics_text(), end="")
+        server.close()
+        return rc
+
+    from tpusvm.serve.http import make_http_server
+
+    httpd = make_http_server(server, host=args.host, port=args.port)
+    host, port = httpd.server_address[:2]
+    print(f"serving on http://{host}:{port} "
+          f"(POST /v1/models/<name>:predict, GET /metrics)")
+    try:
+        httpd.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        httpd.shutdown()
+        print(server.metrics_text(), end="")
+        print(json.dumps(server.status()))
+        server.close()
+    return 0
+
+
+def _serve_smoke(server, n_threads: int, n_requests: int) -> int:
+    """Concurrent in-process exercise of every hosted model: the CI gate
+    asserts zero errors and zero post-warm-up recompiles."""
+    import threading
+
+    import numpy as np
+
+    failures = []
+    for name in server.registry.names():
+        entry = server.registry.get(name)
+        rng = np.random.default_rng(0)
+        rows = rng.random((n_threads * n_requests, entry.n_features))
+        bad = []
+        lock = threading.Lock()
+
+        def run(t, name=name, rows=rows, bad=bad, lock=lock):
+            for i in range(n_requests):
+                r = server.submit(name, rows[t * n_requests + i])
+                if not r.ok:
+                    with lock:
+                        bad.append(r.status)
+
+        threads = [threading.Thread(target=run, args=(t,))
+                   for t in range(n_threads)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        snap = server.metrics(name)
+        if bad or snap["errors"] or snap["recompiles"]:
+            failures.append((name, bad, snap["errors"], snap["recompiles"]))
+        print(f"smoke {name}: {snap['ok']} ok, {snap['errors']} errors, "
+              f"{snap['recompiles']} recompiles, mean batch rows "
+              f"{snap['mean_batch_rows']:.2f}")
+    if failures:
+        for name, bad, errors, recompiles in failures:
+            print(f"SMOKE FAILED {name}: statuses={bad} errors={errors} "
+                  f"recompiles={recompiles}")
+        return 1
+    return 0
+
+
 def _cmd_info(args) -> int:
     import jax
 
@@ -519,9 +645,8 @@ def main(argv=None) -> int:
         if args.process_id is not None:
             kw["process_id"] = args.process_id
         jax.distributed.initialize(**kw)
-    return {"train": _cmd_train, "predict": _cmd_predict, "info": _cmd_info}[
-        args.command
-    ](args)
+    return {"train": _cmd_train, "predict": _cmd_predict,
+            "serve": _cmd_serve, "info": _cmd_info}[args.command](args)
 
 
 if __name__ == "__main__":

@@ -44,11 +44,28 @@ def save_model(path: str, state: Dict[str, Any], config: SVMConfig) -> None:
 
 
 def load_model(path: str):
-    """Returns (state dict, SVMConfig)."""
+    """Returns (state dict, SVMConfig).
+
+    Version gate first: artifacts that will be served long after they were
+    trained must fail loudly and specifically — a missing field means "not
+    a tpusvm model" (or one predating versioning), an unknown version means
+    "written by a different tpusvm"; neither may surface as a KeyError from
+    whichever state field happens to be read first.
+    """
     with np.load(_norm(path), allow_pickle=False) as z:
+        if "format_version" not in z.files:
+            raise ValueError(
+                f"{_norm(path)!r} has no format_version field — not a "
+                "tpusvm model artifact (or written before format "
+                "versioning; retrain and re-save it)"
+            )
         version = int(z["format_version"])
         if version != _FORMAT_VERSION:
-            raise ValueError(f"unsupported model format version {version}")
+            raise ValueError(
+                f"unsupported model format version {version} in "
+                f"{_norm(path)!r}: this build reads version "
+                f"{_FORMAT_VERSION}"
+            )
         cfg_fields = {f.name for f in dataclasses.fields(SVMConfig)}
         cfg_kwargs = {}
         state = {}

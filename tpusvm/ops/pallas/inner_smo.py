@@ -392,7 +392,7 @@ def _make_multipair_kernel(q: int, max_inner: int, p: int, R: int, L: int):
             n_ok = jnp.int32(0)
             n_dead = jnp.int32(0)
             new_act = act_f
-            glob_taken = jnp.bool_(False)
+            glob_touched = jnp.bool_(False)
             for s in range(p):
                 ih_s, il_s, bh_s, bl_s, ok_s = slot[s]
                 row_h = K_ref[pl.ds(ih_s, 1)].reshape(R, L)
@@ -415,18 +415,26 @@ def _make_multipair_kernel(q: int, max_inner: int, p: int, R: int, L: int):
                 a_s_ref[il_s] = a_l + upd.da_l
                 ok = upd.do_update & ~upd.stalled
                 n_ok = n_ok + ok.astype(jnp.int32)
-                # when the globally-worst pair lies entirely inside this
-                # slot (identical min-index tie-breaks -> the slot picks
-                # exactly it) and the slot's update went through, the
-                # global step below must not re-apply the SAME analytic
-                # delta from its stale b_h/b_l — a second application
-                # walks a_l to 2*delta, the zero-gain point of the
-                # pair's dual parabola, and double-counts n_upd
-                # gate on ok (not do_update): a STALLED slot take must
-                # still let the global step re-diagnose the pair so the
-                # fresh-f shrink below can retire it
-                glob_taken = glob_taken | (
-                    (ih_s == i_hg) & (il_s == i_lg) & ok)
+                # the global step below must not run against alphas a slot
+                # moved THIS iteration (ADVICE r5 #4): its b_h/b_l are
+                # iteration-start values, so
+                #   - a slot that took exactly the global pair would see
+                #     the SAME analytic delta re-applied — a_l walks to
+                #     2*delta, the zero-gain point of the pair's dual
+                #     parabola, and n_upd double-counts;
+                #   - a slot that moved EITHER end (the cross-slot case:
+                #     i_hg in one slot's rows, i_lg in another's) leaves
+                #     the global step a box-clipped but potentially
+                #     non-ascent step — transient dual decrease and
+                #     inflated update counts on adversarial data.
+                # Track any applied slot update overlapping a global end;
+                # gate on ok (not do_update): a STALLED slot take leaves
+                # alphas unmoved, and the global step must still
+                # re-diagnose the pair so the fresh-f shrink below can
+                # retire it
+                glob_touched = glob_touched | (
+                    ((ih_s == i_hg) | (il_s == i_hg)
+                     | (ih_s == i_lg) | (il_s == i_lg)) & ok)
                 # slots NEVER shrink: a slot's dead diagnosis is made
                 # against intra-iteration-stale f (other slots' deltas
                 # land simultaneously), and shrinking on it falsely
@@ -451,10 +459,16 @@ def _make_multipair_kernel(q: int, max_inner: int, p: int, R: int, L: int):
             # Gauss-Seidel after the slots (alpha mirror reads happen
             # post-slot-writes, so a coincidence with a slot index sees
             # the current value and the combined deltas stay box-clipped
-            # and sum(y*a)-conserving; only its b_h/b_l are one slot
-            # phase stale, bounded by the clips). Skipped when a slot
-            # already took exactly this pair's step (glob_taken).
-            glob_go = proceed & ~glob_taken
+            # and sum(y*a)-conserving). Skipped whenever a slot's APPLIED
+            # update touched either global end this iteration
+            # (glob_touched, ADVICE r5 #4): against post-slot alphas the
+            # iteration-start b_h/b_l would make this a box-clipped but
+            # potentially non-ascent step (transient dual decrease,
+            # inflated update counts on adversarial data). Termination is
+            # unaffected: if every slot idled nothing was touched and the
+            # step (or the fresh-f shrink) still fires; if a slot
+            # updated, the iteration already made progress.
+            glob_go = proceed & ~glob_touched
             row_hg = K_ref[pl.ds(i_hg, 1)].reshape(R, L)
             row_lg = K_ref[pl.ds(i_lg, 1)].reshape(R, L)
             K12g = pick(row_hg, i_lg)

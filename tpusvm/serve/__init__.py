@@ -1,0 +1,48 @@
+"""tpusvm.serve — batched online inference over trained SVM models.
+
+The training side reproduces the reference's offline pipeline; this package
+is the ROADMAP's serving leg: the path from a serialized model to
+low-latency predictions under concurrent load. Design (the adaptive-batching
+shape popularized by Clipper, Crankshaw et al. NSDI 2017 — PAPERS.md — on
+top of the repo's own predict kernels):
+
+  registry.py   load + pin: models come off disk once, their SV/coef/b
+                arrays live on device for the server's lifetime
+  batcher.py    deadline-aware micro-batching: single-row requests coalesce
+                into batches under a max-latency budget, with a bounded
+                queue (fast-fail backpressure) and per-request timeouts
+  buckets.py    bucketed compile cache: batches pad to power-of-two row
+                buckets so each (model, bucket) compiles exactly once —
+                AOT-compiled executables, warm-up API, recompile counter
+  metrics.py    request/error/timeout counters, batch-occupancy histogram,
+                latency percentiles; JSON + plaintext /metrics dumps
+  server.py     the in-process frontend: Server.submit()/submit_many()
+  http.py       stdlib-only JSON-over-HTTP endpoint (`tpusvm serve`)
+
+Correctness contract: a served score is BIT-IDENTICAL to a direct
+decision_function call on the same rows — per-row scores are independent of
+the surrounding batch (each row's K-row feeds its own dot product).
+tests/test_predict.py proves it across block/padding geometries; the two
+degenerate row counts where XLA's CPU dot kernels drift by ~1 ulp are
+engineered out by bucket floors (buckets.py: binary pads lone requests to
+2-row programs, OVR to 4).
+"""
+
+from tpusvm.serve.batcher import MicroBatcher, ServeResult
+from tpusvm.serve.buckets import CompileCache, bucket_for, default_buckets
+from tpusvm.serve.metrics import Metrics
+from tpusvm.serve.registry import ModelEntry, ModelRegistry
+from tpusvm.serve.server import ServeConfig, Server
+
+__all__ = [
+    "CompileCache",
+    "Metrics",
+    "MicroBatcher",
+    "ModelEntry",
+    "ModelRegistry",
+    "ServeConfig",
+    "ServeResult",
+    "Server",
+    "bucket_for",
+    "default_buckets",
+]

@@ -1,0 +1,150 @@
+"""Bucketed compile cache: pad batches to power-of-two row buckets.
+
+A jit-compiled scorer keyed on exact batch shape would recompile for every
+distinct coalesced batch size the micro-batcher happens to form — up to
+max_batch executables per model, each compile a multi-ms stall in the
+serving hot path. Padding every batch up to its power-of-two bucket caps
+the shape universe at len(buckets) ~ log2(max_batch)+1 shapes per model,
+all compiled AHEAD OF TIME by warmup(); steady state then never compiles.
+
+Padding is safe because per-row scores are independent of the surrounding
+batch (each padded row contributes only garbage rows that get sliced off —
+tests/test_predict.py proves bit-identity across every block/padding
+geometry). Executables are built with .lower().compile() rather than
+relying on jax's internal jit cache, so COMPILES ARE OBSERVABLE: the cache
+counts them, and compiles after warm-up surface as the `recompiles` metric
+(steady-state target: 0).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Sequence, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from tpusvm.models.ovr import _ovr_scores
+from tpusvm.serve.registry import ModelEntry
+from tpusvm.solver.predict import decision_function
+
+
+def default_buckets(max_batch: int) -> Tuple[int, ...]:
+    """Power-of-two row buckets 1, 2, 4, ... covering max_batch."""
+    if max_batch < 1:
+        raise ValueError(f"max_batch must be >= 1, got {max_batch}")
+    buckets = []
+    b = 1
+    while b < max_batch:
+        buckets.append(b)
+        b *= 2
+    buckets.append(max_batch)
+    return tuple(buckets)
+
+
+def bucket_for(m: int, buckets: Sequence[int]) -> int:
+    """Smallest bucket that fits m rows."""
+    for b in buckets:
+        if m <= b:
+            return b
+    raise ValueError(f"batch of {m} rows exceeds the largest bucket "
+                     f"{max(buckets)}")
+
+
+# Bucket floors, the price of the bit-identity contract on the CPU
+# backend: XLA dispatches DIFFERENT dot kernels at degenerate row counts,
+# with ~1-ulp contraction-order drift against the vectorized kernel every
+# other geometry shares. Measured (tests/test_serve.py, test_predict.py):
+#   - binary (matvec K(m,n) @ coef): only the m == 1 program drifts —
+#     floor 2, so a lone request pads to a 2-row program;
+#   - ovr (gemm K(m,n) @ coef.T): programs below 4 rows drift — floor 4;
+#     every power-of-two bucket >= 4 is mutually identical and matches
+#     direct multiple-of-4-row calls bitwise.
+# The padding cost is one or three zero rows on an idle server — noise.
+_MIN_BUCKET = {"binary": 2, "ovr": 4}
+
+
+class CompileCache:
+    """(bucket -> AOT-compiled scorer) for one model, with compile counts."""
+
+    def __init__(self, entry: ModelEntry, buckets: Sequence[int],
+                 block: int = 2048):
+        self.entry = entry
+        floor = _MIN_BUCKET[entry.kind]
+        self.buckets = tuple(sorted({max(int(b), floor) for b in buckets}))
+        # the binary scorer's scan block; bucket rows pad up to one block
+        # internally, which does not change per-row scores (bit-identity)
+        self.block = block
+        self._compiled: Dict[int, object] = {}
+        self._lock = threading.Lock()
+        self.compiles = 0          # total executable builds
+        self.recompiles = 0        # builds AFTER warm-up completed
+        self.warmed = False
+
+    # ------------------------------------------------------------ compile
+    def _build(self, bucket: int):
+        e = self.entry
+        Xz = jnp.zeros((bucket, e.n_features), e.dtype)
+        if e.kind == "binary":
+            # block capped at the bucket: decision_function pads m up to a
+            # block multiple internally, so block=2048 would make a 1-row
+            # bucket compute 2048 rows of kernel (measured 7x throughput
+            # loss); any block yields bit-identical per-row scores
+            # (tests/test_predict.py), so the cap is free
+            lowered = decision_function.lower(
+                Xz, e.X_sv, e.coef, e.b, gamma=e.config.gamma,
+                block=min(self.block, bucket))
+        else:
+            gamma = jnp.asarray(e.config.gamma, e.dtype)
+            lowered = _ovr_scores.lower(Xz, e.X_sv, e.coef, e.b, gamma)
+        return lowered.compile()
+
+    def _get(self, bucket: int):
+        with self._lock:
+            fn = self._compiled.get(bucket)
+            if fn is None:
+                fn = self._build(bucket)
+                self._compiled[bucket] = fn
+                self.compiles += 1
+                if self.warmed:
+                    self.recompiles += 1
+            return fn
+
+    def warmup(self) -> int:
+        """Compile every bucket; returns how many were newly built.
+
+        Idempotent: a second call builds nothing and keeps `warmed` set, so
+        the recompile counter keeps meaning "compiles the warm-up missed".
+        """
+        before = self.compiles
+        for b in self.buckets:
+            self._get(b)
+        self.warmed = True
+        return self.compiles - before
+
+    @property
+    def compiled_shapes(self) -> int:
+        with self._lock:
+            return len(self._compiled)
+
+    # -------------------------------------------------------------- score
+    def scores(self, X: np.ndarray) -> Tuple[np.ndarray, int]:
+        """Scores for the m rows of X via the padded bucket executable.
+
+        X must already be scaled and of the entry's dtype/width. Returns
+        (scores for the real rows, bucket used). Binary: (m,); ovr: (m, K).
+        """
+        m = X.shape[0]
+        bucket = bucket_for(m, self.buckets)
+        e = self.entry
+        # the pad buffer is built in the model dtype: the assignment casts
+        # the f64-scaled rows exactly like the offline path's device upload
+        Xp = np.zeros((bucket, X.shape[1]), np.dtype(jnp.dtype(e.dtype)))
+        Xp[:m] = X
+        fn = self._get(bucket)
+        if e.kind == "binary":
+            out = fn(jnp.asarray(Xp), e.X_sv, e.coef, e.b)
+        else:
+            gamma = jnp.asarray(e.config.gamma, e.dtype)
+            out = fn(jnp.asarray(Xp), e.X_sv, e.coef, e.b, gamma)
+        return np.asarray(out)[:m], bucket

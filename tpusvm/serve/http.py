@@ -1,0 +1,132 @@
+"""Stdlib-only JSON-over-HTTP frontend for the serving subsystem.
+
+http.server.ThreadingHTTPServer gives one handler thread per connection;
+handler threads block in Server.submit_many(), so concurrent HTTP clients'
+rows coalesce in the micro-batcher exactly like in-process callers — the
+HTTP layer adds no batching logic of its own. No third-party dependencies
+(the container bans installs; stdlib is the point).
+
+Routes:
+  GET  /healthz                   {"status": "ok"}
+  GET  /v1/models                 hosted-model summaries (Server.status())
+  GET  /v1/models/<name>/metrics  one model's metrics JSON
+  GET  /metrics                   plaintext metrics for every model
+  POST /v1/models/<name>:predict  {"instances": [[...], ...]}
+                                  -> {"predictions": [...], "scores": [...],
+                                      "statuses": [...]}
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+
+from tpusvm.status import ServeStatus
+
+
+class _Handler(BaseHTTPRequestHandler):
+    # the Server instance is attached to the HTTP server object
+    protocol_version = "HTTP/1.1"
+
+    @property
+    def _srv(self):
+        return self.server.tpusvm_server
+
+    def log_message(self, fmt, *args):  # quiet by default
+        if getattr(self.server, "verbose", False):
+            super().log_message(fmt, *args)
+
+    def _send(self, code: int, body: bytes, ctype: str) -> None:
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _send_json(self, obj, code: int = 200) -> None:
+        self._send(code, json.dumps(obj).encode(), "application/json")
+
+    def do_GET(self):  # noqa: N802 — BaseHTTPRequestHandler API
+        if self.path == "/healthz":
+            self._send_json({"status": "ok"})
+        elif self.path == "/metrics":
+            self._send(200, self._srv.metrics_text().encode(),
+                       "text/plain; version=0.0.4")
+        elif self.path == "/v1/models":
+            self._send_json(self._srv.status())
+        elif self.path.startswith("/v1/models/") and self.path.endswith("/metrics"):
+            name = self.path[len("/v1/models/"):-len("/metrics")]
+            try:
+                self._send_json(self._srv.metrics(name))
+            except KeyError as e:
+                self._send_json({"error": str(e)}, code=404)
+        else:
+            self._send_json({"error": f"no route {self.path}"}, code=404)
+
+    def do_POST(self):  # noqa: N802 — BaseHTTPRequestHandler API
+        if not (self.path.startswith("/v1/models/")
+                and self.path.endswith(":predict")):
+            self._send_json({"error": f"no route {self.path}"}, code=404)
+            return
+        name = self.path[len("/v1/models/"):-len(":predict")]
+        try:
+            length = int(self.headers.get("Content-Length", 0))
+            payload = json.loads(self.rfile.read(length) or b"{}")
+            instances = payload["instances"]
+            X = np.asarray(instances, dtype=np.float64)
+        except (ValueError, KeyError, TypeError) as e:
+            self._send_json({"error": f"bad request body: {e}"}, code=400)
+            return
+        try:
+            results = self._srv.submit_many(
+                name, X, timeout_s=payload.get("timeout_s"))
+        except KeyError as e:
+            self._send_json({"error": str(e)}, code=404)
+            return
+        except ValueError as e:
+            self._send_json({"error": str(e)}, code=400)
+            return
+        statuses = [ServeStatus(r.status).name for r in results]
+        ok = all(r.ok for r in results)
+        self._send_json(
+            {
+                "predictions": [
+                    None if r.label is None else np.asarray(r.label).item()
+                    for r in results
+                ],
+                "scores": [
+                    None if r.scores is None else np.asarray(r.scores).tolist()
+                    for r in results
+                ],
+                "statuses": statuses,
+            },
+            # load-induced rejections map to 503 (retryable), per-request
+            # detail stays in `statuses`
+            code=200 if ok else 503,
+        )
+
+
+def make_http_server(server, host: str = "127.0.0.1", port: int = 8471,
+                     verbose: bool = False) -> ThreadingHTTPServer:
+    """Bind (not yet serving) a ThreadingHTTPServer over a serve.Server.
+
+    port=0 binds an ephemeral port (tests); read httpd.server_address.
+    Call .serve_forever() (blocking) or start_http_thread() below.
+    """
+    httpd = ThreadingHTTPServer((host, port), _Handler)
+    httpd.tpusvm_server = server
+    httpd.verbose = verbose
+    # handler threads must not block interpreter exit
+    httpd.daemon_threads = True
+    return httpd
+
+
+def start_http_thread(httpd: ThreadingHTTPServer) -> threading.Thread:
+    """Run an HTTP server on a daemon thread (in-process tests / CLI)."""
+    t = threading.Thread(target=httpd.serve_forever, daemon=True,
+                         name="tpusvm-serve-http")
+    t.start()
+    return t

@@ -1,0 +1,115 @@
+"""Serving metrics: counters, batch-occupancy histogram, latency percentiles.
+
+Everything is host-side Python (no JAX) and guarded by one lock — the
+request rates a single-host server sees (thousands/s) are far below where a
+lock becomes the bottleneck, and one lock keeps snapshot() consistent: a
+scrape never observes a request counted but its latency missing.
+
+Latency percentiles come from a bounded reservoir of the most recent
+completions (default 4096) rather than a streaming sketch: exact over the
+window, O(window log window) only at scrape time, and the window bounds
+memory regardless of uptime.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+from typing import Dict, Optional, Sequence
+
+_COUNTERS = (
+    "requests",      # rows accepted into the queue
+    "ok",            # rows answered with a score
+    "errors",        # rows failed by an exception in the scoring path
+    "timeouts",      # rows that missed their deadline (client- or queue-side)
+    "queue_full",    # rows fast-failed by backpressure (never enqueued)
+    "batches",       # flushes executed by the micro-batcher
+    "recompiles",    # bucket compiles AFTER warm-up (steady state target: 0)
+)
+
+
+class Metrics:
+    """Thread-safe serving counters for one model."""
+
+    def __init__(self, buckets: Sequence[int], latency_window: int = 4096):
+        self._lock = threading.Lock()
+        self._counts: Dict[str, int] = {k: 0 for k in _COUNTERS}
+        # per-bucket occupancy: how many batches flushed at this bucket
+        # size, and how many real (non-padding) rows they carried
+        self._bucket_batches: Dict[int, int] = {int(b): 0 for b in buckets}
+        self._bucket_rows: Dict[int, int] = {int(b): 0 for b in buckets}
+        self._lat = collections.deque(maxlen=latency_window)
+
+    def inc(self, name: str, n: int = 1) -> None:
+        with self._lock:
+            self._counts[name] += n
+
+    def observe_batch(self, bucket: int, rows: int) -> None:
+        with self._lock:
+            self._counts["batches"] += 1
+            self._bucket_batches[bucket] = self._bucket_batches.get(bucket, 0) + 1
+            self._bucket_rows[bucket] = self._bucket_rows.get(bucket, 0) + rows
+
+    def observe_latency(self, seconds: float) -> None:
+        with self._lock:
+            self._lat.append(float(seconds))
+
+    # ------------------------------------------------------------- export
+    @staticmethod
+    def _percentile(sorted_lat, frac: float) -> Optional[float]:
+        if not sorted_lat:
+            return None
+        idx = min(len(sorted_lat) - 1, int(frac * len(sorted_lat)))
+        return sorted_lat[idx]
+
+    def snapshot(self) -> dict:
+        """One consistent JSON-able view of every counter and derived stat."""
+        with self._lock:
+            counts = dict(self._counts)
+            batches = dict(self._bucket_batches)
+            rows = dict(self._bucket_rows)
+            lat = sorted(self._lat)
+        total_rows = sum(rows.values())
+        total_batches = sum(batches.values())
+        occupancy = {
+            str(b): {
+                "batches": batches[b],
+                "rows": rows[b],
+                # mean real rows per flushed batch of this bucket size
+                "mean_rows": (rows[b] / batches[b]) if batches[b] else 0.0,
+            }
+            for b in sorted(batches)
+        }
+        return {
+            **counts,
+            "batch_occupancy": occupancy,
+            "mean_batch_rows": (total_rows / total_batches) if total_batches else 0.0,
+            "latency_s": {
+                "count": len(lat),
+                "p50": self._percentile(lat, 0.50),
+                "p95": self._percentile(lat, 0.95),
+                "p99": self._percentile(lat, 0.99),
+                "max": lat[-1] if lat else None,
+            },
+        }
+
+    def render_text(self, prefix: str = "tpusvm_serve", labels: str = "") -> str:
+        """Plaintext /metrics-style dump (one `name{labels} value` per line)."""
+        snap = self.snapshot()
+        lab = f"{{{labels}}}" if labels else ""
+        lines = [f"{prefix}_{k}_total{lab} {snap[k]}" for k in _COUNTERS]
+        lines.append(
+            f"{prefix}_mean_batch_rows{lab} {snap['mean_batch_rows']:.4f}"
+        )
+        for b, occ in snap["batch_occupancy"].items():
+            sep = "," if labels else ""
+            blab = f"{{{labels}{sep}bucket=\"{b}\"}}"
+            lines.append(f"{prefix}_batches{blab} {occ['batches']}")
+            lines.append(f"{prefix}_batch_rows{blab} {occ['rows']}")
+        for p in ("p50", "p95", "p99"):
+            v = snap["latency_s"][p]
+            if v is not None:
+                sep = "," if labels else ""
+                qlab = f"{{{labels}{sep}quantile=\"{p[1:]}\"}}"
+                lines.append(f"{prefix}_latency_seconds{qlab} {v:.6f}")
+        return "\n".join(lines) + "\n"

@@ -1,0 +1,163 @@
+"""Model registry: load serialized models once, pin their arrays on device.
+
+The estimators' decision_function re-uploads sv_X/coef/b from host numpy on
+every call — fine for offline scoring, hostile to a serving hot path (an
+H2D transfer of the whole SV set per request). A ModelEntry does that
+conversion exactly once at load; the compile cache (buckets.py) then feeds
+the SAME pinned device arrays to every AOT-compiled bucket executable, so a
+steady-state request uploads only its own padded rows.
+
+Feature scaling stays on the host (numpy, per batch): it is O(m*d) on a
+few-row batch, and keeping it host-side makes the served scores use the
+exact scaler arithmetic of the offline path (bit-identity contract).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tpusvm.config import SVMConfig
+from tpusvm.data.scaler import MinMaxScaler
+from tpusvm.models.serialization import is_multiclass_model
+
+
+@dataclasses.dataclass
+class ModelEntry:
+    """One servable model: pinned device arrays + host-side scaler."""
+
+    name: str
+    kind: str                      # "binary" | "ovr"
+    config: SVMConfig
+    n_features: int
+    X_sv: jax.Array                # (n_sv, d), device-resident
+    coef: jax.Array                # binary: (n_sv,) alpha*y; ovr: (K, n_sv)
+    b: jax.Array                   # binary: scalar; ovr: (K,)
+    scaler: Optional[MinMaxScaler]
+    classes: Optional[np.ndarray]  # ovr only
+    dtype: object = jnp.float32
+
+    @property
+    def n_sv(self) -> int:
+        return int(self.X_sv.shape[0])
+
+    def scale(self, X: np.ndarray) -> np.ndarray:
+        return self.scaler.transform(X) if self.scaler is not None else X
+
+    @classmethod
+    def from_estimator(cls, name: str, model) -> "ModelEntry":
+        """Pin an already-fitted BinarySVC / OneVsRestSVC."""
+        # OneVsRestSVC carries classes_/X_sv_/coef_; BinarySVC sv_X_/sv_alpha_
+        if getattr(model, "classes_", None) is not None:
+            if model.X_sv_ is None:
+                raise RuntimeError("model is not fitted")
+            return cls(
+                name=name, kind="ovr", config=model.config,
+                n_features=int(model.X_sv_.shape[1]),
+                X_sv=jnp.asarray(model.X_sv_, model.dtype),
+                coef=jnp.asarray(model.coef_, model.dtype),
+                b=jnp.asarray(model.b_, model.dtype),
+                scaler=model.scaler_ if model.scale else None,
+                classes=np.asarray(model.classes_),
+                dtype=model.dtype,
+            )
+        if model.sv_X_ is None:
+            raise RuntimeError("model is not fitted")
+        coef = np.asarray(model.sv_alpha_) * np.asarray(model.sv_Y_)
+        return cls(
+            name=name, kind="binary", config=model.config,
+            n_features=int(model.sv_X_.shape[1]),
+            X_sv=jnp.asarray(model.sv_X_, model.dtype),
+            coef=jnp.asarray(coef, model.dtype),
+            b=jnp.asarray(model.b_, model.dtype),
+            scaler=model.scaler_ if model.scale else None,
+            classes=None,
+            dtype=model.dtype,
+        )
+
+    @classmethod
+    def from_path(cls, name: str, path: str, dtype=jnp.float32) -> "ModelEntry":
+        """Load a serialized model (binary/OVR auto-detected) and pin it."""
+        from tpusvm.models import BinarySVC, OneVsRestSVC
+
+        if is_multiclass_model(path):
+            model = OneVsRestSVC.load(path, dtype=dtype)
+        else:
+            model = BinarySVC.load(path, dtype=dtype)
+        return cls.from_estimator(name, model)
+
+    def validate_rows(self, X: np.ndarray) -> np.ndarray:
+        # float64 on the host regardless of the model dtype: the scaler
+        # then runs the same f64 arithmetic as the offline path (numpy
+        # promotes mixed f32/f64 to f64 there too), and the cast to the
+        # model dtype happens once, at device upload — bit-identity with
+        # model.decision_function on the same rows
+        X = np.asarray(X, dtype=np.float64)
+        if X.ndim == 1:
+            X = X[None, :]
+        if X.ndim != 2 or X.shape[1] != self.n_features:
+            raise ValueError(
+                f"model {self.name!r} expects rows of {self.n_features} "
+                f"features, got array of shape {X.shape}"
+            )
+        return X
+
+    # npz-load path used by `load_model` requires a SVMConfig; keep a tiny
+    # summary for status endpoints instead of exposing device arrays
+    def describe(self) -> dict:
+        d = {
+            "name": self.name,
+            "kind": self.kind,
+            "n_sv": self.n_sv,
+            "n_features": self.n_features,
+            "gamma": self.config.gamma,
+            "C": self.config.C,
+            "scaled": self.scaler is not None,
+        }
+        if self.classes is not None:
+            d["classes"] = [int(c) for c in self.classes]
+        return d
+
+
+class ModelRegistry:
+    """Thread-safe name -> ModelEntry map."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._entries: Dict[str, ModelEntry] = {}
+
+    def add(self, entry: ModelEntry) -> ModelEntry:
+        with self._lock:
+            if entry.name in self._entries:
+                raise ValueError(f"model {entry.name!r} already registered")
+            self._entries[entry.name] = entry
+        return entry
+
+    def load(self, name: str, path: str, dtype=jnp.float32) -> ModelEntry:
+        return self.add(ModelEntry.from_path(name, path, dtype=dtype))
+
+    def get(self, name: str) -> ModelEntry:
+        with self._lock:
+            try:
+                return self._entries[name]
+            except KeyError:
+                raise KeyError(
+                    f"unknown model {name!r}; registered: {sorted(self._entries)}"
+                ) from None
+
+    def unload(self, name: str) -> None:
+        with self._lock:
+            self._entries.pop(name, None)
+
+    def names(self):
+        with self._lock:
+            return sorted(self._entries)
+
+    def __contains__(self, name: str) -> bool:
+        with self._lock:
+            return name in self._entries

@@ -1,0 +1,247 @@
+"""The serving frontend: Server.submit()/submit_many() over named models.
+
+One Server hosts any number of models; each model gets its own pinned
+ModelEntry (registry.py), bucketed compile cache (buckets.py), metrics
+(metrics.py), and micro-batcher worker (batcher.py) — models are fully
+independent, so a slow model cannot head-of-line-block another.
+
+Lifecycle: load/add -> warmup() -> submit()/submit_many() -> close().
+warmup() AOT-compiles every (model, bucket) executable so steady state is
+compile-free (the `recompiles` metric proves it); skipping warm-up is legal
+but the first request to each bucket then pays the compile and counts it.
+
+predict_direct() is the sequential one-request-at-a-time path — the same
+scoring arithmetic with no queue or coalescing. It exists as the benchmark
+baseline (benchmarks/serve_latency.py measures batched-vs-sequential
+throughput against it) and as the bit-identity oracle in tests.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from tpusvm.serve.batcher import MicroBatcher, ServeResult
+from tpusvm.serve.buckets import CompileCache, default_buckets
+from tpusvm.serve.metrics import Metrics
+from tpusvm.serve.registry import ModelEntry, ModelRegistry
+
+
+@dataclasses.dataclass(frozen=True)
+class ServeConfig:
+    """Per-server serving knobs (shared by every hosted model)."""
+
+    max_batch: int = 64          # coalescing cap = largest bucket
+    max_delay_ms: float = 2.0    # max added latency waiting for co-riders
+    queue_size: int = 1024       # backpressure bound (fast-fail when full)
+    timeout_ms: float = 1000.0   # default per-request deadline
+    buckets: Optional[Tuple[int, ...]] = None  # default: powers of two
+    block: int = 2048            # binary scorer's scan block
+
+    def resolved_buckets(self) -> Tuple[int, ...]:
+        if self.buckets is not None:
+            b = tuple(sorted(int(x) for x in self.buckets))
+            if not b or b[-1] < self.max_batch:
+                raise ValueError(
+                    f"buckets {b} do not cover max_batch={self.max_batch}"
+                )
+            return b
+        return default_buckets(self.max_batch)
+
+
+class _ModelWorker:
+    """Entry + cache + metrics + batcher for one hosted model."""
+
+    def __init__(self, entry: ModelEntry, config: ServeConfig):
+        buckets = config.resolved_buckets()
+        self.entry = entry
+        self.cache = CompileCache(entry, buckets, block=config.block)
+        self.metrics = Metrics(buckets)
+        # serializes predict_direct against the batcher thread: compiled
+        # executables tolerate concurrent callers, but one at a time keeps
+        # the latency accounting honest and the device queue short
+        self._exec_lock = threading.Lock()
+        self.batcher = MicroBatcher(
+            self._run_batch,
+            max_batch=config.max_batch,
+            max_delay_s=config.max_delay_ms / 1e3,
+            queue_size=config.queue_size,
+            timeout_s=config.timeout_ms / 1e3,
+            metrics=self.metrics,
+        )
+
+    def _score(self, X: np.ndarray):
+        """(scores, labels, [(bucket, rows), ...]) for validated f64 rows.
+
+        Batches larger than the top bucket (possible only via the direct
+        path — the batcher caps at max_batch) are chunked through it."""
+        e = self.entry
+        if X.shape[0] == 0:
+            shape = (0,) if e.kind == "binary" else (0, len(e.classes))
+            return np.zeros(shape), np.zeros(0, np.int32), []
+        Xs = e.scale(X)
+        top = self.cache.buckets[-1]
+        parts, chunks = [], []
+        with self._exec_lock:
+            for i in range(0, Xs.shape[0], top):
+                s, bucket = self.cache.scores(Xs[i:i + top])
+                parts.append(s)
+                chunks.append((bucket, s.shape[0]))
+        scores = np.concatenate(parts) if len(parts) > 1 else parts[0]
+        if e.kind == "binary":
+            labels = np.where(scores > 0, 1, -1).astype(np.int32)
+        else:
+            labels = e.classes[np.argmax(scores, axis=1)]
+        return scores, labels, chunks
+
+    def _run_batch(self, X: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+        scores, labels, chunks = self._score(X)
+        for bucket, rows in chunks:
+            self.metrics.observe_batch(bucket, rows)
+        return scores, labels
+
+    def close(self) -> None:
+        self.batcher.close()
+
+
+class Server:
+    """In-process serving frontend over named SVM models."""
+
+    def __init__(self, config: ServeConfig = ServeConfig(),
+                 dtype=jnp.float32):
+        self.config = config
+        self.dtype = dtype
+        self.registry = ModelRegistry()
+        self._workers: Dict[str, _ModelWorker] = {}
+        self._lock = threading.Lock()
+        self._closed = False
+
+    # ----------------------------------------------------------- hosting
+    def _install(self, entry: ModelEntry) -> ModelEntry:
+        self.registry.add(entry)
+        with self._lock:
+            self._workers[entry.name] = _ModelWorker(entry, self.config)
+        return entry
+
+    def load_model(self, name: str, path: str) -> ModelEntry:
+        """Load a serialized .npz model (binary/OVR auto-detected)."""
+        return self._install(ModelEntry.from_path(name, path,
+                                                  dtype=self.dtype))
+
+    def add_model(self, name: str, model) -> ModelEntry:
+        """Host an already-fitted BinarySVC / OneVsRestSVC."""
+        return self._install(ModelEntry.from_estimator(name, model))
+
+    def _worker(self, name: str) -> _ModelWorker:
+        with self._lock:
+            try:
+                return self._workers[name]
+            except KeyError:
+                raise KeyError(
+                    f"unknown model {name!r}; hosted: {sorted(self._workers)}"
+                ) from None
+
+    def warmup(self, name: Optional[str] = None) -> Dict[str, int]:
+        """AOT-compile every bucket executable; {model: compiles done}."""
+        names = [name] if name is not None else self.registry.names()
+        return {n: self._worker(n).cache.warmup() for n in names}
+
+    # ----------------------------------------------------------- serving
+    def submit(self, name: str, x: np.ndarray,
+               timeout_s: Optional[float] = None) -> ServeResult:
+        """Score one row through the micro-batcher; blocks for the result."""
+        w = self._worker(name)
+        row = w.entry.validate_rows(x)
+        if row.shape[0] != 1:
+            raise ValueError(
+                f"submit takes one row, got {row.shape[0]} (use submit_many)"
+            )
+        return w.batcher.submit(row[0], timeout_s=timeout_s)
+
+    def submit_many(self, name: str, X: np.ndarray,
+                    timeout_s: Optional[float] = None) -> List[ServeResult]:
+        """Score rows through the micro-batcher (rows coalesce freely with
+        other callers' requests)."""
+        w = self._worker(name)
+        rows = w.entry.validate_rows(X)
+        return w.batcher.submit_many(list(rows), timeout_s=timeout_s)
+
+    def predict_direct(self, name: str, X: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+        """(scores, labels) synchronously, bypassing the queue.
+
+        The sequential baseline and bit-identity oracle: same scaler, same
+        bucket executables, no batching."""
+        w = self._worker(name)
+        rows = w.entry.validate_rows(X)
+        scores, labels, _ = w._score(rows)
+        return scores, labels
+
+    # ------------------------------------------------------------ status
+    def metrics(self, name: str) -> dict:
+        return self._worker(name).metrics.snapshot()
+
+    def metrics_text(self) -> str:
+        chunks = []
+        for n in self.registry.names():
+            w = self._worker(n)
+            snap_labels = f'model="{n}"'
+            chunks.append(w.metrics.render_text(labels=snap_labels))
+            chunks.append(
+                f'tpusvm_serve_compiled_shapes{{{snap_labels}}} '
+                f'{w.cache.compiled_shapes}\n'
+            )
+        return "".join(chunks)
+
+    def status(self) -> dict:
+        """JSON-able server summary (models, buckets, compiles, queues)."""
+        models = {}
+        for n in self.registry.names():
+            w = self._worker(n)
+            models[n] = {
+                **w.entry.describe(),
+                "buckets": list(w.cache.buckets),
+                "compiled_shapes": w.cache.compiled_shapes,
+                "compiles": w.cache.compiles,
+                "recompiles": w.cache.recompiles,
+                "warmed": w.cache.warmed,
+                "queue_depth": w.batcher.depth,
+            }
+        return {
+            "models": models,
+            "config": dataclasses.asdict(self.config),
+        }
+
+    def close(self) -> None:
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+            workers = list(self._workers.values())
+        for w in workers:
+            w.close()
+
+    def __enter__(self) -> "Server":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+def sequential_qps(server: Server, name: str, rows: Sequence[np.ndarray],
+                   duration_s: float) -> float:
+    """Throughput of the one-request-at-a-time path (benchmark baseline)."""
+    import itertools
+    import time
+
+    n = 0
+    t0 = time.perf_counter()
+    for x in itertools.cycle(rows):
+        server.predict_direct(name, x)
+        n += 1
+        if time.perf_counter() - t0 >= duration_s:
+            break
+    return n / (time.perf_counter() - t0)

@@ -31,3 +31,25 @@ class Status(enum.IntEnum):
     NONPOS_ETA = 4
     MAX_ITER = 5
     STALLED = 6
+
+
+class ServeStatus(enum.IntEnum):
+    """Per-request outcome codes for the online-serving path (tpusvm.serve).
+
+    Mirrors the solver's explicit-status philosophy above: the serving
+    frontend never raises for load-induced conditions — a request comes
+    back with a code the caller (and the metrics layer) can branch on.
+
+      OK          scored; result carries scores/label
+      TIMEOUT     missed its deadline (client wait or queue residency)
+      QUEUE_FULL  fast-failed by backpressure; never entered the queue
+      ERROR       the scoring path raised (bad input caught pre-queue
+                  raises ValueError instead — that is a caller bug)
+      SHUTDOWN    the server closed while the request was in flight
+    """
+
+    OK = 0
+    TIMEOUT = 1
+    QUEUE_FULL = 2
+    ERROR = 3
+    SHUTDOWN = 4
