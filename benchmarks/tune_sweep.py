@@ -1,0 +1,155 @@
+"""Cold-vs-warm tune sweep: measuring the warm-start iteration savings.
+
+The tune acceptance bar (ISSUE 3): on the committed benchmark grid, the
+warm-started grid search must spend >= 30% fewer total SMO alpha updates
+than a cold-start sweep of the SAME grid on the SAME folds, while agreeing
+with it on the winning (C, gamma) exactly and on every point's CV accuracy
+within 1e-6 (the warm seed changes the optimisation trajectory, never the
+optimum the stopping rule accepts). This harness runs both arms and emits
+one JSONL row per grid point (cold vs warm update counts, the per-point
+saving, both CV accuracies) plus a summary row with the gates — the house
+provenance style (workload_record, violations list, rc != 0 on any gate
+failure).
+
+The workload is the MNIST-shaped synthetic family at a reduced
+(n=768, d=64) shape: big enough that SMO update counts are in the tens of
+thousands per arm (the savings signal is about active-set transfer, which
+a toy 2-D problem with a handful of SVs cannot exhibit), small enough to
+run on CPU in CI time. The grid is 5x5 multiplicative 2x steps bracketing
+the reference's (C=10, gamma≈1/d) operating point — fine enough steps that
+adjacent points share most of their active set, which is precisely the
+regime warm-starting exploits (and how a real refinement sweep is shaped).
+
+Usage: python benchmarks/tune_sweep.py [--smoke] [--n 768] [--d 64]
+           [--folds 3] [--C-grid LIST] [--gamma-grid LIST] [--jsonl PATH]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from benchmarks.common import emit, log, pin_platform, workload_record  # noqa: E402
+
+pin_platform()
+
+import jax  # noqa: E402
+
+SAVINGS_GATE = 0.30  # full-size runs only; --smoke checks agreement gates
+CV_TOL = 1e-6
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--smoke", action="store_true",
+                    help="tiny shape (schema/CI run): agreement gates "
+                    "only, no savings floor")
+    ap.add_argument("--n", type=int, default=768, help="dataset rows")
+    ap.add_argument("--d", type=int, default=64)
+    ap.add_argument("--seed", type=int, default=11, help="data seed")
+    ap.add_argument("--folds", type=int, default=3)
+    ap.add_argument("--fold-seed", type=int, default=1)
+    ap.add_argument("--C-grid", dest="C_grid",
+                    default="2.5,5,10,20,40")
+    ap.add_argument("--gamma-grid",
+                    default="0.004,0.008,0.016,0.031,0.0625")
+    ap.add_argument("--tau", type=float, default=1e-5)
+    ap.add_argument("--jsonl", default=None,
+                    help="also append rows to this file")
+    args = ap.parse_args(argv)
+    if args.smoke:
+        args.n, args.d, args.folds = 320, 16, 2
+        args.C_grid, args.gamma_grid = "5,10", "0.03,0.06"
+
+    from tpusvm.config import SVMConfig
+    from tpusvm.data.synthetic import BENCH_LABEL_NOISE, BENCH_NOISE, mnist_like
+    from tpusvm.tune import TuneConfig, make_grid, tune
+
+    gen_kwargs = dict(n=args.n, d=args.d, seed=args.seed,
+                      noise=BENCH_NOISE, label_noise=BENCH_LABEL_NOISE)
+    X, Y = mnist_like(**gen_kwargs)
+    grid = make_grid([float(v) for v in args.C_grid.split(",")],
+                     [float(v) for v in args.gamma_grid.split(",")])
+    base = SVMConfig(tau=args.tau)
+
+    def arm(warm: bool):
+        cfg = TuneConfig(folds=args.folds, seed=args.fold_seed,
+                         warm_start=warm)
+        return tune(X, Y, grid, cfg, base=base)
+
+    log(f"tune_sweep: n={args.n} d={args.d} folds={args.folds} "
+        f"grid={grid.shape[0]}x{grid.shape[1]}")
+    cold = arm(False)
+    log(f"cold arm: {cold.total_updates} updates, "
+        f"winner C={cold.winner['C']:g} gamma={cold.winner['gamma']:g}")
+    warm = arm(True)
+    log(f"warm arm: {warm.total_updates} updates, "
+        f"winner C={warm.winner['C']:g} gamma={warm.winner['gamma']:g}")
+
+    sink = open(args.jsonl, "a") if args.jsonl else None
+
+    def row(rec):
+        emit(rec)
+        if sink:
+            sink.write(json.dumps(rec) + "\n")
+
+    base_rec = {
+        "bench": "tune_sweep",
+        "workload": workload_record(mnist_like, **gen_kwargs),
+        "folds": args.folds,
+        "fold_seed": args.fold_seed,
+        "tau": args.tau,
+        "platform": jax.default_backend(),
+    }
+
+    max_cv_diff = 0.0
+    for pc, pw in zip(cold.points, warm.points):
+        assert (pc["C"], pc["gamma"]) == (pw["C"], pw["gamma"])
+        cv_diff = abs(pc["cv_accuracy"] - pw["cv_accuracy"])
+        max_cv_diff = max(max_cv_diff, cv_diff)
+        saving = (1.0 - pw["n_updates"] / pc["n_updates"]
+                  if pc["n_updates"] else 0.0)
+        row({
+            **base_rec, "C": pc["C"], "gamma": pc["gamma"],
+            "cold_updates": pc["n_updates"],
+            "warm_updates": pw["n_updates"],
+            "saving": round(saving, 4),
+            "cold_cv": pc["cv_accuracy"], "warm_cv": pw["cv_accuracy"],
+            "warm_seeded": pw["warm_seeded"],
+            "sv_count": pc["sv_count"],
+        })
+
+    total_saving = 1.0 - warm.total_updates / cold.total_updates
+    same_winner = (cold.winner["C"] == warm.winner["C"]
+                   and cold.winner["gamma"] == warm.winner["gamma"])
+    violations = []
+    if not same_winner:
+        violations.append("winner_mismatch")
+    if max_cv_diff > CV_TOL:
+        violations.append("cv_accuracy_drift")
+    if not args.smoke and total_saving < SAVINGS_GATE:
+        violations.append("savings_below_gate")
+    row({
+        **base_rec, "summary": True,
+        "cold_total_updates": cold.total_updates,
+        "warm_total_updates": warm.total_updates,
+        "total_saving": round(total_saving, 4),
+        "savings_gate": None if args.smoke else SAVINGS_GATE,
+        "same_winner": same_winner,
+        "winner": warm.winner,
+        "max_cv_diff": max_cv_diff,
+        "cold_wall_s": round(cold.wall_s, 2),
+        "warm_wall_s": round(warm.wall_s, 2),
+        "violations": violations,
+    })
+    if sink:
+        sink.close()
+    return 1 if violations else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

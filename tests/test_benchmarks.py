@@ -170,6 +170,34 @@ def test_serve_latency_smoke_schema(capsys):
     assert summary["summary"] and summary["violations"] == []
 
 
+def test_tune_sweep_smoke_schema(capsys):
+    # the cold-vs-warm tune A/B (ISSUE 3): schema + the load-independent
+    # hard gates — identical winner and CV accuracy within 1e-6 between
+    # the arms. The >= 30% savings floor is deliberately not asserted at
+    # smoke shape (active-set transfer needs real SV counts);
+    # benchmarks/results/tune_sweep_cpu.jsonl holds the committed
+    # full-size curve: 43.8% total saving at n=768 d=64, 5x5 grid
+    from benchmarks import tune_sweep
+
+    rc = tune_sweep.main(["--smoke"])
+    assert rc == 0
+    recs = _records(capsys)
+    points = [r for r in recs if "summary" not in r]
+    assert len(points) == 4  # 2x2 smoke grid
+    for r in points:
+        assert r["workload"]["synthetic"] is True
+        assert r["cold_updates"] > 0 and r["warm_updates"] > 0
+        assert abs(r["cold_cv"] - r["warm_cv"]) <= 1e-6
+    # the warm chain engages on every point after the first
+    assert all(r["warm_seeded"] == 2 for r in points[1:])
+    summary = recs[-1]
+    assert summary["summary"] and summary["violations"] == []
+    assert summary["same_winner"] is True
+    assert summary["max_cv_diff"] <= 1e-6
+    assert summary["warm_total_updates"] == sum(
+        r["warm_updates"] for r in points)
+
+
 def test_midsize_cascade_smoke(capsys):
     # the production-scale cascade artifact harness (VERDICT r4 #6),
     # shrunken: direct control + tree + star on the simulated mesh, zero

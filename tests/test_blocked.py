@@ -80,6 +80,73 @@ def test_blocked_warm_start():
     np.testing.assert_allclose(np.asarray(r2.alpha), np.asarray(r.alpha))
 
 
+def test_blocked_warm_start_equivalence_from_neighbour_solution():
+    """The tune subsystem's contract on the solver surface, tested
+    directly (previously only covered indirectly via cascade tests):
+    warm-starting from a NEIGHBOURING hyperparameter point's solution
+    must (a) converge, (b) reproduce the cold solve's SV IDs and b within
+    tolerance — same optimum, different trajectory — and (c) cost
+    markedly fewer updates than the cold solve; and re-solving from the
+    point's OWN solution must terminate in a handful of outer rounds."""
+    from tpusvm.oracle import get_sv_indices
+
+    Xs, Y = _data(rings, n=256, noise=0.3, seed=11)
+    Xj, Yj = jnp.asarray(Xs), jnp.asarray(Y)
+    kw = dict(C=4.0, gamma=4.0, q=64)
+    cold = blocked_smo_solve(Xj, Yj, **kw)
+    assert int(cold.status) == Status.CONVERGED
+
+    # (a)-(c): seed from the adjacent grid point gamma*sqrt(2)
+    donor = blocked_smo_solve(Xj, Yj, C=4.0, gamma=4.0 * 2 ** 0.5, q=64)
+    assert int(donor.status) == Status.CONVERGED
+    warm = blocked_smo_solve(Xj, Yj, alpha0=donor.alpha,
+                             warm_start=True, **kw)
+    assert int(warm.status) == Status.CONVERGED
+    assert float(warm.b_low) <= float(warm.b_high) + 2 * 1e-5
+    np.testing.assert_array_equal(
+        get_sv_indices(np.asarray(warm.alpha)),
+        get_sv_indices(np.asarray(cold.alpha)),
+    )
+    np.testing.assert_allclose(float(warm.b), float(cold.b), atol=1e-4)
+    assert int(warm.n_iter) < int(cold.n_iter)
+
+    # own-solution resume: converges at (or within a handful of rounds
+    # of) the first global stop check
+    resume = blocked_smo_solve(Xj, Yj, alpha0=cold.alpha,
+                               warm_start=True, **kw)
+    assert int(resume.status) == Status.CONVERGED
+    assert int(resume.n_outer) <= 3
+    np.testing.assert_allclose(np.asarray(resume.alpha),
+                               np.asarray(cold.alpha), atol=1e-9)
+
+
+def test_blocked_precomputed_sn_identical():
+    # the tune driver's fold-cache path: passing cached sq_norms must be
+    # numerically invisible (same trajectory, same result)
+    from tpusvm.ops.rbf import sq_norms
+
+    Xs, Y = _data(blobs, n=120, d=4, seed=2)
+    Xj, Yj = jnp.asarray(Xs), jnp.asarray(Y)
+    a = blocked_smo_solve(Xj, Yj, C=1.0, gamma=0.25, q=32)
+    b = blocked_smo_solve(Xj, Yj, sn=sq_norms(Xj), C=1.0, gamma=0.25, q=32)
+    assert int(a.n_iter) == int(b.n_iter)
+    np.testing.assert_array_equal(np.asarray(a.alpha), np.asarray(b.alpha))
+    assert float(a.b) == float(b.b)
+
+
+def test_pad_alpha0_resume_shapes():
+    from tpusvm.solver.blocked import pad_alpha0
+
+    a = np.array([1.0, 2.0, 3.0])
+    np.testing.assert_array_equal(pad_alpha0(a, 5), [1, 2, 3, 0, 0])
+    np.testing.assert_array_equal(pad_alpha0(a, 2), [1, 2])
+    assert pad_alpha0(a, 3) is a
+    aj = jnp.asarray(a)
+    out = pad_alpha0(aj, 5)
+    assert isinstance(out, jnp.ndarray) and out.shape == (5,)
+    np.testing.assert_array_equal(np.asarray(out), [1, 2, 3, 0, 0])
+
+
 def test_blocked_single_class_no_working_set():
     Xs, Y = _data(blobs, n=64, seed=1)
     r = blocked_smo_solve(

@@ -277,6 +277,11 @@ def test_cli_class_parallel_requires_multiclass(capsys):
               "--class-parallel"])
 
 
+def test_cli_stratify_requires_cascade(capsys):
+    with pytest.raises(SystemExit, match="--mode cascade"):
+        main(["train", "--synthetic", "rings", "--n", "64", "--stratify"])
+
+
 def test_cli_class_parallel_rejects_blocked(capsys):
     with pytest.raises(SystemExit, match="pair solver"):
         main(["train", "--synthetic", "blobs", "--n", "64", "--multiclass",

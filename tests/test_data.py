@@ -74,6 +74,43 @@ def test_partition_contiguous_with_ids():
     np.testing.assert_array_equal(part.X[part.valid], X)
 
 
+def test_partition_stratified_balances_sorted_labels():
+    # label-sorted input: the contiguous split hands out single-class
+    # shards (the pallas-mp-adv fuzz shape); stratified must not
+    n = 64
+    X = np.arange(n * 2, dtype=float).reshape(n, 2)
+    Y = np.array([1] * 32 + [-1] * 32, np.int32)
+    cont = partition(X, Y, 4)
+    assert any(len(np.unique(cont.Y[p][cont.valid[p]])) == 1
+               for p in range(4))
+    strat = partition(X, Y, 4, stratified=True)
+    for p in range(4):
+        ys = strat.Y[p][strat.valid[p]]
+        assert set(np.unique(ys)) == {1, -1}
+        assert (ys == 1).sum() == 8 and (ys == -1).sum() == 8
+    # global IDs are still original row indices: reassembling by ID gives
+    # back the dataset exactly (the cascade's dedup-by-ID contract)
+    ids = strat.ids[strat.valid]
+    np.testing.assert_array_equal(np.sort(ids), np.arange(n))
+    np.testing.assert_array_equal(X[ids], strat.X[strat.valid])
+    np.testing.assert_array_equal(Y[ids], strat.Y[strat.valid])
+
+
+def test_partition_stratified_remainders_staggered():
+    # 7 rows of class A, 5 of class B over 4 shards: per-class remainders
+    # must not all land on shard 0 (class starts are staggered)
+    Y = np.array([1] * 7 + [-1] * 5, np.int32)
+    X = np.zeros((12, 3))
+    part = partition(X, Y, 4, stratified=True)
+    assert part.count.sum() == 12
+    assert part.count.max() - part.count.min() <= 1
+    # every shard still sees both classes where it has >= 2 rows
+    for p in range(4):
+        ys = part.Y[p][part.valid[p]]
+        if len(ys) >= 2:
+            assert len(np.unique(ys)) == 2
+
+
 def test_synthetic_deterministic():
     X1, Y1 = blobs(n=50, seed=3)
     X2, Y2 = blobs(n=50, seed=3)

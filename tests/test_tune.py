@@ -1,0 +1,298 @@
+"""tpusvm.tune: folds, grid geometry, warm seeding, search driver, results.
+
+The subsystem's correctness contract has three legs:
+  - splits are deterministic, stratified, and exhaustive (every row in
+    exactly one validation side);
+  - warm seeding never changes WHAT a sweep decides — winner and CV
+    accuracies match a cold sweep of the same grid/folds (the benchmark
+    gate asserts this at full size; here at test size);
+  - the persisted artifact is format-versioned and fails loudly on
+    foreign/tampered files, like model serialization.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from tpusvm.config import SVMConfig
+from tpusvm.data import rings
+from tpusvm.status import TuneStatus
+from tpusvm.tune import (
+    TuneConfig,
+    format_table,
+    is_tune_result,
+    load_tune_result,
+    make_grid,
+    save_tune_result,
+    stratified_kfold,
+    tune,
+)
+from tpusvm.tune.grid import log_distance, log_grid, nearest_point
+from tpusvm.tune.search import _rung_sizes
+from tpusvm.tune.warm import WarmStore, feasible_seed
+
+
+# ------------------------------------------------------------------- folds
+def test_stratified_kfold_exhaustive_and_deterministic():
+    Y = np.array([1] * 30 + [-1] * 18, np.int32)
+    folds = stratified_kfold(Y, 3, seed=5)
+    all_val = np.concatenate([f.val_idx for f in folds])
+    # every row in exactly one val side
+    np.testing.assert_array_equal(np.sort(all_val), np.arange(48))
+    for f in folds:
+        assert len(np.intersect1d(f.train_idx, f.val_idx)) == 0
+        # stratified: each val side carries both classes at ~global ratio
+        yv = Y[f.val_idx]
+        assert (yv == 1).sum() == 10 and (yv == -1).sum() == 6
+    folds2 = stratified_kfold(Y, 3, seed=5)
+    for a, b in zip(folds, folds2):
+        np.testing.assert_array_equal(a.train_idx, b.train_idx)
+        np.testing.assert_array_equal(a.val_idx, b.val_idx)
+
+
+def test_stratified_kfold_train_order_is_shuffled():
+    # rung subsets are PREFIXES of train_idx, so its order must mix
+    # classes — sorted order would make small rungs echo the storage order
+    Y = np.array([1] * 40 + [-1] * 40, np.int32)  # label-sorted input
+    (f, *_) = stratified_kfold(Y, 4, seed=0)
+    prefix = Y[f.train_idx[:16]]
+    assert (prefix == 1).any() and (prefix == -1).any()
+    assert not np.all(np.diff(f.train_idx) > 0)
+
+
+def test_stratified_kfold_rejects_starved_class():
+    Y = np.array([1] * 20 + [-1] * 2, np.int32)
+    with pytest.raises(ValueError, match="class .* rows < k"):
+        stratified_kfold(Y, 3)
+    with pytest.raises(ValueError, match="2 <= k"):
+        stratified_kfold(np.ones(8, np.int32), 1)
+
+
+# -------------------------------------------------------------------- grid
+def test_grid_snake_order_adjacent_steps():
+    g = make_grid([1.0, 4.0, 16.0], [0.5, 2.0, 8.0])
+    pts = g.points()
+    assert len(pts) == 9 and g.shape == (3, 3)
+    # consecutive points differ in exactly one coordinate by one grid step
+    for a, b in zip(pts, pts[1:]):
+        changed = (a[0] != b[0]) + (a[1] != b[1])
+        assert changed == 1
+        assert log_distance(a, b) <= np.log(4.0) + 1e-9
+
+
+def test_grid_validation():
+    with pytest.raises(ValueError, match="positive"):
+        make_grid([1.0, -2.0], [0.5])
+    with pytest.raises(ValueError, match="distinct"):
+        make_grid([1.0, 1.0], [0.5])
+    with pytest.raises(ValueError, match="at least one"):
+        make_grid([], [0.5])
+    lg = log_grid(10.0, 0.001, span=1, step=4.0)
+    assert lg.shape == (3, 3)
+    assert 10.0 in lg.C_values and 0.001 in lg.gamma_values
+
+
+def test_nearest_point_log_space_ties_to_earliest():
+    cands = [(1.0, 1.0), (4.0, 1.0), (1.0, 4.0)]
+    assert nearest_point((2.0, 1.0), cands) == 0  # log-closer to (1,1)
+    assert nearest_point((4.0, 4.0), cands) == 1  # tie -> earliest
+
+
+def test_rung_sizes():
+    assert _rung_sizes(1000, 100, 3) == [100, 300, 900, 1000]
+    assert _rung_sizes(100, 100, 3) == [100]
+    assert _rung_sizes(50, 100, 3) == [50]
+
+
+# -------------------------------------------------------------------- warm
+def test_feasible_seed_clips_and_balances():
+    Y = np.array([1, 1, -1, -1], np.int32)
+    a = feasible_seed(np.array([5.0, 3.0, 2.0, 1.0]), Y, C=2.0)
+    assert (a >= 0).all() and (a <= 2.0).all()  # clipped into the new box
+    np.testing.assert_allclose((a * Y).sum(), 0.0, atol=1e-12)
+    # the lighter side is untouched, the heavier side scaled down
+    np.testing.assert_allclose(a[2:], [2.0, 1.0])
+
+
+def test_feasible_seed_one_sided_collapses_to_zero():
+    Y = np.array([1, 1, -1], np.int32)
+    a = feasible_seed(np.array([1.0, 2.0, 0.0]), Y, C=10.0)
+    assert (a == 0).all()
+
+
+def test_warm_store_prefers_same_point_then_neighbour():
+    Y = np.array([1, -1, 1, -1], np.int32)
+    store = WarmStore()
+    assert store.seed(0, (1.0, 1.0), 4, Y, C=10.0) is None  # empty: cold
+    store.record(0, (1.0, 1.0), np.array([1.0, 1.0, 0.0, 0.0]))
+    store.record(0, (100.0, 100.0), np.array([0.0, 0.0, 2.0, 2.0]))
+    # same point wins over any neighbour, zero-padding across rung sizes
+    s = store.seed(0, (1.0, 1.0), 6,
+                   np.array([1, -1, 1, -1, 1, -1], np.int32), C=10.0)
+    np.testing.assert_allclose(s, [1.0, 1.0, 0, 0, 0, 0])
+    # unseen point: the log-space-nearest donor's alphas
+    s2 = store.seed(0, (2.0, 2.0), 4, Y, C=10.0)
+    np.testing.assert_allclose(s2, [1.0, 1.0, 0.0, 0.0])
+    # folds are independent stores
+    assert store.seed(1, (1.0, 1.0), 4, Y, C=10.0) is None
+
+
+# ------------------------------------------------------------------ search
+@pytest.fixture(scope="module")
+def rings_data():
+    return rings(n=240, noise=0.25, seed=3)
+
+
+def _cfg(**kw):
+    kw.setdefault("folds", 2)
+    kw.setdefault("seed", 1)
+    return TuneConfig(**kw)
+
+
+def test_tune_grid_warm_matches_cold_decision(rings_data):
+    X, Y = rings_data
+    grid = make_grid([1.0, 4.0], [1.0, 4.0])
+    warm = tune(X, Y, grid, _cfg(warm_start=True))
+    cold = tune(X, Y, grid, _cfg(warm_start=False))
+    assert warm.winner["C"] == cold.winner["C"]
+    assert warm.winner["gamma"] == cold.winner["gamma"]
+    for pw, pc in zip(warm.points, cold.points):
+        assert pw["status"] == TuneStatus.EVALUATED.name
+        assert abs(pw["cv_accuracy"] - pc["cv_accuracy"]) <= 1e-6
+    # warm seeding actually engaged everywhere after the first point
+    assert all(p["warm_seeded"] == 2 for p in warm.points[1:])
+    assert all(p["warm_seeded"] == 0 for p in cold.points)
+    assert warm.total_updates == sum(p["n_updates"] for p in warm.points)
+    assert warm.n == 240 and warm.d == 2 and warm.folds == 2
+
+
+def test_tune_halving_prunes_and_promotes(rings_data):
+    X, Y = rings_data
+    grid = make_grid([0.5, 2.0, 8.0], [0.5, 2.0, 8.0])
+    res = tune(X, Y, grid, _cfg(schedule="halving", min_rung=32, eta=3))
+    statuses = [p["status"] for p in res.points]
+    n_eval = statuses.count(TuneStatus.EVALUATED.name)
+    n_pruned = statuses.count(TuneStatus.PRUNED.name)
+    assert n_eval >= 1 and n_pruned >= 1
+    assert n_eval + n_pruned == 9  # halving never skips a point
+    # pruned points were measured at a smaller rung than the survivors
+    last_rung = max(p["rung"] for p in res.points)
+    for p in res.points:
+        if p["status"] == TuneStatus.EVALUATED.name:
+            assert p["rung"] == last_rung
+            assert p["n_subset"] == min(
+                len(f.train_idx)
+                for f in stratified_kfold(Y, 2, seed=1))
+    # the winner is a final-rung point with the best accuracy there
+    finals = [p for p in res.points
+              if p["status"] == TuneStatus.EVALUATED.name]
+    assert res.winner["cv_accuracy"] == max(
+        p["cv_accuracy"] for p in finals)
+
+
+def test_tune_plateau_early_stop(rings_data):
+    X, Y = rings_data
+    grid = make_grid([0.5, 1.0, 2.0, 4.0, 8.0], [2.0])
+    res = tune(X, Y, grid, _cfg(patience=2))
+    statuses = [p["status"] for p in res.points]
+    # rings saturates at the first points -> patience fires, tail skipped
+    assert TuneStatus.SKIPPED.name in statuses
+    skipped = [p for p in res.points
+               if p["status"] == TuneStatus.SKIPPED.name]
+    for p in skipped:
+        assert p["cv_accuracy"] is None and p["n_updates"] == 0
+    # skipped points can never be the winner
+    assert res.winner["cv_accuracy"] is not None
+
+
+def test_tune_config_validation():
+    with pytest.raises(ValueError, match="schedule"):
+        TuneConfig(schedule="random")
+    with pytest.raises(ValueError, match="folds"):
+        TuneConfig(folds=1)
+    with pytest.raises(ValueError, match="eta"):
+        TuneConfig(eta=1)
+    with pytest.raises(ValueError, match="patience"):
+        TuneConfig(patience=0)
+
+
+# ----------------------------------------------------------------- results
+def test_tune_result_roundtrip_and_table(tmp_path, rings_data):
+    X, Y = rings_data
+    res = tune(X, Y, make_grid([1.0], [2.0]), _cfg())
+    path = str(tmp_path / "r.json")
+    save_tune_result(path, res)
+    assert is_tune_result(path)
+    back = load_tune_result(path)
+    assert back.winner == res.winner
+    assert back.points == res.points
+    assert back.schedule == "grid" and back.warm_start is True
+    table = format_table(back)
+    assert "winner: C=1" in table and "EVALUATED" in table
+
+
+def test_tune_result_version_gate(tmp_path):
+    raw = {"kind": "tpusvm-tune-result", "format_version": 99}
+    p = str(tmp_path / "future.json")
+    json.dump(raw, open(p, "w"))
+    with pytest.raises(ValueError, match="unsupported tune-results format"):
+        load_tune_result(p)
+    p2 = str(tmp_path / "foreign.json")
+    json.dump({"something": "else"}, open(p2, "w"))
+    assert not is_tune_result(p2)
+    with pytest.raises(ValueError, match="not a tpusvm tune-results"):
+        load_tune_result(p2)
+    # versioned and right kind but missing fields: loud, named error
+    p3 = str(tmp_path / "torn.json")
+    json.dump({"kind": "tpusvm-tune-result", "format_version": 1,
+               "winner": {}}, open(p3, "w"))
+    with pytest.raises(ValueError, match="missing tune-result fields"):
+        load_tune_result(p3)
+
+
+# --------------------------------------------------------------------- cli
+def test_cli_tune_smoke_and_info(tmp_path, capsys):
+    from tpusvm.cli import main
+
+    results = str(tmp_path / "tune.json")
+    model = str(tmp_path / "winner.npz")
+    rc = main(["tune", "--smoke", "--results", results, "--save", model])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "tune smoke ok" in out
+    assert "winner:" in out
+
+    # info recognises the tune artifact and pretty-prints the table
+    rc = main(["info", results])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "winner:" in out and "EVALUATED" in out and "grid=2x2" in out
+
+    # ... and still describes model files
+    rc = main(["info", model])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "model: binary" in out and "SV count:" in out
+
+    # ... and still prints backend info with no path
+    rc = main(["info"])
+    assert rc == 0
+    assert "backend:" in capsys.readouterr().out
+
+
+def test_cli_tune_rejects_half_grid():
+    from tpusvm.cli import main
+
+    with pytest.raises(SystemExit, match="both --C-grid and --gamma-grid"):
+        main(["tune", "--synthetic", "rings", "--n", "64",
+              "--C-grid", "1,2"])
+
+
+def test_cli_info_rejects_unknown_artifact(tmp_path):
+    from tpusvm.cli import main
+
+    bogus = str(tmp_path / "bogus.bin")
+    open(bogus, "wb").write(b"not an artifact")
+    with pytest.raises(SystemExit, match="neither a tune-results"):
+        main(["info", bogus])

@@ -78,27 +78,36 @@ def _build_parser() -> argparse.ArgumentParser:
     add_shared(p, suppress=False)
     sub = p.add_subparsers(dest="command", required=True)
 
+    def add_data_source(parser):
+        """The --train/--synthetic source group, shared by train and tune."""
+        src = parser.add_argument_group(
+            "data source (one of --train / --synthetic)")
+        src.add_argument("--train", metavar="CSV",
+                         help="training CSV (last column = label)")
+        src.add_argument("--test", metavar="CSV",
+                         help="held-out CSV to evaluate on")
+        src.add_argument(
+            "--synthetic",
+            choices=["mnist-like", "blobs", "rings"],
+            help="generate a deterministic synthetic dataset instead of "
+            "reading CSVs",
+        )
+        src.add_argument("--n", type=int, default=60000,
+                         help="synthetic train size (default 60000)")
+        src.add_argument("--n-test", type=int, default=10000,
+                         help="synthetic test size (default 10000)")
+        src.add_argument("--d", type=int, default=784,
+                         help="synthetic feature count (default 784)")
+        src.add_argument("--seed", type=int, default=587,
+                         help="synthetic data seed")
+        src.add_argument(
+            "--n-limit", type=int, default=None, metavar="N",
+            help="cap training rows (the reference's gpu_svm_main4 argv[1])",
+        )
+
     tr = sub.add_parser("train", parents=[common],
                         help="train a model and optionally evaluate")
-    src = tr.add_argument_group("data source (one of --train / --synthetic)")
-    src.add_argument("--train", metavar="CSV", help="training CSV (last column = label)")
-    src.add_argument("--test", metavar="CSV", help="held-out CSV to evaluate on")
-    src.add_argument(
-        "--synthetic",
-        choices=["mnist-like", "blobs", "rings"],
-        help="generate a deterministic synthetic dataset instead of reading CSVs",
-    )
-    src.add_argument("--n", type=int, default=60000,
-                     help="synthetic train size (default 60000)")
-    src.add_argument("--n-test", type=int, default=10000,
-                     help="synthetic test size (default 10000)")
-    src.add_argument("--d", type=int, default=784,
-                     help="synthetic feature count (default 784)")
-    src.add_argument("--seed", type=int, default=587, help="synthetic data seed")
-    src.add_argument(
-        "--n-limit", type=int, default=None, metavar="N",
-        help="cap training rows (the reference's gpu_svm_main4 argv[1])",
-    )
+    add_data_source(tr)
 
     mode = tr.add_argument_group("training mode")
     mode.add_argument(
@@ -128,6 +137,11 @@ def _build_parser() -> argparse.ArgumentParser:
                       "star = mpi_svm_main2)")
     mode.add_argument("--shards", type=int, default=None,
                       help="cascade shard count P (default: all local devices)")
+    mode.add_argument("--stratify", action="store_true",
+                      help="cascade: per-class round-robin sharding instead "
+                      "of the reference's contiguous scatter (safe on "
+                      "label-sorted input, which otherwise hands a leaf a "
+                      "single-class shard)")
     mode.add_argument("--sv-capacity", type=int, default=4096,
                       help="padded SV buffer capacity per shard")
     mode.add_argument("--checkpoint", metavar="NPZ",
@@ -218,8 +232,87 @@ def _build_parser() -> argparse.ArgumentParser:
     sv.add_argument("--smoke-requests", type=int, default=32,
                     help="requests per smoke thread")
 
-    sub.add_parser("info", parents=[common],
-                   help="print device / backend information")
+    tu = sub.add_parser(
+        "tune", parents=[common],
+        help="cross-validated (C, gamma) search with warm-started fits "
+        "(tpusvm.tune); trains the winner on the full data")
+    add_data_source(tu)
+    tu.set_defaults(multiclass=False)  # _load_train_data reads it
+
+    space = tu.add_argument_group("search space")
+    space.add_argument("--C-grid", metavar="LIST", dest="C_grid",
+                       help="comma-separated C values (overrides "
+                       "--center-C/--span/--step)")
+    space.add_argument("--gamma-grid", metavar="LIST",
+                       help="comma-separated gamma values")
+    space.add_argument("--center-C", type=float, default=10.0,
+                       help="log-grid center C when --C-grid is absent "
+                       "(default: the reference's MNIST constant)")
+    space.add_argument("--center-gamma", type=float, default=0.00125,
+                       help="log-grid center gamma when --gamma-grid is "
+                       "absent")
+    space.add_argument("--span", type=int, default=2,
+                       help="log grid: steps each side of the center "
+                       "(grid edge = 2*span+1)")
+    space.add_argument("--step", type=float, default=4.0,
+                       help="log grid: multiplicative step per cell")
+
+    sched = tu.add_argument_group("schedule")
+    sched.add_argument("--folds", type=int, default=3,
+                       help="stratified CV folds (default 3)")
+    sched.add_argument("--fold-seed", type=int, default=0,
+                       help="fold split / rung subset shuffle seed")
+    sched.add_argument("--schedule", choices=["grid", "halving"],
+                       default="grid")
+    sched.add_argument("--eta", type=int, default=3,
+                       help="halving: rung growth factor and survivor "
+                       "fraction denominator")
+    sched.add_argument("--min-rung", type=int, default=256,
+                       help="halving: smallest rung subset size")
+    sched.add_argument("--no-warm-start", action="store_true",
+                       help="fit every point cold (the benchmark's "
+                       "control arm)")
+    sched.add_argument("--patience", type=int, default=None,
+                       help="grid: stop after this many consecutive "
+                       "non-improving points")
+    sched.add_argument("--plateau-tol", type=float, default=0.0,
+                       help="minimum CV-accuracy gain that resets "
+                       "--patience")
+
+    hp2 = tu.add_argument_group("numerics (defaults = reference constants)")
+    hp2.add_argument("--tau", type=float, default=1e-5)
+    hp2.add_argument("--eps", type=float, default=1e-12)
+    hp2.add_argument("--sv-tol", type=float, default=1e-8)
+    hp2.add_argument("--max-iter", type=int, default=100000)
+    hp2.add_argument("--dtype", choices=["float32", "bfloat16", "float64"],
+                     default="float32")
+    hp2.add_argument(
+        "--accum", choices=["none", "float64"], default="float64",
+        help="solver accumulator dtype (see train --accum)")
+    hp2.add_argument("--no-scale", action="store_true",
+                     help="skip min-max feature scaling")
+    hp2.add_argument(
+        "--solver-opt", action="append", default=[], metavar="KEY=VALUE",
+        help="extra static blocked-solver knob, repeatable "
+        "(e.g. --solver-opt q=256)")
+
+    out2 = tu.add_argument_group("output")
+    out2.add_argument("--results", metavar="JSON",
+                      help="write the versioned TuneResult table here")
+    out2.add_argument("--save", metavar="NPZ",
+                      help="save the winner model trained on the full data")
+    out2.add_argument("--smoke", action="store_true",
+                      help="CI gate: tiny grid, 2 folds, synthetic rings, "
+                      "then assert every fit converged, warm seeding "
+                      "engaged, and the winner model beats chance")
+    out2.add_argument("-q", "--quiet", action="store_true")
+
+    inf = sub.add_parser("info", parents=[common],
+                         help="print device / backend information, or "
+                         "describe a model / tune-results artifact")
+    inf.add_argument("path", nargs="?", default=None,
+                     help="optional artifact: a model .npz or a tune "
+                     "results .json (auto-detected)")
     return p
 
 
@@ -352,7 +445,7 @@ def _cmd_train(args) -> int:
         # arrays and the hyperparameters with dedicated CLI flags are not
         # --solver-opt material (passing them twice would TypeError in fit)
         flagged = {"C", "gamma", "eps", "tau", "max_iter", "accum_dtype"}
-        reserved = {"X", "Y", "valid", "alpha0"} | flagged
+        reserved = {"X", "Y", "valid", "alpha0", "sn"} | flagged
         known = set(inspect.signature(fn).parameters) - reserved
         bad = sorted(set(solver_opts) - known)
         if bad:
@@ -370,6 +463,9 @@ def _cmd_train(args) -> int:
     if args.checkpoint and args.mode != "cascade":
         raise SystemExit("--checkpoint/--resume only apply to --mode cascade "
                          "(per-round cascade state is what gets persisted)")
+    if args.stratify and args.mode != "cascade":
+        raise SystemExit("--stratify only applies to --mode cascade (it "
+                         "changes how shards are dealt over the mesh)")
 
     log = RunLogger(jsonl_path=args.jsonl,
                     primary=(jax.process_index() == 0) and not args.quiet)
@@ -411,7 +507,8 @@ def _cmd_train(args) -> int:
                                    topology=args.topology)
                 model.fit_cascade(X, Y, cc, verbose=not args.quiet,
                                   checkpoint_path=args.checkpoint,
-                                  resume=args.resume)
+                                  resume=args.resume,
+                                  stratified=args.stratify)
                 log.info("cascade: %d rounds, converged = %s",
                          model.cascade_rounds_,
                          model.status_.name == "CONVERGED")
@@ -601,7 +698,161 @@ def _serve_smoke(server, n_threads: int, n_requests: int) -> int:
     return 0
 
 
+def _cmd_tune(args) -> int:
+    import dataclasses
+
+    import jax.numpy as jnp
+
+    from tpusvm.config import SVMConfig
+    from tpusvm.models import BinarySVC
+    from tpusvm.status import TuneStatus
+    from tpusvm.tune import (
+        TuneConfig,
+        format_table,
+        log_grid,
+        make_grid,
+        save_tune_result,
+        tune,
+    )
+    from tpusvm.utils import PhaseTimer
+
+    if args.smoke:
+        # the CI gate shape: tiny, CPU-friendly, deterministic — 2 folds,
+        # a 2x2 grid bracketing the rings problem's good region, so the
+        # whole run (including the winner's full-data retrain) is seconds
+        args.synthetic, args.train, args.test = "rings", None, None
+        args.n, args.n_test, args.n_limit = 240, 60, None
+        args.folds, args.fold_seed = 2, 0
+        args.C_grid, args.gamma_grid = "1,8", "1,8"
+        args.schedule = "grid"
+
+    if args.C_grid or args.gamma_grid:
+        if not (args.C_grid and args.gamma_grid):
+            raise SystemExit("tune: pass both --C-grid and --gamma-grid "
+                             "(or neither, for the log grid around "
+                             "--center-C/--center-gamma)")
+        grid = make_grid([float(v) for v in args.C_grid.split(",")],
+                         [float(v) for v in args.gamma_grid.split(",")])
+    else:
+        grid = log_grid(args.center_C, args.center_gamma,
+                        span=args.span, step=args.step)
+
+    base = SVMConfig(tau=args.tau, eps=args.eps, sv_tol=args.sv_tol,
+                     max_iter=args.max_iter)
+    config = TuneConfig(
+        folds=args.folds, seed=args.fold_seed, schedule=args.schedule,
+        eta=args.eta, min_rung=args.min_rung,
+        warm_start=not args.no_warm_start, patience=args.patience,
+        plateau_tol=args.plateau_tol,
+    )
+
+    import warnings
+
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore", UserWarning)
+        from tpusvm.config import resolve_accum_dtype
+
+        accum = resolve_accum_dtype(
+            "auto" if args.accum == "float64" else None
+        )
+
+    timer = PhaseTimer()
+    with timer.phase("data"):
+        X, Y, Xt, Yt = _load_train_data(args)
+    say = (lambda msg: None) if args.quiet else print
+    say(f"n = {X.shape[0]}, n_features = {X.shape[1]}, "
+        f"grid = {grid.shape[0]}x{grid.shape[1]}, folds = {args.folds}, "
+        f"schedule = {args.schedule}")
+
+    with timer.phase("search"):
+        result = tune(
+            X, Y, grid, config, base=base, dtype=getattr(jnp, args.dtype),
+            accum_dtype=accum, scale=not args.no_scale,
+            solver_opts=_parse_solver_opts(args.solver_opt),
+            log_fn=(lambda msg: None) if args.quiet else print,
+        )
+    print(format_table(result))
+    if args.results:
+        save_tune_result(args.results, result)
+        say(f"results written to {args.results}")
+
+    # the winner becomes a normal model: full-data fit with the winning
+    # point, saved in the standard .npz format
+    win_cfg = dataclasses.replace(base, C=result.winner["C"],
+                                  gamma=result.winner["gamma"])
+    model = BinarySVC(config=win_cfg, dtype=getattr(jnp, args.dtype),
+                      scale=not args.no_scale)
+    with timer.phase("final-train"):
+        model.fit(X, Y)
+    say(f"winner model: {model.n_support_} SVs, "
+        f"status {model.status_.name}")
+    test_acc = None
+    if Xt is not None and len(Xt):
+        test_acc = model.score(Xt, Yt)
+        say(f"held-out accuracy = {test_acc:.4f}")
+    if args.save:
+        model.save(args.save)
+        say(f"model saved to {args.save}")
+    say(timer.report())
+
+    if args.smoke:
+        evaluated = [r for r in result.points
+                     if r["status"] == TuneStatus.EVALUATED.name]
+        # beyond the very first point every fold fit must have found a
+        # warm seed; a regression that silently runs everything cold
+        # would still "pass" on accuracy alone
+        warm_ok = all(r["warm_seeded"] == args.folds
+                      for r in evaluated[1:])
+        acc_ok = all(r["cv_accuracy"] is not None
+                     and r["cv_accuracy"] > 0.5 for r in evaluated)
+        final_ok = test_acc is not None and test_acc > 0.8
+        if not (warm_ok and acc_ok and final_ok):
+            print(f"TUNE SMOKE FAILED: warm_ok={warm_ok} acc_ok={acc_ok} "
+                  f"final_ok={final_ok} (test_acc={test_acc})")
+            return 1
+        print(f"tune smoke ok: {len(evaluated)} points, "
+              f"winner C={result.winner['C']:g} "
+              f"gamma={result.winner['gamma']:g}, "
+              f"test_acc={test_acc:.4f}")
+    return 0
+
+
+def _info_artifact(path: str) -> int:
+    """`tpusvm info <path>`: describe a tune-results JSON or a model .npz."""
+    from tpusvm.tune import format_table, is_tune_result, load_tune_result
+
+    if is_tune_result(path):
+        print(format_table(load_tune_result(path)))
+        return 0
+    from tpusvm.models.serialization import is_multiclass_model, load_model
+
+    try:
+        multiclass = is_multiclass_model(path)
+    except (OSError, ValueError) as e:
+        raise SystemExit(
+            f"info: {path!r} is neither a tune-results JSON nor a "
+            f"readable model artifact ({e})"
+        )
+    state, config = load_model(path)
+    kind = "multiclass (one-vs-rest)" if multiclass else "binary"
+    print(f"model: {kind}")
+    if multiclass:
+        print(f"classes: {state['classes'].tolist()}")
+        print(f"SV union: {state['sv_X'].shape[0]}")
+        print(f"n_features: {state['sv_X'].shape[1]}")
+    else:
+        print(f"SV count: {len(state['sv_alpha'])}")
+        print(f"n_features: {state['sv_X'].shape[1]}")
+        print(f"b = {float(state['b']):.15f}")
+    print(f"config: C={config.C:g} gamma={config.gamma:g} "
+          f"tau={config.tau:g} sv_tol={config.sv_tol:g}")
+    print(f"scaled: {bool(state.get('scale', False))}")
+    return 0
+
+
 def _cmd_info(args) -> int:
+    if args.path:
+        return _info_artifact(args.path)
     import jax
 
     print(f"jax {jax.__version__}")
@@ -646,7 +897,8 @@ def main(argv=None) -> int:
             kw["process_id"] = args.process_id
         jax.distributed.initialize(**kw)
     return {"train": _cmd_train, "predict": _cmd_predict,
-            "serve": _cmd_serve, "info": _cmd_info}[args.command](args)
+            "serve": _cmd_serve, "tune": _cmd_tune,
+            "info": _cmd_info}[args.command](args)
 
 
 if __name__ == "__main__":

@@ -1,4 +1,4 @@
-"""Contiguous data partitioning with global IDs.
+"""Data partitioning with global IDs: contiguous (reference) or stratified.
 
 Reference: the MPI scatter (mpi_svm_main3.cpp:463-518) splits the dataset into
 P contiguous chunks of ceil(n/P) rows each (the last chunk may be short) and
@@ -9,6 +9,15 @@ On TPU there is no scatter: the partition is expressed as a padded (P, cap, d)
 array + validity mask, which is then laid out over the mesh with a
 NamedSharding so each mesh member holds exactly one chunk. Padding keeps
 shapes static for XLA (SURVEY.md §7.3 "Dynamic shapes").
+
+The contiguous split is reference-faithful but class-blind: on label-sorted
+input it hands cascade leaves single-class (or class-starved) shards, whose
+solves die NO_WORKING_SET — the exact shape the `pallas-mp-adv` parity fuzz
+constructs deliberately (block-sorted labels). `stratified=True` deals each
+class's rows round-robin over the shards instead, so every shard carries
+both classes at near the global ratio regardless of input order; global IDs
+are unchanged (still the original row indices), so dedup-by-ID and the
+convergence test are oblivious to which split produced the shards.
 """
 
 from __future__ import annotations
@@ -35,30 +44,57 @@ class Partition(NamedTuple):
     count: np.ndarray
 
 
-def partition(X: np.ndarray, Y: np.ndarray, n_shards: int) -> Partition:
-    """Split (X, Y) into n_shards contiguous ceil(n/P)-row padded chunks.
-
-    Like the reference's scatter, trailing shards can be short — or entirely
-    empty when n < n_shards * ceil(n/n_shards) by a full chunk. Empty shards
-    solve to NO_WORKING_SET with an empty SV set; the cascade layer masks
-    them out of merges, so they are harmless there, but callers running
-    per-shard solves directly should check `count` first.
-    """
+def _fill(X: np.ndarray, Y: np.ndarray, n_shards: int, cap: int,
+          shard_rows) -> Partition:
     n, d = X.shape
-    cap = -(-n // n_shards)  # ceil
     Xp = np.zeros((n_shards, cap, d), X.dtype)
     Yp = np.zeros((n_shards, cap), np.int32)
     ids = np.full((n_shards, cap), -1, np.int32)
     valid = np.zeros((n_shards, cap), bool)
     count = np.zeros((n_shards,), np.int32)
-    for p in range(n_shards):
-        lo = p * cap
-        hi = min(lo + cap, n)
-        c = max(hi - lo, 0)
+    for p, rows in enumerate(shard_rows):
+        c = len(rows)
         if c:
-            Xp[p, :c] = X[lo:hi]
-            Yp[p, :c] = Y[lo:hi]
-            ids[p, :c] = np.arange(lo, hi, dtype=np.int32)
+            idx = np.asarray(rows, np.int32)
+            Xp[p, :c] = X[idx]
+            Yp[p, :c] = Y[idx]
+            ids[p, :c] = idx
             valid[p, :c] = True
         count[p] = c
     return Partition(Xp, Yp, ids, valid, count)
+
+
+def partition(X: np.ndarray, Y: np.ndarray, n_shards: int,
+              stratified: bool = False) -> Partition:
+    """Split (X, Y) into n_shards padded chunks with global IDs.
+
+    stratified=False (default): the reference's contiguous ceil(n/P)-row
+    scatter — trailing shards can be short, or entirely empty when
+    n < n_shards * ceil(n/n_shards) by a full chunk. Empty shards solve to
+    NO_WORKING_SET with an empty SV set; the cascade layer masks them out
+    of merges, so they are harmless there, but callers running per-shard
+    solves directly should check `count` first.
+
+    stratified=True: per-class round-robin — class c's rows (in original
+    order) are dealt one at a time over the shards, with the starting
+    shard staggered per class so the "one extra row" remainders of
+    different classes don't all pile onto shard 0. Shard sizes stay within
+    one row per class of each other; cap is the realised maximum, so the
+    padded width can differ from the contiguous split's ceil(n/P) by at
+    most (n_classes - 1). Row order within a shard interleaves classes —
+    irrelevant to the solver, which is order-free over the validity mask.
+    """
+    n, d = X.shape
+    if not stratified:
+        cap = -(-n // n_shards)  # ceil
+        shard_rows = [range(p * cap, min(p * cap + cap, n))
+                      if p * cap < n else range(0)
+                      for p in range(n_shards)]
+        return _fill(X, Y, n_shards, cap, shard_rows)
+
+    shard_rows = [[] for _ in range(n_shards)]
+    for ci, c in enumerate(np.unique(Y)):
+        for j, i in enumerate(np.flatnonzero(Y == c)):
+            shard_rows[(ci + j) % n_shards].append(int(i))
+    cap = max(1, max(len(rows) for rows in shard_rows))
+    return _fill(X, Y, n_shards, cap, shard_rows)

@@ -143,6 +143,7 @@ class BinarySVC:
         verbose: bool = False,
         checkpoint_path: Optional[str] = None,
         resume: bool = False,
+        stratified: bool = False,
     ) -> "BinarySVC":
         """Distributed cascade training over a device mesh (MPI capability).
 
@@ -151,7 +152,11 @@ class BinarySVC:
         the reference-faithful trajectory).
 
         checkpoint_path/resume: persist per-round cascade state and restart
-        from it (parallel.cascade.cascade_fit)."""
+        from it (parallel.cascade.cascade_fit).
+
+        stratified: per-class round-robin sharding instead of the
+        reference's contiguous scatter — safe on label-sorted input
+        (parallel.cascade.cascade_fit)."""
         t0 = time.perf_counter()
         Xs = self._scale_fit(np.asarray(X))
         res = cascade_fit(
@@ -160,6 +165,7 @@ class BinarySVC:
             accum_dtype=self.accum_dtype, verbose=verbose,
             checkpoint_path=checkpoint_path, resume=resume,
             solver=self.solver, solver_opts=self.solver_opts,
+            stratified=stratified,
         )
         self.train_time_s_ = time.perf_counter() - t0
         self.sv_X_ = res.sv_X

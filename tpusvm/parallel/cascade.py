@@ -402,6 +402,7 @@ def cascade_fit(
     resume: bool = False,
     solver: str = "pair",
     solver_opts: Optional[dict] = None,
+    stratified: bool = False,
 ) -> CascadeResult:
     """Train a binary SVM with the distributed cascade.
 
@@ -425,6 +426,12 @@ def cascade_fit(
     stopping criterion, so the cascade's SV-set fixed point is unchanged.
     solver_opts: extra static solver knobs (blocked: q, max_outer,
     max_inner).
+
+    stratified: deal each class round-robin over the shards instead of
+    the reference's contiguous scatter (data.partition) — label-sorted
+    input then cannot hand a leaf a single-class shard (whose solve dies
+    NO_WORKING_SET). Global IDs are original row indices either way, so
+    the dedup-by-ID merges and the ID-set convergence test are unchanged.
     """
     if solver not in ("pair", "blocked"):
         raise ValueError(f"unknown solver {solver!r}")
@@ -435,7 +442,8 @@ def cascade_fit(
         mesh = make_mesh(n_shards)
     sv_cap = cc.sv_capacity
 
-    part = make_partition(np.asarray(X), np.asarray(Y), n_shards)
+    part = make_partition(np.asarray(X), np.asarray(Y), n_shards,
+                          stratified=stratified)
     chunk = part.X.shape[1]
     d = part.X.shape[2]
     train_cap = chunk + sv_cap

@@ -40,6 +40,7 @@ from typing import NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
 from tpusvm.config import pallas_flag_errors
@@ -55,6 +56,28 @@ _PALLAS_LANE = 128
 def _clamp_q(n: int, q: int) -> int:
     """q clamps to the (even) training-set size; tiny n floors at 2."""
     return min(q, n if n % 2 == 0 else n - 1) if n >= 2 else 2
+
+
+def pad_alpha0(alpha, n: int):
+    """Resize a previous solution's alphas to n rows for a warm re-solve.
+
+    The resume-shape helper for warm starts across problem sizes: a donor
+    solution transfers to a GROWN training set (successive-halving rungs
+    are nested prefixes of one fixed row order, tpusvm.tune) by giving the
+    new rows alpha=0 — exactly the state cold SMO would start them in —
+    and to a truncated set by dropping the tail rows' alphas. Works on
+    numpy and jax arrays alike (returns the same family it was given);
+    note truncation generally breaks the dual equality constraint
+    sum(alpha*y)=0, so callers should re-project the seed feasible
+    (tpusvm.tune.warm.feasible_seed) before passing it as alpha0.
+    """
+    m = alpha.shape[0]
+    if m == n:
+        return alpha
+    if m > n:
+        return alpha[:n]
+    xp = jnp if isinstance(alpha, jax.Array) else np
+    return xp.concatenate([alpha, xp.zeros((n - m,), alpha.dtype)])
 
 
 def resolve_solver_config(n: int, q: int = 1024, inner: str = "auto",
@@ -303,6 +326,7 @@ def blocked_smo_solve(
     valid: Optional[jax.Array] = None,
     alpha0: Optional[jax.Array] = None,
     *,
+    sn: Optional[jax.Array] = None,
     C: float = 10.0,
     gamma: float = 0.00125,
     eps: float = 1e-12,
@@ -330,6 +354,15 @@ def blocked_smo_solve(
     max_iter as a bound on total alpha updates — checked between outer
     rounds, so it can overshoot by at most max_inner); n_iter counts total
     inner alpha updates + 1. q is clamped to n.
+
+    sn: optional precomputed per-row squared norms sq_norms(X), shape (n,).
+    The solver needs them every outer round (the distance-dot trick of the
+    f update); callers fitting MANY models on the SAME rows — the tune
+    driver sweeps a whole (C, gamma) grid per fold — pass the cached
+    vector so each fit skips its own O(n*d) X stream. The values feed the
+    same rbf_cross_matvec every fit uses, so passing a correct cache
+    changes nothing numerically; passing norms of DIFFERENT rows is
+    undefined behaviour, exactly like a wrong alpha0.
 
     Defaults (q=1024, max_inner=1024) were tuned on the MNIST-shaped 60k
     benchmark: larger working sets amortise the outer O(n*d*q) update over
@@ -522,7 +555,9 @@ def blocked_smo_solve(
     f0 = jnp.where(valid, f0, 0.0)
 
     # hoisted out of the outer loop: one X stream per solve, not per round
-    sn = sq_norms(X)
+    # (or zero, when the caller supplied its fold-level cache)
+    if sn is None:
+        sn = sq_norms(X)
 
     refine_cap = min(refine, n) if refine > 0 else 0
 

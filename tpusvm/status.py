@@ -53,3 +53,25 @@ class ServeStatus(enum.IntEnum):
     QUEUE_FULL = 2
     ERROR = 3
     SHUTDOWN = 4
+
+
+class TuneStatus(enum.IntEnum):
+    """Per-grid-point outcome codes for hyperparameter search (tpusvm.tune).
+
+    A tune run's result table records every point of the search space with
+    one of these, so "this point has no CV accuracy" is always explained
+    by the schedule that produced it rather than left as a null to guess
+    about:
+
+      EVALUATED  fit and scored on every fold at the FINAL rung (grid
+                 schedule: all points; halving: the last survivors —
+                 the winner is always one of these)
+      PRUNED     successive halving dropped it after a smaller-rung
+                 evaluation; its recorded metrics are from that rung
+      SKIPPED    plateau early-stopping ended the sweep before this point
+                 was ever fit; no metrics recorded
+    """
+
+    EVALUATED = 0
+    PRUNED = 1
+    SKIPPED = 2

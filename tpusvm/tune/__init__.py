@@ -1,0 +1,50 @@
+"""Hyperparameter search for the TPU-native SVM stack (`tpusvm tune`).
+
+The reference project hard-codes a single (C, gamma) pair per dataset
+(main3.cpp:308-347) and validates it by cross-implementation parity alone;
+model selection happens off-stage. This package makes it a first-class,
+benchmarked workload built out of capabilities the codebase already has:
+
+  - `folds`   — deterministic, stratified k-fold splitting (generalises the
+    cascade's contiguous `data.partition` to label-balanced validation
+    splits);
+  - `grid`    — the (C, gamma) search space: explicit value lists, snake
+    traversal order, log-space geometry;
+  - `warm`    — the warm-start policy: seed each point's alphas from its
+    nearest already-solved neighbour in log-(C, gamma) space, made feasible
+    for the new box constraint (the same dormant solver capability the
+    cascade uses when feeding SVs up the merge tree,
+    `blocked_smo_solve(alpha0=..., warm_start=True)`);
+  - `search`  — the driver: grid and successive-halving schedules over
+    fold x point fits with shared per-fold artifact caches (scaled X, row
+    norms) and plateau early-stopping;
+  - `results` — the versioned `TuneResult` JSON artifact (winner, per-point
+    table, update counts) in the house format-versioned persistence style
+    (`models/serialization.py`).
+"""
+
+from tpusvm.tune.folds import Fold, stratified_kfold
+from tpusvm.tune.grid import GridSpec, log_grid, make_grid
+from tpusvm.tune.results import (
+    TuneResult,
+    format_table,
+    is_tune_result,
+    load_tune_result,
+    save_tune_result,
+)
+from tpusvm.tune.search import TuneConfig, tune
+
+__all__ = [
+    "Fold",
+    "stratified_kfold",
+    "GridSpec",
+    "log_grid",
+    "make_grid",
+    "TuneConfig",
+    "tune",
+    "TuneResult",
+    "format_table",
+    "save_tune_result",
+    "load_tune_result",
+    "is_tune_result",
+]

@@ -1,0 +1,297 @@
+"""The tune driver: fold x point scheduling, shared caches, warm chaining.
+
+Two schedules over a GridSpec:
+
+  - "grid": every point, full training folds, in snake order (so each fit
+    warm-starts from an adjacent solved point), with optional plateau
+    early-stopping;
+  - "halving": successive halving over data-subset rungs (Li et al. 2018,
+    Hyperband's inner loop): all points are fit on a small stratified
+    subset first, the best 1/eta survive to an eta-times-larger subset,
+    repeating until the full fold — so hopeless corners of the grid cost a
+    small-rung fit instead of a full one. Rung subsets are nested prefixes
+    of each fold's fixed shuffled row order, which makes a point's
+    previous-rung solution a valid (zero-padded) warm seed for its next
+    rung.
+
+Cost structure the driver is built around (what "embarrassingly parallel in
+exactly the ways this codebase is already good at" means concretely):
+
+  - per fold, the scaled training matrix, its row norms (sq_norms), and the
+    scaled validation side are computed ONCE and reused by every fit and
+    every evaluation at every grid point — a gamma sweep at fixed fold
+    re-streams zero feature bytes for setup (the norms thread into
+    `blocked_smo_solve(sn=...)` and `rbf_cross(snA=, snB=)`);
+  - the k fold fits of a point are dispatched before any result is
+    materialised (JAX dispatch is async), so they pipeline on device and
+    overlap with each other instead of running strictly back-to-back;
+  - every rung uses ONE uniform subset size across folds (the minimum of
+    the per-fold cap), so each rung compiles the solver exactly once
+    instead of once per ±1-row fold-size variant.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from tpusvm.config import SVMConfig, resolve_accum_dtype
+from tpusvm.data.scaler import MinMaxScaler
+from tpusvm.ops.rbf import rbf_cross, sq_norms
+from tpusvm.solver.blocked import blocked_smo_solve
+from tpusvm.status import Status, TuneStatus
+from tpusvm.tune.folds import Fold, stratified_kfold
+from tpusvm.tune.grid import GridSpec
+from tpusvm.tune.results import TuneResult
+from tpusvm.tune.warm import WarmStore
+
+
+@dataclasses.dataclass(frozen=True)
+class TuneConfig:
+    """Search-level knobs (the per-point SVM hyperparameters come from the
+    grid; numerical tolerances from the base SVMConfig passed to tune()).
+
+    Attributes:
+      folds: stratified CV fold count k (>= 2).
+      seed: fold-split / rung-subset shuffle seed — the whole run is a
+        pure function of (data, grid, config), so cold/warm A/Bs compare
+        identical problems.
+      schedule: "grid" or "halving".
+      eta: halving aggressiveness — rung subsets grow by eta, the best
+        ceil(1/eta) fraction of points survives each rung (>= 2).
+      min_rung: smallest rung subset size (halving); rungs run
+        min_rung, min_rung*eta, ..., full fold.
+      warm_start: seed each fit from the nearest solved neighbour /
+        previous rung (tpusvm.tune.warm); False = every fit cold — the
+        benchmark's control arm.
+      patience: grid schedule only — stop the sweep after this many
+        consecutive points that fail to improve the best CV accuracy by
+        more than plateau_tol (None = sweep every point). Unvisited
+        points are recorded as SKIPPED. Ignored by halving (its pruning
+        already bounds the cost of bad points).
+      plateau_tol: minimum improvement that resets the patience counter.
+    """
+
+    folds: int = 3
+    seed: int = 0
+    schedule: str = "grid"
+    eta: int = 3
+    min_rung: int = 256
+    warm_start: bool = True
+    patience: Optional[int] = None
+    plateau_tol: float = 0.0
+
+    def __post_init__(self):
+        if self.schedule not in ("grid", "halving"):
+            raise ValueError(
+                f"schedule must be grid|halving, got {self.schedule!r}"
+            )
+        if self.folds < 2:
+            raise ValueError(f"folds must be >= 2, got {self.folds}")
+        if self.eta < 2:
+            raise ValueError(f"eta must be >= 2, got {self.eta}")
+        if self.min_rung < 2:
+            raise ValueError(f"min_rung must be >= 2, got {self.min_rung}")
+        if self.patience is not None and self.patience < 1:
+            raise ValueError(f"patience must be >= 1, got {self.patience}")
+
+
+class _FoldCache:
+    """Per-fold shared artifacts: scaled X (train fold order / val), row
+    norms, labels. Built once; every grid point's fit and eval reuse the
+    same device arrays, and rung subsets are prefix slices (so even the
+    norms cache is shared across rungs)."""
+
+    def __init__(self, X: np.ndarray, Y: np.ndarray, fold: Fold, dtype,
+                 scale: bool):
+        Xtr = X[fold.train_idx]
+        Xval = X[fold.val_idx]
+        if scale:
+            scaler = MinMaxScaler().fit(Xtr)
+            Xtr = scaler.transform(Xtr)
+            Xval = scaler.transform(Xval)
+        self.Xtr = jnp.asarray(Xtr, dtype)
+        self.Ytr = jnp.asarray(Y[fold.train_idx])
+        self.Ytr_host = np.asarray(Y[fold.train_idx])
+        self.sn = sq_norms(self.Xtr)          # one X stream, whole sweep
+        self.Xval = jnp.asarray(Xval, dtype)
+        self.sn_val = sq_norms(self.Xval)
+        self.Yval = np.asarray(Y[fold.val_idx])
+        self.n_train = len(fold.train_idx)
+
+
+def _rung_sizes(n_full: int, min_rung: int, eta: int) -> List[int]:
+    if min_rung >= n_full:
+        return [n_full]
+    sizes = []
+    s = min_rung
+    while s < n_full:
+        sizes.append(s)
+        s *= eta
+    sizes.append(n_full)
+    return sizes
+
+
+def _point_row(C: float, gamma: float) -> Dict[str, Any]:
+    return {
+        "C": C, "gamma": gamma, "status": TuneStatus.SKIPPED.name,
+        "rung": -1, "n_subset": 0, "cv_accuracy": None,
+        "fold_accuracy": [], "sv_count": None, "n_updates": 0,
+        "wall_s": 0.0, "warm_seeded": 0,
+    }
+
+
+def tune(
+    X: np.ndarray,
+    Y: np.ndarray,
+    grid: GridSpec,
+    config: TuneConfig = TuneConfig(),
+    *,
+    base: SVMConfig = SVMConfig(),
+    dtype=jnp.float32,
+    accum_dtype="auto",
+    scale: bool = True,
+    solver_opts: Optional[dict] = None,
+    log_fn: Optional[Callable[[str], None]] = None,
+) -> TuneResult:
+    """Cross-validated search over `grid`; returns the TuneResult table.
+
+    base: numerical-tolerance donor (tau/eps/sv_tol/max_iter); its C and
+    gamma are ignored — the grid supplies those per point. Fits use the
+    blocked solver with the fold's cached row norms; extra static knobs
+    (q, max_inner, ...) pass through solver_opts.
+    """
+    X = np.asarray(X)
+    Y = np.asarray(Y)
+    accum = resolve_accum_dtype(accum_dtype)
+    opts = dict(solver_opts or {})
+    say = log_fn or (lambda msg: None)
+    t_run = time.perf_counter()
+
+    folds = stratified_kfold(Y, config.folds, seed=config.seed)
+    caches = [_FoldCache(X, Y, f, dtype, scale) for f in folds]
+    n_full = min(c.n_train for c in caches)  # uniform rung cap: one
+    # compiled solver shape per rung instead of one per ±1-row fold size
+    points = grid.points()
+    rows = [_point_row(C, g) for C, g in points]
+    store = WarmStore()
+
+    def fit_point(pi: int, m: int, rung: int) -> Dict[str, Any]:
+        """All k fold fits of one point at rung size m: seeds first, then
+        every solve dispatched, then one materialisation pass."""
+        C, gamma = points[pi]
+        row = rows[pi]
+        t0 = time.perf_counter()
+        seeds = []
+        if config.warm_start:
+            for fi, c in enumerate(caches):
+                seeds.append(store.seed(fi, points[pi], m,
+                                        c.Ytr_host[:m], C))
+        else:
+            seeds = [None] * len(caches)
+        results = []
+        for c, seed in zip(caches, seeds):
+            alpha0 = None if seed is None else jnp.asarray(seed, accum)
+            results.append(blocked_smo_solve(
+                c.Xtr[:m], c.Ytr[:m], alpha0=alpha0,
+                warm_start=seed is not None, sn=c.sn[:m],
+                C=C, gamma=gamma, eps=base.eps, tau=base.tau,
+                max_iter=base.max_iter, accum_dtype=accum, **opts,
+            ))
+        accs, svs, updates = [], [], 0
+        for fi, (c, res) in enumerate(zip(caches, results)):
+            alpha = np.asarray(res.alpha)  # completion barrier
+            store.record(fi, points[pi], alpha)
+            coef = jnp.asarray(alpha * c.Ytr_host[:m], dtype)
+            scores = np.asarray(
+                rbf_cross(c.Xval, c.Xtr[:m], gamma,
+                          snA=c.sn_val, snB=c.sn[:m]) @ coef
+                - jnp.asarray(res.b, dtype)
+            )
+            pred = np.where(scores > 0, 1, -1)
+            accs.append(float((pred == c.Yval).mean()))
+            svs.append(int((alpha > base.sv_tol).sum()))
+            updates += int(res.n_iter) - 1
+            status = Status(int(res.status))
+            if status not in (Status.CONVERGED, Status.NO_WORKING_SET):
+                say(f"tune: point (C={C:g}, gamma={gamma:g}) fold {fi} "
+                    f"ended {status.name}")
+        row.update(
+            rung=rung, n_subset=m,
+            cv_accuracy=float(np.mean(accs)), fold_accuracy=accs,
+            sv_count=float(np.mean(svs)),
+            n_updates=row["n_updates"] + updates,
+            wall_s=row["wall_s"] + (time.perf_counter() - t0),
+            warm_seeded=row["warm_seeded"]
+            + sum(s is not None for s in seeds),
+        )
+        return row
+
+    if config.schedule == "grid":
+        best = -np.inf
+        since_improve = 0
+        for pi in range(len(points)):
+            row = fit_point(pi, n_full, rung=0)
+            row["status"] = TuneStatus.EVALUATED.name
+            say(f"tune: C={row['C']:g} gamma={row['gamma']:g} "
+                f"cv={row['cv_accuracy']:.4f} updates={row['n_updates']} "
+                f"warm={row['warm_seeded']}/{config.folds}")
+            if row["cv_accuracy"] > best + config.plateau_tol:
+                best = row["cv_accuracy"]
+                since_improve = 0
+            else:
+                since_improve += 1
+            if config.patience and since_improve >= config.patience:
+                say(f"tune: plateau after {pi + 1}/{len(points)} points "
+                    f"(no improvement in {since_improve})")
+                break
+    else:
+        survivors = list(range(len(points)))
+        sizes = _rung_sizes(n_full, config.min_rung, config.eta)
+        for rung, m in enumerate(sizes):
+            last = rung == len(sizes) - 1
+            for pi in survivors:
+                fit_point(pi, m, rung=rung)
+            say(f"tune: rung {rung} (m={m}) scored {len(survivors)} points")
+            # rank: best CV accuracy first, solve order breaks ties
+            # deterministically
+            ranked = sorted(
+                survivors,
+                key=lambda pi: (-rows[pi]["cv_accuracy"], pi),
+            )
+            if last:
+                for pi in survivors:
+                    rows[pi]["status"] = TuneStatus.EVALUATED.name
+            else:
+                keep = max(1, -(-len(survivors) // config.eta))
+                for pi in ranked[keep:]:
+                    rows[pi]["status"] = TuneStatus.PRUNED.name
+                survivors = sorted(ranked[:keep])
+
+    evaluated = [r for r in rows
+                 if r["status"] == TuneStatus.EVALUATED.name]
+    if not evaluated:  # unreachable: both schedules evaluate >= 1 point
+        raise RuntimeError("tune evaluated no grid points")
+    win = max(evaluated, key=lambda r: r["cv_accuracy"])  # first max wins
+    winner = {"C": win["C"], "gamma": win["gamma"],
+              "cv_accuracy": win["cv_accuracy"]}
+    say(f"tune: winner C={win['C']:g} gamma={win['gamma']:g} "
+        f"cv={win['cv_accuracy']:.4f}")
+    return TuneResult(
+        schedule=config.schedule,
+        grid={"C_values": list(grid.C_values),
+              "gamma_values": list(grid.gamma_values)},
+        folds=config.folds,
+        seed=config.seed,
+        n=int(X.shape[0]),
+        d=int(X.shape[1]),
+        warm_start=config.warm_start,
+        points=rows,
+        winner=winner,
+        total_updates=int(sum(r["n_updates"] for r in rows)),
+        wall_s=time.perf_counter() - t_run,
+    )
