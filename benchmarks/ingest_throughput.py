@@ -1,0 +1,163 @@
+"""Ingest + prefetch throughput for the out-of-core pipeline (tpusvm.stream).
+
+Two numbers the stream layer stands on:
+
+  - INGEST rate: CSV -> sharded dataset (streamed blocks, manifest stats +
+    checksums computed per shard), in rows/s. This is the one-time cost of
+    making a dataset a first-class on-disk artifact.
+  - PREFETCH gain: batches/s of a ShardReader-fed consumer (background IO
+    overlapping a fixed per-batch compute) vs. the same consumer doing
+    cold synchronous shard loads. With compute >= IO per batch the reader
+    should hide nearly all IO; the record carries both rates and the
+    ratio, plus the reader's max_live_shards so the residency bound is
+    part of the committed evidence.
+
+Emits ONE JSON line (house provenance style: workload_record, explicit
+platform), plus a summary gate: rc != 0 if the reader round-trip dropped
+rows or the residency bound was violated — so a regression cannot commit a
+plausible-looking curve.
+
+Usage: python benchmarks/ingest_throughput.py [--smoke] [--n N] [--d D]
+           [--rows-per-shard R] [--batch-size B] [--compute-ms MS]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from benchmarks.common import emit, log, pin_platform, workload_record  # noqa: E402
+
+pin_platform()
+
+import numpy as np  # noqa: E402
+
+
+def _cold_batches(ds, batch_size):
+    """Synchronous baseline: load each shard on the consumer thread, then
+    re-chunk — the exact work ShardReader.batches does, minus the overlap."""
+    rx = ry = None
+    for i in range(ds.n_shards):
+        X, Y = ds.load_shard(i)
+        if rx is not None:
+            X = np.concatenate([rx, X])
+            Y = np.concatenate([ry, Y])
+            rx = ry = None
+        n_full = len(X) // batch_size * batch_size
+        for s in range(0, n_full, batch_size):
+            yield X[s:s + batch_size], Y[s:s + batch_size]
+        if n_full < len(X):
+            rx, ry = X[n_full:].copy(), Y[n_full:].copy()
+    if rx is not None:
+        yield rx, ry
+
+
+def _consume(batches, compute_s):
+    """Drain a batch stream with a fixed per-batch 'compute' (sleep: the
+    stand-in for device work, which releases the GIL exactly like a real
+    dispatch would). Returns (n_batches, n_rows, elapsed_s)."""
+    t0 = time.perf_counter()
+    nb = rows = 0
+    for X, _ in batches:
+        time.sleep(compute_s)
+        nb += 1
+        rows += len(X)
+    return nb, rows, time.perf_counter() - t0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--smoke", action="store_true",
+                    help="tiny shape (schema/CI run)")
+    ap.add_argument("--n", type=int, default=16384, help="dataset rows")
+    ap.add_argument("--d", type=int, default=64)
+    ap.add_argument("--seed", type=int, default=11, help="data seed")
+    ap.add_argument("--rows-per-shard", type=int, default=2048)
+    ap.add_argument("--batch-size", type=int, default=512)
+    ap.add_argument("--prefetch-depth", type=int, default=2)
+    ap.add_argument("--compute-ms", type=float, default=2.0,
+                    help="simulated per-batch consumer compute")
+    ap.add_argument("--jsonl", metavar="PATH",
+                    help="also append the record to this file")
+    args = ap.parse_args(argv)
+    if args.smoke:
+        args.n, args.d = 1024, 16
+        args.rows_per_shard, args.batch_size = 128, 64
+        args.compute_ms = 1.0
+
+    from tpusvm.data import mnist_like, write_csv
+    from tpusvm.data.synthetic import BENCH_LABEL_NOISE, BENCH_NOISE
+    from tpusvm.stream import ShardReader, ingest_csv, open_dataset
+
+    gen_kwargs = dict(n=args.n, d=args.d, seed=args.seed,
+                      noise=BENCH_NOISE, label_noise=BENCH_LABEL_NOISE)
+    X, Y = mnist_like(**gen_kwargs)
+
+    violations = []
+    with tempfile.TemporaryDirectory() as tmp:
+        csv_path = os.path.join(tmp, "data.csv")
+        log(f"writing {args.n} x {args.d} CSV ...")
+        write_csv(csv_path, X, Y)
+
+        log("ingesting ...")
+        t0 = time.perf_counter()
+        manifest = ingest_csv(os.path.join(tmp, "ds"), csv_path,
+                              rows_per_shard=args.rows_per_shard)
+        ingest_s = time.perf_counter() - t0
+        ds = open_dataset(os.path.join(tmp, "ds"))
+
+        compute_s = args.compute_ms / 1000.0
+        log("cold read ...")
+        cold_nb, cold_rows, cold_s = _consume(
+            _cold_batches(ds, args.batch_size), compute_s)
+        log("prefetch read ...")
+        reader = ShardReader(ds, prefetch_depth=args.prefetch_depth)
+        pre_nb, pre_rows, pre_s = _consume(
+            reader.batches(args.batch_size), compute_s)
+
+        if pre_rows != ds.n_rows or cold_rows != ds.n_rows:
+            violations.append(
+                f"row drop: cold {cold_rows} / prefetch {pre_rows} "
+                f"vs {ds.n_rows}")
+        if reader.max_live_shards > args.prefetch_depth + 1:
+            violations.append(
+                f"residency: {reader.max_live_shards} > "
+                f"{args.prefetch_depth + 1}")
+
+    record = {
+        "bench": "ingest_throughput",
+        "workload": workload_record(mnist_like, **gen_kwargs),
+        "platform": "cpu",
+        "rows": args.n,
+        "d": args.d,
+        "rows_per_shard": args.rows_per_shard,
+        "n_shards": len(manifest.shards),
+        "batch_size": args.batch_size,
+        "prefetch_depth": args.prefetch_depth,
+        "compute_ms": args.compute_ms,
+        "ingest_s": round(ingest_s, 4),
+        "ingest_rows_per_s": round(args.n / ingest_s, 1),
+        "cold_batches_per_s": round(cold_nb / cold_s, 2),
+        "prefetch_batches_per_s": round(pre_nb / pre_s, 2),
+        "prefetch_speedup": round(cold_s / pre_s, 4),
+        "max_live_shards": int(reader.max_live_shards),
+        "violations": violations,
+    }
+    emit(record)
+    if args.jsonl:
+        with open(args.jsonl, "a") as f:
+            f.write(json.dumps(record) + "\n")
+    if violations:
+        log(f"GATES FAILED: {violations}")
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

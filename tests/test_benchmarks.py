@@ -246,3 +246,31 @@ def test_sweep_p_tree_skips_non_power_of_two(capsys):
     ])
     assert rc == 0
     assert _records(capsys) == []  # P=3 tree is skipped, nothing emitted
+
+
+def test_ingest_throughput_smoke_schema(capsys):
+    from benchmarks import ingest_throughput
+
+    rc = ingest_throughput.main(["--smoke"])
+    recs = _records(capsys)
+    assert rc == 0
+    assert len(recs) == 1
+    r = recs[0]
+    assert r["bench"] == "ingest_throughput"
+    assert r["workload"]["synthetic"] is True
+    assert r["n_shards"] >= 1
+    assert r["ingest_s"] > 0 and r["ingest_rows_per_s"] > 0
+    assert r["cold_batches_per_s"] > 0 and r["prefetch_batches_per_s"] > 0
+    assert r["prefetch_speedup"] > 0
+    # the residency bound is part of the committed evidence
+    assert r["max_live_shards"] <= r["prefetch_depth"] + 1
+    assert r["violations"] == []
+    # the committed CPU curve carries the same schema
+    import json as _json
+    import os as _os
+
+    path = _os.path.join(_os.path.dirname(_os.path.dirname(
+        _os.path.abspath(__file__))), "benchmarks", "results",
+        "ingest_throughput_cpu.jsonl")
+    committed = [_json.loads(line) for line in open(path)]
+    assert committed and set(r) <= set(committed[0])

@@ -120,3 +120,70 @@ def test_synthetic_deterministic():
     assert Xm.shape == (100, 32)
     assert Xm.min() >= 0 and Xm.max() <= 255
     assert set(np.unique(Ym)) == {-1, 1}
+
+
+def test_scaler_from_stats_bit_parity_with_fit():
+    # per-shard partial min/max merged -> from_stats must transform
+    # BIT-identically to fit() on the concatenated array, including the
+    # degenerate-range (< 1e-12) branch (a constant column and a
+    # sub-threshold-range column)
+    from tpusvm.data import merge_minmax
+
+    rng = np.random.default_rng(7)
+    shards = []
+    for i in range(5):
+        S = rng.standard_normal((17 + i, 4))
+        S[:, 1] = 3.25            # exactly constant: range 0
+        S[:, 2] = 1.0 + rng.uniform(0, 0.9e-12, len(S))  # degenerate range
+        shards.append(S)
+    X = np.concatenate(shards)
+    fitted = MinMaxScaler().fit(X)
+    lo, hi = merge_minmax(
+        (np.min(s, axis=0), np.max(s, axis=0)) for s in shards
+    )
+    merged = MinMaxScaler.from_stats(lo, hi)
+    assert merged.min_val.tobytes() == fitted.min_val.tobytes()
+    assert merged.max_val.tobytes() == fitted.max_val.tobytes()
+    Xt = rng.standard_normal((13, 4))
+    assert merged.transform(Xt).tobytes() == fitted.transform(Xt).tobytes()
+    # the degenerate branch really engaged (range treated as 1.0)
+    assert fitted.range_[1] == 1.0 and fitted.range_[2] == 1.0
+
+
+def test_scaler_from_stats_validates():
+    from tpusvm.data import merge_minmax
+
+    with pytest.raises(ValueError):
+        MinMaxScaler.from_stats(np.zeros(3), np.zeros(2))
+    with pytest.raises(ValueError):
+        MinMaxScaler.from_stats(np.ones(2), np.zeros(2))  # max < min
+    with pytest.raises(ValueError):
+        merge_minmax([])
+
+
+def test_read_csv_blocks_matches_read_csv(tmp_path):
+    from tpusvm.data import read_csv, read_csv_blocks, write_csv
+
+    rng = np.random.default_rng(5)
+    X = rng.standard_normal((97, 3))
+    Y = rng.integers(0, 5, 97).astype(np.int32)
+    p = str(tmp_path / "d.csv")
+    write_csv(p, X, Y)
+    for kw in ({}, {"n_limit": 41}, {"binary": False},
+               {"positive_label": 3}):
+        whole = read_csv(p, **kw)
+        for block_rows in (1, 7, 97, 1000):
+            blocks = list(read_csv_blocks(p, block_rows=block_rows, **kw))
+            assert all(len(b[1]) <= block_rows for b in blocks)
+            np.testing.assert_array_equal(
+                np.concatenate([b[0] for b in blocks]), whole[0])
+            np.testing.assert_array_equal(
+                np.concatenate([b[1] for b in blocks]), whole[1])
+
+
+def test_read_csv_blocks_header_only(tmp_path):
+    from tpusvm.data import read_csv_blocks
+
+    p = tmp_path / "h.csv"
+    p.write_text("a,b,label\n")
+    assert list(read_csv_blocks(str(p))) == []

@@ -183,3 +183,54 @@ def test_native_malformed_raises(native_lib, tmp_path):
         read_csv_fast(junk)
     with pytest.raises(ValueError):
         read_csv(junk)
+
+
+# ------------------------------------------------- checked-in fixture parity
+FIXTURE = os.path.join(REPO, "tests", "fixtures", "native_parity.csv")
+
+
+def test_fixture_native_python_parity(native_lib):
+    # the committed fixture exercises denormals/extremes/exponent notation,
+    # a skipped bare-number row and a skipped blank line; native and Python
+    # readers must agree to the BYTE on X and Y
+    Xp, Yp = read_csv(FIXTURE)
+    Xn, Yn = read_csv_fast(FIXTURE)
+    assert Xp.shape == (11, 5)  # 13 data lines - 2 short rows skipped
+    assert Xn.tobytes() == Xp.tobytes()
+    assert Yn.tobytes() == Yp.tobytes()
+    assert set(np.unique(Yp)) == {1, -1}
+
+
+def test_fixture_parity_n_limit_and_raw(native_lib):
+    for n_limit in (0, 3, 5, 100):
+        Xn, Yn = read_csv_fast(FIXTURE, n_limit=n_limit)
+        Xp, Yp = read_csv(FIXTURE, n_limit=n_limit)
+        assert Xn.tobytes() == Xp.tobytes()
+        assert Yn.tobytes() == Yp.tobytes()
+    Xn, Yn = read_csv_fast(FIXTURE, binary_labels=False)
+    Xp, Yp = read_csv(FIXTURE, binary=False)
+    assert Yn.tobytes() == Yp.tobytes()
+    assert Yn.tolist() == [1, 0, 7, 1, 2, -1, 1, 10, 1, 3, 0]
+
+
+def test_fixture_parity_positive_label(native_lib):
+    # non-default positive class: the native path reads RAW labels and
+    # remaps on the host — must match the pure-Python mapping exactly
+    for k in (0, 7, -1, 99):
+        Xn, Yn = read_csv_fast(FIXTURE, positive_label=k)
+        Xp, Yp = read_csv(FIXTURE, positive_label=k)
+        assert Xn.tobytes() == Xp.tobytes()
+        assert Yn.tobytes() == Yp.tobytes()
+        raw = read_csv(FIXTURE, binary=False)[1]
+        np.testing.assert_array_equal(Yn, np.where(raw == k, 1, -1))
+
+
+def test_positive_label_python_fallback(tmp_path):
+    # pure-Python path (no native lib involvement): label != k -> -1
+    p = str(tmp_path / "d.csv")
+    with open(p, "w") as f:
+        f.write("a,b,label\n1.0,2.0,7\n3.0,4.0,1\n5.0,6.0,0\n")
+    X, Y = read_csv(p, positive_label=7)
+    np.testing.assert_array_equal(Y, [1, -1, -1])
+    X, Y = read_csv(p, positive_label=0)
+    np.testing.assert_array_equal(Y, [-1, -1, 1])

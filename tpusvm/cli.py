@@ -10,7 +10,10 @@ zero-flag run is a parity run.
     python -m tpusvm train --train train.csv --test test.csv
     python -m tpusvm train --synthetic mnist-like --n 60000 --mode cascade \
         --topology star --shards 8
+    python -m tpusvm ingest --train train.csv --out shards/
+    python -m tpusvm train --data shards/ --mode cascade --shards 8
     python -m tpusvm predict --model model.npz --data test.csv
+    python -m tpusvm predict --model model.npz --data shards/
     python -m tpusvm info
 
 Output reproduces the reference's diagnostics contract (SURVEY.md
@@ -78,10 +81,11 @@ def _build_parser() -> argparse.ArgumentParser:
     add_shared(p, suppress=False)
     sub = p.add_subparsers(dest="command", required=True)
 
-    def add_data_source(parser):
-        """The --train/--synthetic source group, shared by train and tune."""
+    def add_data_source(parser, sharded: bool = True):
+        """The --train/--synthetic/--data source group (train/tune/ingest)."""
         src = parser.add_argument_group(
-            "data source (one of --train / --synthetic)")
+            "data source (one of --train / --synthetic"
+            + (" / --data)" if sharded else ")"))
         src.add_argument("--train", metavar="CSV",
                          help="training CSV (last column = label)")
         src.add_argument("--test", metavar="CSV",
@@ -92,6 +96,13 @@ def _build_parser() -> argparse.ArgumentParser:
             help="generate a deterministic synthetic dataset instead of "
             "reading CSVs",
         )
+        if sharded:
+            src.add_argument(
+                "--data", metavar="DIR", dest="data",
+                help="ingested sharded dataset directory (tpusvm ingest): "
+                "out-of-core streaming source — the scaler comes from "
+                "manifest stats and shards are loaded one at a time",
+            )
         src.add_argument("--n", type=int, default=60000,
                          help="synthetic train size (default 60000)")
         src.add_argument("--n-test", type=int, default=10000,
@@ -103,6 +114,11 @@ def _build_parser() -> argparse.ArgumentParser:
         src.add_argument(
             "--n-limit", type=int, default=None, metavar="N",
             help="cap training rows (the reference's gpu_svm_main4 argv[1])",
+        )
+        src.add_argument(
+            "--positive-label", type=int, default=1, metavar="K",
+            help="CSV binary mode: the class mapped to +1 (label != K -> "
+            "-1); default 1, the reference's hard-coded digit",
         )
 
     tr = sub.add_parser("train", parents=[common],
@@ -187,12 +203,45 @@ def _build_parser() -> argparse.ArgumentParser:
                      help="capture a jax.profiler trace of training")
     out.add_argument("-q", "--quiet", action="store_true")
 
+    ing = sub.add_parser(
+        "ingest", parents=[common],
+        help="convert a CSV or synthetic generator into a sharded "
+        "on-disk dataset (tpusvm.stream): packed .npz shards + a JSON "
+        "manifest with per-shard stats and checksums")
+    add_data_source(ing, sharded=False)
+    ing.set_defaults(multiclass=False)
+    ing.add_argument("--multiclass", action="store_true",
+                     help="keep raw integer labels instead of the binary "
+                     "one-vs-rest mapping")
+    ing.add_argument("--out", metavar="DIR",
+                     help="output dataset directory (required unless "
+                     "--smoke)")
+    ing.add_argument("--rows-per-shard", type=int, default=65536,
+                     help="rows per .npz shard (default 65536)")
+    ing.add_argument("--block-rows", type=int, default=8192,
+                     help="CSV streaming block size (peak ingest memory)")
+    ing.add_argument("--smoke", action="store_true",
+                     help="CI gate: ingest a tiny synthetic dataset to a "
+                     "temp dir, then assert manifest integrity "
+                     "(checksums/stats validate OK), reader round-trip "
+                     "parity with the generator, scaler-from-stats parity "
+                     "with a full-array fit, and the prefetch residency "
+                     "bound; non-zero exit on any failure")
+    ing.add_argument("-q", "--quiet", action="store_true")
+
     pr = sub.add_parser("predict", parents=[common],
-                        help="evaluate a saved model on a CSV")
+                        help="evaluate a saved model on a CSV or an "
+                        "ingested sharded dataset")
     pr.add_argument("--model", required=True, metavar="NPZ",
                     help="binary or --multiclass model (auto-detected)")
-    pr.add_argument("--data", required=True, metavar="CSV")
+    pr.add_argument("--data", required=True, metavar="CSV|DIR",
+                    help="test CSV, or a sharded dataset directory "
+                    "(streamed batched scoring with bounded memory)")
     pr.add_argument("--n-limit", type=int, default=None)
+    pr.add_argument("--positive-label", type=int, default=1, metavar="K",
+                    help="CSV binary mode: the class mapped to +1")
+    pr.add_argument("--batch-size", type=int, default=4096,
+                    help="sharded --data: rows per scoring batch")
     pr.add_argument("--scores", action="store_true",
                     help="print decision scores instead of accuracy (one "
                     "line per row; multiclass: one column per class)")
@@ -322,15 +371,21 @@ def _load_train_data(args) -> Tuple[np.ndarray, np.ndarray, Optional[np.ndarray]
     from tpusvm.data.native_io import read_csv_fast
     from tpusvm.data.synthetic import mnist_like_multiclass
 
-    if (args.train is None) == (args.synthetic is None):
-        raise SystemExit("train: pass exactly one of --train / --synthetic")
+    n_sources = sum(s is not None for s in
+                    (args.train, args.synthetic, getattr(args, "data", None)))
+    if n_sources != 1:
+        raise SystemExit(
+            "pass exactly one of --train / --synthetic / --data"
+        )
     if args.train:
         binary = not args.multiclass
         X, Y = read_csv_fast(args.train, n_limit=args.n_limit,
-                             binary_labels=binary)
+                             binary_labels=binary,
+                             positive_label=args.positive_label)
         Xt = Yt = None
         if args.test:
-            Xt, Yt = read_csv_fast(args.test, binary_labels=binary)
+            Xt, Yt = read_csv_fast(args.test, binary_labels=binary,
+                                   positive_label=args.positive_label)
         return X, Y, Xt, Yt
 
     n_total = args.n + args.n_test
@@ -471,11 +526,43 @@ def _cmd_train(args) -> int:
                     primary=(jax.process_index() == 0) and not args.quiet)
     timer = PhaseTimer()
 
-    with timer.phase("data"):
-        X, Y, Xt, Yt = _load_train_data(args)
-    n, n_features = X.shape
+    dataset = None
+    if args.data:
+        # streamed source: scaler from manifest stats, shard-at-a-time
+        # loading — trains the identical model to the in-memory path
+        if args.train or args.synthetic:
+            raise SystemExit(
+                "pass exactly one of --train / --synthetic / --data"
+            )
+        if args.multiclass:
+            raise SystemExit("--data supports binary training (one-vs-rest "
+                             "over shards is a future PR); ingest was "
+                             "binary-mapped or use --train")
+        if args.mode == "oracle":
+            raise SystemExit("--mode oracle reads CSVs (--train); --data "
+                             "is the streaming path")
+        if args.n_limit is not None:
+            raise SystemExit("--n-limit does not apply to --data (the "
+                             "manifest defines the rows; re-ingest with "
+                             "--n-limit instead)")
+        from tpusvm.data.native_io import read_csv_fast
+        from tpusvm.stream import open_dataset
+
+        Xt = Yt = None
+        with timer.phase("data"):
+            dataset = open_dataset(args.data)
+            if args.test:
+                Xt, Yt = read_csv_fast(args.test, binary_labels=True,
+                                       positive_label=args.positive_label)
+        n, n_features = dataset.n_rows, dataset.n_features
+        X = Y = None
+    else:
+        with timer.phase("data"):
+            X, Y, Xt, Yt = _load_train_data(args)
+        n, n_features = X.shape
     log.info("n = %d, n_features = %d", n, n_features)
-    log.event("data", n=n, n_features=n_features, mode=args.mode)
+    log.event("data", n=n, n_features=n_features, mode=args.mode,
+              streamed=dataset is not None)
     if args.multiclass:
         if args.mode != "single":
             raise SystemExit("--multiclass currently supports --mode single")
@@ -505,13 +592,21 @@ def _cmd_train(args) -> int:
                 cc = CascadeConfig(n_shards=shards,
                                    sv_capacity=args.sv_capacity,
                                    topology=args.topology)
-                model.fit_cascade(X, Y, cc, verbose=not args.quiet,
-                                  checkpoint_path=args.checkpoint,
-                                  resume=args.resume,
-                                  stratified=args.stratify)
+                if dataset is not None:
+                    model.fit_cascade_stream(
+                        dataset, cc, verbose=not args.quiet,
+                        checkpoint_path=args.checkpoint,
+                        resume=args.resume, stratified=args.stratify)
+                else:
+                    model.fit_cascade(X, Y, cc, verbose=not args.quiet,
+                                      checkpoint_path=args.checkpoint,
+                                      resume=args.resume,
+                                      stratified=args.stratify)
                 log.info("cascade: %d rounds, converged = %s",
                          model.cascade_rounds_,
                          model.status_.name == "CONVERGED")
+            elif dataset is not None:
+                model.fit_stream(dataset)
             else:
                 model.fit(X, Y)
 
@@ -567,10 +662,99 @@ def _fit_oracle(X, Y, cfg, timer, log):
     return model
 
 
+def _cmd_ingest(args) -> int:
+    """Convert a CSV / synthetic generator into a sharded dataset dir."""
+    from tpusvm.status import StreamStatus
+    from tpusvm.stream import ingest_arrays, ingest_csv, open_dataset
+
+    say = (lambda msg: None) if args.quiet else print
+
+    if args.smoke:
+        return _ingest_smoke(args, say)
+    if not args.out:
+        raise SystemExit("ingest: --out DIR is required (or --smoke)")
+    if (args.train is None) == (args.synthetic is None):
+        raise SystemExit("ingest: pass exactly one of --train / --synthetic")
+
+    if args.train:
+        manifest = ingest_csv(
+            args.out, args.train, rows_per_shard=args.rows_per_shard,
+            n_limit=args.n_limit, binary=not args.multiclass,
+            positive_label=args.positive_label, block_rows=args.block_rows,
+        )
+    else:
+        # synthetic generators are in-memory anyway; shard their output
+        args.n_test = 0
+        X, Y, _, _ = _load_train_data(args)
+        manifest = ingest_arrays(
+            args.out, X, Y, rows_per_shard=args.rows_per_shard,
+            binary=not args.multiclass,
+            positive_label=None if args.multiclass else args.positive_label,
+        )
+
+    bad = [(manifest.shards[i].filename, s.name)
+           for i, s in enumerate(open_dataset(args.out).validate())
+           if s != StreamStatus.OK]
+    if bad:
+        print(f"ingest: wrote shards that FAIL validation: {bad}")
+        return 1
+    stats = manifest.global_stats()
+    say(f"ingested {manifest.n_rows} rows x {manifest.n_features} features "
+        f"into {len(manifest.shards)} shards at {args.out}")
+    say(f"class counts: {dict(sorted(stats.class_counts.items()))}")
+    return 0
+
+
+def _ingest_smoke(args, say) -> int:
+    """CI gate: ingest a tiny synthetic dataset and assert every claim the
+    stream layer makes — manifest integrity, reader round-trip parity,
+    scaler-from-stats bit-parity, the prefetch residency bound."""
+    import tempfile
+
+    import numpy as np
+
+    from tpusvm.data import MinMaxScaler, rings
+    from tpusvm.status import StreamStatus
+    from tpusvm.stream import ShardReader, ingest_arrays, open_dataset
+
+    X, Y = rings(n=301, seed=11)
+    failures = []
+    with tempfile.TemporaryDirectory() as tmp:
+        manifest = ingest_arrays(tmp, X, Y, rows_per_shard=64)
+        ds = open_dataset(tmp)
+        statuses = ds.validate()
+        if not all(s == StreamStatus.OK for s in statuses):
+            failures.append(f"validate: {[s.name for s in statuses]}")
+        reader = ShardReader(ds, prefetch_depth=2)
+        blocks = list(reader)
+        Xr = np.concatenate([b[0] for b in blocks])
+        Yr = np.concatenate([b[1] for b in blocks])
+        if not (np.array_equal(Xr, X) and np.array_equal(Yr, Y)):
+            failures.append("reader round-trip diverged from the generator")
+        if reader.max_live_shards > 3:
+            failures.append(
+                f"residency bound violated: {reader.max_live_shards} live "
+                "shards > prefetch_depth + 1 = 3")
+        sc = ds.scaler()
+        sf = MinMaxScaler().fit(X)
+        if not (np.array_equal(sc.min_val, sf.min_val)
+                and np.array_equal(sc.max_val, sf.max_val)):
+            failures.append("manifest scaler != full-array fit")
+    if failures:
+        for f in failures:
+            print(f"INGEST SMOKE FAILED: {f}")
+        return 1
+    say(f"ingest smoke ok: {manifest.n_rows} rows, "
+        f"{len(manifest.shards)} shards, scaler/round-trip/residency "
+        "parity held")
+    return 0
+
+
 def _cmd_predict(args) -> int:
     from tpusvm.data.native_io import read_csv_fast
     from tpusvm.models import BinarySVC, OneVsRestSVC
     from tpusvm.models.serialization import is_multiclass_model
+    from tpusvm.stream import is_dataset_dir
     from tpusvm.utils import PhaseTimer
 
     timer = PhaseTimer()
@@ -578,9 +762,38 @@ def _cmd_predict(args) -> int:
     # of the reference's binary != 1 -> -1 mapping
     multiclass = is_multiclass_model(args.model)
     model = (OneVsRestSVC if multiclass else BinarySVC).load(args.model)
+    if is_dataset_dir(args.data):
+        # streamed scoring off the shards: peak memory is the reader's
+        # prefetch bound + one batch, regardless of dataset size
+        from tpusvm.stream import evaluate_stream, open_dataset, predict_stream
+
+        dataset = open_dataset(args.data)
+        if args.mesh_predict:
+            raise SystemExit("--mesh-predict applies to CSV input; the "
+                             "streamed path batches over shards instead")
+        if args.scores:
+            n_out = 0
+            for scores, _ in predict_stream(dataset=dataset, model=model,
+                                            batch_size=args.batch_size):
+                if args.n_limit is not None:
+                    scores = scores[: max(0, args.n_limit - n_out)]
+                n_out += len(scores)
+                for row in scores.reshape(len(scores), -1):
+                    print(" ".join(f"{s:.15f}" for s in row))
+                if args.n_limit is not None and n_out >= args.n_limit:
+                    break
+            return 0
+        with timer.phase("prediction"):
+            acc, m = evaluate_stream(model, dataset,
+                                     batch_size=args.batch_size,
+                                     n_limit=args.n_limit)
+        print(f"accuracy = {acc:.4f} ({round(acc * m)}/{m})")
+        print(timer.report())
+        return 0
     with timer.phase("data"):
         X, Y = read_csv_fast(args.data, n_limit=args.n_limit,
-                             binary_labels=not multiclass)
+                             binary_labels=not multiclass,
+                             positive_label=args.positive_label)
     mesh = None
     if args.mesh_predict:
         import jax
@@ -721,6 +934,7 @@ def _cmd_tune(args) -> int:
         # a 2x2 grid bracketing the rings problem's good region, so the
         # whole run (including the winner's full-data retrain) is seconds
         args.synthetic, args.train, args.test = "rings", None, None
+        args.data = None
         args.n, args.n_test, args.n_limit = 240, 60, None
         args.folds, args.fold_seed = 2, 0
         args.C_grid, args.gamma_grid = "1,8", "1,8"
@@ -757,10 +971,35 @@ def _cmd_tune(args) -> int:
         )
 
     timer = PhaseTimer()
-    with timer.phase("data"):
-        X, Y, Xt, Yt = _load_train_data(args)
+    dataset = None
+    if args.data:
+        # streamed source: folds come from a labels-only manifest pass,
+        # fold caches gather only their own rows shard by shard
+        if args.train or args.synthetic:
+            raise SystemExit(
+                "pass exactly one of --train / --synthetic / --data"
+            )
+        if args.n_limit is not None:
+            raise SystemExit("--n-limit does not apply to --data "
+                             "(re-ingest with --n-limit instead)")
+        from tpusvm.stream import open_dataset
+
+        with timer.phase("data"):
+            dataset = open_dataset(args.data)
+        X = Y = None
+        Xt = Yt = None
+        if args.test:
+            from tpusvm.data.native_io import read_csv_fast
+
+            Xt, Yt = read_csv_fast(args.test, binary_labels=True,
+                                   positive_label=args.positive_label)
+        n, n_features = dataset.n_rows, dataset.n_features
+    else:
+        with timer.phase("data"):
+            X, Y, Xt, Yt = _load_train_data(args)
+        n, n_features = X.shape
     say = (lambda msg: None) if args.quiet else print
-    say(f"n = {X.shape[0]}, n_features = {X.shape[1]}, "
+    say(f"n = {n}, n_features = {n_features}, "
         f"grid = {grid.shape[0]}x{grid.shape[1]}, folds = {args.folds}, "
         f"schedule = {args.schedule}")
 
@@ -770,6 +1009,7 @@ def _cmd_tune(args) -> int:
             accum_dtype=accum, scale=not args.no_scale,
             solver_opts=_parse_solver_opts(args.solver_opt),
             log_fn=(lambda msg: None) if args.quiet else print,
+            dataset=dataset,
         )
     print(format_table(result))
     if args.results:
@@ -783,7 +1023,10 @@ def _cmd_tune(args) -> int:
     model = BinarySVC(config=win_cfg, dtype=getattr(jnp, args.dtype),
                       scale=not args.no_scale)
     with timer.phase("final-train"):
-        model.fit(X, Y)
+        if dataset is not None:
+            model.fit_stream(dataset)
+        else:
+            model.fit(X, Y)
     say(f"winner model: {model.n_support_} SVs, "
         f"status {model.status_.name}")
     test_acc = None
@@ -818,9 +1061,13 @@ def _cmd_tune(args) -> int:
 
 
 def _info_artifact(path: str) -> int:
-    """`tpusvm info <path>`: describe a tune-results JSON or a model .npz."""
+    """`tpusvm info <path>`: describe a sharded dataset dir, a tune-results
+    JSON, or a model .npz."""
+    from tpusvm.stream import is_dataset_dir
     from tpusvm.tune import format_table, is_tune_result, load_tune_result
 
+    if is_dataset_dir(path):
+        return _info_dataset(path)
     if is_tune_result(path):
         print(format_table(load_tune_result(path)))
         return 0
@@ -847,6 +1094,36 @@ def _info_artifact(path: str) -> int:
     print(f"config: C={config.C:g} gamma={config.gamma:g} "
           f"tau={config.tau:g} sv_tol={config.sv_tol:g}")
     print(f"scaled: {bool(state.get('scale', False))}")
+    return 0
+
+
+def _info_dataset(path: str) -> int:
+    """Describe + verify an ingested sharded dataset directory."""
+    from tpusvm.status import StreamStatus
+    from tpusvm.stream import open_dataset
+
+    ds = open_dataset(path)
+    m = ds.manifest
+    stats = m.global_stats()
+    kind = "binary" if m.binary else "multiclass (raw labels)"
+    print(f"sharded dataset: {m.n_rows} rows x {m.n_features} features, "
+          f"{len(m.shards)} shards")
+    print(f"labels: {kind}"
+          + (f" (positive_label={m.positive_label})"
+             if m.positive_label is not None else ""))
+    print(f"class counts: {dict(sorted(stats.class_counts.items()))}")
+    print(f"feature range: [{stats.min_val.min():g}, "
+          f"{stats.max_val.max():g}]")
+    statuses = ds.validate()
+    bad = [(m.shards[i].filename, s.name)
+           for i, s in enumerate(statuses) if s != StreamStatus.OK]
+    if bad:
+        print(f"validation FAILED on {len(bad)}/{len(statuses)} shards:")
+        for name, status in bad:
+            print(f"  {name}: {status}")
+        return 1
+    print(f"validation: all {len(statuses)} shards OK "
+          "(checksums, row counts, stats)")
     return 0
 
 
@@ -896,9 +1173,9 @@ def main(argv=None) -> int:
         if args.process_id is not None:
             kw["process_id"] = args.process_id
         jax.distributed.initialize(**kw)
-    return {"train": _cmd_train, "predict": _cmd_predict,
-            "serve": _cmd_serve, "tune": _cmd_tune,
-            "info": _cmd_info}[args.command](args)
+    return {"train": _cmd_train, "ingest": _cmd_ingest,
+            "predict": _cmd_predict, "serve": _cmd_serve,
+            "tune": _cmd_tune, "info": _cmd_info}[args.command](args)
 
 
 if __name__ == "__main__":

@@ -1,14 +1,16 @@
-from tpusvm.data.csv_reader import read_csv, write_csv
+from tpusvm.data.csv_reader import read_csv, read_csv_blocks, write_csv
 from tpusvm.data.partition import Partition, partition
-from tpusvm.data.scaler import MinMaxScaler
+from tpusvm.data.scaler import MinMaxScaler, merge_minmax
 from tpusvm.data.synthetic import blobs, mnist_like, mnist_like_multiclass, rings
 
 __all__ = [
     "read_csv",
+    "read_csv_blocks",
     "write_csv",
     "Partition",
     "partition",
     "MinMaxScaler",
+    "merge_minmax",
     "blobs",
     "rings",
     "mnist_like",

@@ -73,22 +73,31 @@ def read_csv_fast(
     n_limit: Optional[int] = None,
     binary_labels: bool = True,
     n_threads: int = 0,
+    positive_label: int = 1,
 ) -> Tuple[np.ndarray, np.ndarray]:
     """read_csv with the native multi-threaded parser when available.
 
     Same contract as data.read_csv (header skipped, last column = label,
-    binary mode maps label != 1 -> -1, rows with < 2 fields skipped,
-    n_limit caps rows); binary_labels=False keeps raw integer labels for
-    multi-class use. n_threads=0 = one per hardware thread.
+    binary mode maps label != positive_label -> -1, rows with < 2 fields
+    skipped, n_limit caps rows); binary_labels=False keeps raw integer
+    labels for multi-class use. n_threads=0 = one per hardware thread.
+
+    positive_label: the class mapped to +1 in binary mode. The C ABI only
+    knows the reference's hard-coded `1 vs rest` mapping, so a non-default
+    positive_label reads RAW labels through the native parser and remaps
+    them vectorised on the host — same bytes out as the pure-Python
+    reader, still one native parse of the file.
     """
     lib = _load_lib()
     if lib is None:
-        return _py_read_csv(filename, n_limit, binary=binary_labels)
+        return _py_read_csv(filename, n_limit, binary=binary_labels,
+                            positive_label=positive_label)
 
+    remap = binary_labels and positive_label != 1
     ptr = lib.tpusvm_read_csv(
         os.fsencode(filename),
         -1 if n_limit is None else int(n_limit),
-        1 if binary_labels else 0,
+        0 if remap else (1 if binary_labels else 0),
         int(n_threads),
     )
     if not ptr:
@@ -112,6 +121,8 @@ def read_csv_fast(
                     np.zeros((0,), np.int32))
         X = np.ctypeslib.as_array(data.X, shape=(n, d)).copy()
         Y = np.ctypeslib.as_array(data.Y, shape=(n,)).copy()
+        if remap:
+            Y = np.where(Y == positive_label, 1, -1).astype(np.int32)
         return X, Y
     finally:
         lib.tpusvm_free_csv(ptr)

@@ -8,16 +8,47 @@ set is always scaled with the TRAIN set's min/max (main3.cpp:338-339, 355).
 In the distributed cascade, rank 0 computes min/max over the FULL dataset
 before scattering and broadcasts it (mpi_svm_main3.cpp:529-539) — here the
 scaler is simply fit on the full array before sharding, which is the same
-computation without the broadcast.
+computation without the broadcast. For out-of-core datasets the same global
+min/max is assembled WITHOUT ever holding X: per-shard partial min/max merge
+exactly (min/max are selections, not accumulations, so elementwise
+minimum/maximum over partials is bit-identical to a fit on the concatenated
+array), and `MinMaxScaler.from_stats` builds the scaler from the merged
+result (tpusvm.stream.stats is the manifest-side producer).
 """
 
 from __future__ import annotations
 
 import dataclasses
+from typing import Iterable, Tuple
 
 import numpy as np
 
 _DEGENERATE_RANGE = 1e-12
+
+
+def merge_minmax(
+    parts: Iterable[Tuple[np.ndarray, np.ndarray]],
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Merge per-shard (min_val, max_val) partials into global min/max.
+
+    Bit-identical to np.min/np.max over the row-concatenated array: min and
+    max are selections, so the reduction order cannot perturb the result
+    (unlike a mean or a sum). Raises on an empty iterable — there is no
+    identity element that would round-trip through the degenerate-range
+    rule honestly.
+    """
+    lo = hi = None
+    for p_lo, p_hi in parts:
+        p_lo = np.asarray(p_lo)
+        p_hi = np.asarray(p_hi)
+        if lo is None:
+            lo, hi = p_lo.copy(), p_hi.copy()
+        else:
+            np.minimum(lo, p_lo, out=lo)
+            np.maximum(hi, p_hi, out=hi)
+    if lo is None:
+        raise ValueError("merge_minmax: no partial stats to merge")
+    return lo, hi
 
 
 @dataclasses.dataclass
@@ -31,6 +62,26 @@ class MinMaxScaler:
         self.min_val = np.min(X, axis=0)
         self.max_val = np.max(X, axis=0)
         return self
+
+    @classmethod
+    def from_stats(cls, min_val: np.ndarray, max_val: np.ndarray) -> "MinMaxScaler":
+        """Build a fitted scaler from precomputed per-feature min/max.
+
+        The out-of-core constructor: pass manifest-recorded global stats
+        (or a merge_minmax of per-shard partials) and transform() behaves
+        exactly as after fit() on the full array — including the
+        degenerate-range (< 1e-12) branch, which lives in `range_` and is
+        therefore shared by both construction paths.
+        """
+        min_val = np.asarray(min_val)
+        max_val = np.asarray(max_val)
+        if min_val.shape != max_val.shape:
+            raise ValueError(
+                f"min/max shape mismatch: {min_val.shape} vs {max_val.shape}"
+            )
+        if np.any(max_val < min_val):
+            raise ValueError("from_stats: max_val < min_val on some feature")
+        return cls(min_val=min_val, max_val=max_val)
 
     @property
     def range_(self) -> np.ndarray:

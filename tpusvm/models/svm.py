@@ -96,9 +96,34 @@ class BinarySVC:
 
     def fit(self, X: np.ndarray, Y: np.ndarray) -> "BinarySVC":
         """Single-chip on-device SMO training (gpu_svm_main3.cu capability)."""
-        cfg = self.config
         t0 = time.perf_counter()
         Xs = self._scale_fit(np.asarray(X))
+        return self._fit_scaled(Xs, Y, t0)
+
+    def fit_stream(self, dataset) -> "BinarySVC":
+        """Single-chip fit from a sharded dataset (tpusvm.stream).
+
+        The scaler is fitted from MANIFEST statistics (bit-identical to a
+        full-array fit — stream.stats) and shards are scaled as they
+        stream in, so the raw array is never materialised. The SCALED
+        matrix is — single-chip SMO needs every row on device; use
+        fit_cascade_stream when per-leaf loading is the point.
+        """
+        from tpusvm.stream.reader import ShardReader
+
+        t0 = time.perf_counter()
+        scaler = None
+        if self.scale:
+            self.scaler_ = scaler = dataset.scaler()
+        parts = [X for X, _ in ShardReader(dataset, scaler=scaler)]
+        Xs = np.concatenate(parts)
+        del parts
+        return self._fit_scaled(Xs, dataset.load_labels(), t0)
+
+    def _fit_scaled(self, Xs: np.ndarray, Y: np.ndarray,
+                    t0: float) -> "BinarySVC":
+        """Shared solve + SV extraction on an already-scaled matrix."""
+        cfg = self.config
         solve = blocked_smo_solve if self.solver == "blocked" else smo_solve
         res = solve(
             jnp.asarray(Xs, self.dtype),
@@ -167,6 +192,49 @@ class BinarySVC:
             solver=self.solver, solver_opts=self.solver_opts,
             stratified=stratified,
         )
+        return self._finish_cascade(res, t0)
+
+    def fit_cascade_stream(
+        self,
+        dataset,
+        cascade_config: CascadeConfig = CascadeConfig(),
+        mesh=None,
+        verbose: bool = False,
+        checkpoint_path: Optional[str] = None,
+        resume: bool = False,
+        stratified: bool = False,
+    ) -> "BinarySVC":
+        """Cascade training from a sharded dataset (tpusvm.stream).
+
+        The out-of-core twin of fit_cascade: the scaler comes from
+        MANIFEST statistics (the reference's rank-0 global min/max
+        broadcast, computed without holding X — mpi_svm_main3.cpp:529-539),
+        and each cascade leaf is filled by streaming dataset shards into a
+        prebuilt partition (stream.partition_from_dataset), so no
+        monolithic (n, d) array ever exists. Trains the IDENTICAL model to
+        fit_cascade on the equivalent array: same SV-ID set, same b, same
+        accuracy (the partition is bit-identical and everything downstream
+        consumes only the partition)."""
+        t0 = time.perf_counter()
+        from tpusvm.stream.assign import partition_from_dataset
+
+        scaler = None
+        if self.scale:
+            self.scaler_ = scaler = dataset.scaler()
+        part = partition_from_dataset(
+            dataset, cascade_config.n_shards, stratified=stratified,
+            scaler=scaler,
+        )
+        res = cascade_fit(
+            None, None, self.config, cascade_config, mesh=mesh,
+            dtype=self.dtype, accum_dtype=self.accum_dtype, verbose=verbose,
+            checkpoint_path=checkpoint_path, resume=resume,
+            solver=self.solver, solver_opts=self.solver_opts,
+            partition=part,
+        )
+        return self._finish_cascade(res, t0)
+
+    def _finish_cascade(self, res, t0: float) -> "BinarySVC":
         self.train_time_s_ = time.perf_counter() - t0
         self.sv_X_ = res.sv_X
         self.sv_Y_ = res.sv_Y

@@ -403,6 +403,7 @@ def cascade_fit(
     solver: str = "pair",
     solver_opts: Optional[dict] = None,
     stratified: bool = False,
+    partition=None,
 ) -> CascadeResult:
     """Train a binary SVM with the distributed cascade.
 
@@ -411,6 +412,15 @@ def cascade_fit(
     array first). accum_dtype: see smo_solve; the default "auto" resolves to
     f64 accumulators (enabling jax x64) — the mixed-precision mode matching
     the all-double reference; pass None for same-as-features accumulators.
+
+    partition: a prebuilt data.partition.Partition (already scaled) used
+    INSTEAD of partitioning X/Y here — the out-of-core entry point:
+    stream.partition_from_dataset fills one by streaming manifest shards
+    (with the manifest-fitted scaler), so the cascade never sees a
+    monolithic array. X/Y/stratified are ignored (pass None); everything
+    downstream — dedup-by-ID merges, convergence, checkpoints — keys on
+    the partition's global IDs either way. Its leaf count must equal
+    cascade_config.n_shards.
 
     checkpoint_path: if set, the inter-round state (global SV buffer +
     previous-round ID set) is written there after every round;
@@ -442,8 +452,16 @@ def cascade_fit(
         mesh = make_mesh(n_shards)
     sv_cap = cc.sv_capacity
 
-    part = make_partition(np.asarray(X), np.asarray(Y), n_shards,
-                          stratified=stratified)
+    if partition is not None:
+        if partition.X.shape[0] != n_shards:
+            raise ValueError(
+                f"prebuilt partition has {partition.X.shape[0]} leaves, "
+                f"cascade_config.n_shards is {n_shards}"
+            )
+        part = partition
+    else:
+        part = make_partition(np.asarray(X), np.asarray(Y), n_shards,
+                              stratified=stratified)
     chunk = part.X.shape[1]
     d = part.X.shape[2]
     train_cap = chunk + sv_cap

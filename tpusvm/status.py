@@ -55,6 +55,37 @@ class ServeStatus(enum.IntEnum):
     SHUTDOWN = 4
 
 
+class StreamStatus(enum.IntEnum):
+    """Per-shard integrity codes for the out-of-core data layer
+    (tpusvm.stream).
+
+    A sharded dataset is a directory of packed .npz shards plus a JSON
+    manifest recording per-shard row counts, feature min/max, class counts
+    and content checksums. `ShardedDataset.validate()` re-derives those
+    facts from the bytes on disk and reports one of these per shard —
+    `tpusvm info <dir>` and the ingest smoke gate branch on the codes
+    instead of guessing from exceptions:
+
+      OK                  bytes match the manifest's claims
+      MISSING_FILE        the shard file named by the manifest is absent
+      CHECKSUM_MISMATCH   content hash differs — the shard was modified
+                          (or corrupted) after ingest
+      ROW_COUNT_MISMATCH  the shard's arrays disagree with the manifest's
+                          n_rows / n_features (a truncated or swapped file
+                          that happens to parse)
+      STATS_MISMATCH      per-shard min/max or class counts don't re-derive
+                          from the rows — the manifest-fitted scaler and
+                          the stratified assignment would silently diverge
+                          from a full-array fit
+    """
+
+    OK = 0
+    MISSING_FILE = 1
+    CHECKSUM_MISMATCH = 2
+    ROW_COUNT_MISMATCH = 3
+    STATS_MISMATCH = 4
+
+
 class TuneStatus(enum.IntEnum):
     """Per-grid-point outcome codes for hyperparameter search (tpusvm.tune).
 

@@ -1,0 +1,82 @@
+"""tpusvm.stream — sharded out-of-core data pipeline.
+
+Every other path in the repo consumes one in-memory array; this package
+makes datasets a first-class ON-DISK artifact — the enabling layer for
+larger-than-RAM and multi-host workloads (ROADMAP "production-scale").
+The reference already sketches the shape (rank 0 computes global min/max,
+then scatters shards to workers, mpi_svm_main3.cpp:463-539); here the
+shards live on disk with their statistics in a manifest, and every
+consumer streams:
+
+  format.py   versioned layout: packed .npz shards + JSON manifest
+              (per-shard row counts, feature min/max, class counts,
+              content checksums); ShardWriter / ingest_* producers,
+              ShardedDataset reader handle, StreamStatus validation
+  stats.py    mergeable per-shard statistics: MinMaxScaler fitted from
+              the manifest BIT-IDENTICALLY to a full-array fit
+  reader.py   ShardReader: background-thread prefetch with a hard
+              prefetch_depth + 1 residency bound, deterministic order,
+              on-the-fly scaling
+  assign.py   global row -> cascade-leaf assignment (contiguous or
+              stratified, = data.partition semantics) computed from the
+              manifest; shard-streamed Partition construction; row
+              gathering for tune folds
+  infer.py    predict_stream / evaluate_stream over prefetched batches
+
+CLI: `tpusvm ingest` writes a dataset; `tpusvm train --data`,
+`tpusvm predict --data`, `tpusvm tune --data`, and `tpusvm info <dir>`
+consume one.
+"""
+
+from tpusvm.stream.assign import (
+    RowAssignment,
+    assign_rows,
+    gather_rows,
+    partition_from_dataset,
+)
+from tpusvm.stream.format import (
+    FORMAT_VERSION,
+    Manifest,
+    ShardInfo,
+    ShardWriter,
+    ShardedDataset,
+    ingest_arrays,
+    ingest_blocks,
+    ingest_csv,
+    is_dataset_dir,
+    open_dataset,
+    shard_checksum,
+)
+from tpusvm.stream.infer import evaluate_stream, predict_stream
+from tpusvm.stream.reader import ShardReader
+from tpusvm.stream.stats import (
+    ShardStats,
+    compute_stats,
+    merge_stats,
+    scaler_from_stats,
+)
+
+__all__ = [
+    "FORMAT_VERSION",
+    "Manifest",
+    "RowAssignment",
+    "ShardInfo",
+    "ShardReader",
+    "ShardStats",
+    "ShardWriter",
+    "ShardedDataset",
+    "assign_rows",
+    "compute_stats",
+    "evaluate_stream",
+    "gather_rows",
+    "ingest_arrays",
+    "ingest_blocks",
+    "ingest_csv",
+    "is_dataset_dir",
+    "merge_stats",
+    "open_dataset",
+    "partition_from_dataset",
+    "predict_stream",
+    "scaler_from_stats",
+    "shard_checksum",
+]

@@ -1,0 +1,454 @@
+"""Versioned sharded on-disk dataset format.
+
+Layout: a directory holding packed .npz shards plus one JSON manifest —
+
+    dataset/
+      manifest.json        format version, global shape, per-shard metadata
+      shard-00000.npz      arrays "X" (n_i, d) float64, "Y" (n_i,) int32
+      shard-00001.npz      ...
+
+The manifest records, per shard: filename, row count, the global row offset
+(global row order IS the concatenation of shards in manifest order), feature
+min/max, class counts (tpusvm.stream.stats), and a content checksum (sha256
+over the array bytes + a shape/dtype header, so the hash is a statement
+about the DATA, independent of npz container details like compression or
+zip timestamps). The reference's preprocessing facts — rank-0 global
+min/max, per-rank row counts (mpi_svm_main3.cpp:463-539) — are therefore
+all answerable from the manifest alone, without touching a shard.
+
+Writing goes through ShardWriter, which buffers appended blocks and cuts
+shards of exactly rows_per_shard rows (last one short), so ingest's peak
+memory is one shard regardless of dataset size. `ingest_csv` streams the
+CSV through data.read_csv_blocks; `ingest_arrays` shards an in-memory
+array (tests, synthetic generators).
+
+Versioning follows the house serialization rule (models/serialization.py):
+a manifest without format_version, or with an unknown one, is rejected
+with a clear error instead of being half-parsed.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import os
+from typing import Iterable, Iterator, List, Optional, Tuple
+
+import numpy as np
+
+from tpusvm.data.csv_reader import read_csv_blocks
+from tpusvm.status import StreamStatus
+from tpusvm.stream.stats import (
+    ShardStats,
+    compute_stats,
+    merge_stats,
+    scaler_from_stats,
+)
+
+FORMAT_VERSION = 1
+MANIFEST_NAME = "manifest.json"
+DEFAULT_ROWS_PER_SHARD = 65536
+
+
+def shard_checksum(X: np.ndarray, Y: np.ndarray) -> str:
+    """sha256 over shape/dtype header + row bytes (container-independent)."""
+    h = hashlib.sha256()
+    h.update(f"{X.shape[0]},{X.shape[1]},{X.dtype},{Y.dtype}".encode())
+    h.update(np.ascontiguousarray(X).tobytes())
+    h.update(np.ascontiguousarray(Y).tobytes())
+    return h.hexdigest()
+
+
+@dataclasses.dataclass
+class ShardInfo:
+    """One shard's manifest entry."""
+
+    filename: str
+    row_start: int
+    stats: ShardStats
+    sha256: str
+
+    @property
+    def n_rows(self) -> int:
+        return self.stats.n_rows
+
+    def to_json(self) -> dict:
+        return {
+            "filename": self.filename,
+            "row_start": int(self.row_start),
+            "sha256": self.sha256,
+            **self.stats.to_json(),
+        }
+
+    @classmethod
+    def from_json(cls, obj: dict) -> "ShardInfo":
+        return cls(
+            filename=str(obj["filename"]),
+            row_start=int(obj["row_start"]),
+            stats=ShardStats.from_json(obj),
+            sha256=str(obj["sha256"]),
+        )
+
+
+@dataclasses.dataclass
+class Manifest:
+    """The dataset-level metadata: shape, label convention, shard table."""
+
+    n_rows: int
+    n_features: int
+    shards: List[ShardInfo]
+    binary: bool = True
+    positive_label: Optional[int] = None  # set when binary ingest remapped
+
+    def global_stats(self) -> ShardStats:
+        return merge_stats([s.stats for s in self.shards])
+
+    def to_json(self) -> dict:
+        return {
+            "format_version": FORMAT_VERSION,
+            "n_rows": int(self.n_rows),
+            "n_features": int(self.n_features),
+            "binary": bool(self.binary),
+            "positive_label": self.positive_label,
+            "shards": [s.to_json() for s in self.shards],
+        }
+
+    @classmethod
+    def from_json(cls, obj: dict) -> "Manifest":
+        if "format_version" not in obj:
+            raise ValueError(
+                "not a tpusvm sharded-dataset manifest (no format_version)"
+            )
+        v = obj["format_version"]
+        if v != FORMAT_VERSION:
+            raise ValueError(
+                f"unsupported manifest format_version {v!r} (this build "
+                f"reads version {FORMAT_VERSION}); re-ingest the dataset"
+            )
+        m = cls(
+            n_rows=int(obj["n_rows"]),
+            n_features=int(obj["n_features"]),
+            shards=[ShardInfo.from_json(s) for s in obj["shards"]],
+            binary=bool(obj["binary"]),
+            positive_label=(None if obj.get("positive_label") is None
+                            else int(obj["positive_label"])),
+        )
+        # internal consistency: offsets/counts must tile [0, n_rows)
+        off = 0
+        for s in m.shards:
+            if s.row_start != off:
+                raise ValueError(
+                    f"manifest corrupt: shard {s.filename} row_start "
+                    f"{s.row_start} != running offset {off}"
+                )
+            off += s.n_rows
+        if off != m.n_rows:
+            raise ValueError(
+                f"manifest corrupt: shard rows sum to {off}, "
+                f"n_rows says {m.n_rows}"
+            )
+        return m
+
+
+def is_dataset_dir(path: str) -> bool:
+    """True when `path` is a directory holding a sharded-dataset manifest
+    (how the CLI tells a shards dir from a CSV file)."""
+    return os.path.isdir(path) and os.path.exists(
+        os.path.join(path, MANIFEST_NAME)
+    )
+
+
+class ShardWriter:
+    """Streaming writer: append (X, Y) blocks of any size, get fixed-size
+    shards + a manifest out. Peak memory = one shard's rows.
+
+    Usage:
+        with ShardWriter(out_dir, rows_per_shard=65536) as w:
+            for X, Y in blocks:
+                w.append(X, Y)
+        manifest = w.manifest
+
+    The manifest is written (atomically, temp-file + rename) on close; a
+    crash mid-ingest leaves no manifest, so the directory is never
+    mistaken for a complete dataset.
+    """
+
+    def __init__(self, out_dir: str,
+                 rows_per_shard: int = DEFAULT_ROWS_PER_SHARD,
+                 binary: bool = True,
+                 positive_label: Optional[int] = None):
+        if rows_per_shard < 1:
+            raise ValueError(
+                f"rows_per_shard must be >= 1, got {rows_per_shard}"
+            )
+        self.out_dir = out_dir
+        self.rows_per_shard = rows_per_shard
+        self.binary = binary
+        self.positive_label = positive_label
+        self.manifest: Optional[Manifest] = None
+        self._shards: List[ShardInfo] = []
+        self._pending: List[Tuple[np.ndarray, np.ndarray]] = []
+        self._pending_rows = 0
+        self._row_start = 0
+        self._n_features: Optional[int] = None
+        self._closed = False
+        os.makedirs(out_dir, exist_ok=True)
+
+    def __enter__(self) -> "ShardWriter":
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        if exc_type is None:
+            self.close()
+
+    def append(self, X: np.ndarray, Y: np.ndarray) -> None:
+        X = np.ascontiguousarray(X, np.float64)
+        Y = np.ascontiguousarray(Y, np.int32)
+        if X.ndim != 2 or Y.ndim != 1 or len(X) != len(Y):
+            raise ValueError(
+                f"append expects (n, d) X and (n,) Y, got {X.shape} / {Y.shape}"
+            )
+        if self._n_features is None:
+            self._n_features = X.shape[1]
+        elif X.shape[1] != self._n_features:
+            raise ValueError(
+                f"feature count changed mid-ingest: {X.shape[1]} vs "
+                f"{self._n_features}"
+            )
+        if len(X) == 0:
+            return
+        self._pending.append((X, Y))
+        self._pending_rows += len(X)
+        while self._pending_rows >= self.rows_per_shard:
+            self._flush_shard(self.rows_per_shard)
+
+    def _take(self, n: int) -> Tuple[np.ndarray, np.ndarray]:
+        """Pop exactly n rows off the pending buffers."""
+        xs, ys, taken = [], [], 0
+        while taken < n:
+            X, Y = self._pending[0]
+            need = n - taken
+            if len(X) <= need:
+                xs.append(X)
+                ys.append(Y)
+                taken += len(X)
+                self._pending.pop(0)
+            else:
+                xs.append(X[:need])
+                ys.append(Y[:need])
+                self._pending[0] = (X[need:], Y[need:])
+                taken = n
+        self._pending_rows -= n
+        if len(xs) == 1:
+            return xs[0], ys[0]
+        return np.concatenate(xs), np.concatenate(ys)
+
+    def _flush_shard(self, n: int) -> None:
+        X, Y = self._take(n)
+        idx = len(self._shards)
+        filename = f"shard-{idx:05d}.npz"
+        np.savez(os.path.join(self.out_dir, filename), X=X, Y=Y)
+        self._shards.append(ShardInfo(
+            filename=filename,
+            row_start=self._row_start,
+            stats=compute_stats(X, Y),
+            sha256=shard_checksum(X, Y),
+        ))
+        self._row_start += n
+
+    def close(self) -> Manifest:
+        if self._closed:
+            return self.manifest
+        self._closed = True
+        if self._pending_rows:
+            self._flush_shard(self._pending_rows)
+        if not self._shards:
+            raise ValueError(
+                "ShardWriter: no rows appended — refusing to write an "
+                "empty dataset (there is no honest manifest for it)"
+            )
+        self.manifest = Manifest(
+            n_rows=self._row_start,
+            n_features=int(self._n_features),
+            shards=self._shards,
+            binary=self.binary,
+            positive_label=self.positive_label,
+        )
+        tmp = os.path.join(self.out_dir, MANIFEST_NAME + ".tmp")
+        with open(tmp, "w") as f:
+            json.dump(self.manifest.to_json(), f, indent=1)
+            f.write("\n")
+        os.replace(tmp, os.path.join(self.out_dir, MANIFEST_NAME))
+        return self.manifest
+
+
+def ingest_blocks(out_dir: str,
+                  blocks: Iterable[Tuple[np.ndarray, np.ndarray]],
+                  rows_per_shard: int = DEFAULT_ROWS_PER_SHARD,
+                  binary: bool = True,
+                  positive_label: Optional[int] = None) -> Manifest:
+    """Shard any (X, Y)-block iterator (the generic ingest core)."""
+    with ShardWriter(out_dir, rows_per_shard, binary=binary,
+                     positive_label=positive_label) as w:
+        for X, Y in blocks:
+            w.append(X, Y)
+    return w.manifest
+
+
+def ingest_arrays(out_dir: str, X: np.ndarray, Y: np.ndarray,
+                  rows_per_shard: int = DEFAULT_ROWS_PER_SHARD,
+                  binary: Optional[bool] = None,
+                  positive_label: Optional[int] = None) -> Manifest:
+    """Shard an in-memory array pair (synthetic generators, tests).
+
+    binary defaults to whether Y only carries {+1, -1}."""
+    Y = np.asarray(Y)
+    if binary is None:
+        binary = bool(set(np.unique(Y).tolist()) <= {1, -1})
+    return ingest_blocks(out_dir, [(np.asarray(X), Y)], rows_per_shard,
+                         binary=binary, positive_label=positive_label)
+
+
+def ingest_csv(out_dir: str, csv_path: str,
+               rows_per_shard: int = DEFAULT_ROWS_PER_SHARD,
+               n_limit: Optional[int] = None,
+               binary: bool = True,
+               positive_label: int = 1,
+               block_rows: int = 8192) -> Manifest:
+    """Stream a labelled CSV into shards with reference reader semantics
+    (header skipped, short rows dropped, n_limit cap, one-vs-rest label
+    mapping with a parameterised positive class). Peak memory is
+    max(block_rows, rows_per_shard) rows — the CSV is never whole in RAM.
+    """
+    return ingest_blocks(
+        out_dir,
+        read_csv_blocks(csv_path, block_rows=min(block_rows, rows_per_shard),
+                        n_limit=n_limit, binary=binary,
+                        positive_label=positive_label),
+        rows_per_shard,
+        binary=binary,
+        positive_label=positive_label if binary else None,
+    )
+
+
+class ShardedDataset:
+    """Read-side handle on an ingested dataset directory.
+
+    Loading granularity is one shard; `load_labels` reads ONLY the Y
+    member of each npz (np.load on an npz is lazy per member), so a
+    labels-only pass — stratified assignment, fold splitting — costs 4
+    bytes/row of IO, not the full feature bytes.
+    """
+
+    def __init__(self, path: str, manifest: Manifest):
+        self.path = path
+        self.manifest = manifest
+
+    @property
+    def n_rows(self) -> int:
+        return self.manifest.n_rows
+
+    @property
+    def n_features(self) -> int:
+        return self.manifest.n_features
+
+    @property
+    def n_shards(self) -> int:
+        return len(self.manifest.shards)
+
+    def shard_path(self, i: int) -> str:
+        return os.path.join(self.path, self.manifest.shards[i].filename)
+
+    def load_shard(self, i: int, verify: bool = False
+                   ) -> Tuple[np.ndarray, np.ndarray]:
+        """One shard's (X, Y); verify=True re-checksums the content."""
+        with np.load(self.shard_path(i), allow_pickle=False) as z:
+            X, Y = z["X"], z["Y"]
+        if verify:
+            status = self._check_shard(i, X, Y)
+            if status != StreamStatus.OK:
+                raise ValueError(
+                    f"shard {self.manifest.shards[i].filename}: "
+                    f"{status.name} (re-ingest or restore the file)"
+                )
+        return X, Y
+
+    def load_labels(self) -> np.ndarray:
+        """All labels in global row order (Y-only pass; X never read)."""
+        ys = []
+        for i in range(self.n_shards):
+            with np.load(self.shard_path(i), allow_pickle=False) as z:
+                ys.append(z["Y"])
+        return np.concatenate(ys)
+
+    def load_arrays(self) -> Tuple[np.ndarray, np.ndarray]:
+        """The whole dataset, concatenated — MATERIALISES n_rows x
+        n_features in memory; the escape hatch for consumers that need a
+        flat array (single-chip fit), not the streaming path."""
+        xs, ys = [], []
+        for i in range(self.n_shards):
+            X, Y = self.load_shard(i)
+            xs.append(X)
+            ys.append(Y)
+        return np.concatenate(xs), np.concatenate(ys)
+
+    def iter_shards(self) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
+        for i in range(self.n_shards):
+            yield self.load_shard(i)
+
+    def stats(self) -> ShardStats:
+        return self.manifest.global_stats()
+
+    def scaler(self):
+        """MinMaxScaler fitted from manifest stats — bit-identical to a
+        fit on the concatenated array (stream.stats.scaler_from_stats)."""
+        return scaler_from_stats(self.stats())
+
+    # -------------------------------------------------------- validation
+    def _check_shard(self, i: int, X: np.ndarray,
+                     Y: np.ndarray) -> StreamStatus:
+        info = self.manifest.shards[i]
+        if (len(X) != info.n_rows or len(Y) != info.n_rows
+                or X.shape[1] != self.n_features):
+            return StreamStatus.ROW_COUNT_MISMATCH
+        if shard_checksum(X, Y) != info.sha256:
+            return StreamStatus.CHECKSUM_MISMATCH
+        s = compute_stats(X, Y)
+        if (not np.array_equal(s.min_val, info.stats.min_val)
+                or not np.array_equal(s.max_val, info.stats.max_val)
+                or s.class_counts != info.stats.class_counts):
+            return StreamStatus.STATS_MISMATCH
+        return StreamStatus.OK
+
+    def validate(self) -> List[StreamStatus]:
+        """Re-derive every shard's manifest claims from its bytes; one
+        StreamStatus per shard (all OK == the dataset is exactly what the
+        manifest says it is). Loads one shard at a time."""
+        out = []
+        for i in range(self.n_shards):
+            if not os.path.exists(self.shard_path(i)):
+                out.append(StreamStatus.MISSING_FILE)
+                continue
+            try:
+                with np.load(self.shard_path(i), allow_pickle=False) as z:
+                    X, Y = z["X"], z["Y"]
+            except (OSError, ValueError, KeyError):
+                out.append(StreamStatus.CHECKSUM_MISMATCH)
+                continue
+            out.append(self._check_shard(i, X, Y))
+        return out
+
+
+def open_dataset(path: str) -> ShardedDataset:
+    """Open an ingested dataset directory (reads + validates the manifest's
+    internal consistency; shard bytes are checked by validate())."""
+    manifest_path = os.path.join(path, MANIFEST_NAME)
+    if not os.path.exists(manifest_path):
+        raise FileNotFoundError(
+            f"{path!r} is not a sharded dataset (no {MANIFEST_NAME}; "
+            "create one with `tpusvm ingest`)"
+        )
+    with open(manifest_path) as f:
+        manifest = Manifest.from_json(json.load(f))
+    return ShardedDataset(path, manifest)

@@ -1,0 +1,75 @@
+"""Streamed inference: decision scores over prefetched batches.
+
+The reference's predict phase (gpu_svm_main3.cu:277-296) scores an
+in-memory test matrix in one pass; tpusvm's decision_function keeps that
+shape. This module removes the "in-memory" part: batches flow off a
+ShardReader — IO for the next shard overlapping the device matmul of the
+current batch — through the model's own decision_function/predict (so the
+train-time scaler, the SV-only sum, and the strict >0 sign rule are
+exactly the in-memory code path; the scores literally come from the same
+jitted kernel), with peak memory bounded by prefetch_depth + 1 shards
+plus one batch.
+
+A FIXED batch_size means the jitted scoring kernel compiles once for the
+stream (plus once for the short tail batch) — the compile-cache discipline
+serve.buckets applies to online traffic, applied to offline scans.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, Optional, Tuple
+
+import numpy as np
+
+from tpusvm.stream.format import ShardedDataset
+from tpusvm.stream.reader import ShardReader
+
+
+DEFAULT_BATCH = 4096
+
+
+def predict_stream(model, dataset: ShardedDataset,
+                   batch_size: int = DEFAULT_BATCH,
+                   prefetch_depth: int = 2,
+                   ) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
+    """Yield (scores, Y) per fixed-size batch, in global row order.
+
+    scores is model.decision_function on the RAW batch rows (the model
+    applies its train-time scaler itself — the scaled-with-TRAIN-min/max
+    evaluation protocol, main3.cpp:338-339); Y is the batch's stored
+    labels. Binary models yield (m,) scores; one-vs-rest (m, K).
+    """
+    reader = ShardReader(dataset, prefetch_depth=prefetch_depth)
+    for Xb, Yb in reader.batches(batch_size):
+        yield np.asarray(model.decision_function(Xb)), Yb
+
+
+def evaluate_stream(model, dataset: ShardedDataset,
+                    batch_size: int = DEFAULT_BATCH,
+                    prefetch_depth: int = 2,
+                    n_limit: Optional[int] = None) -> Tuple[float, int]:
+    """Accuracy of `model` over the dataset, never holding more than the
+    residency bound. Returns (accuracy, n_rows_scored).
+
+    n_limit caps scored rows (the gpu_svm_main4 argv[1] semantics applied
+    to evaluation); the reader is closed early, so capped runs do not pay
+    IO for the rest of the dataset.
+    """
+    correct = 0
+    scored = 0
+    reader = ShardReader(dataset, prefetch_depth=prefetch_depth)
+    batches = reader.batches(batch_size)
+    for Xb, Yb in batches:
+        if n_limit is not None and scored + len(Xb) > n_limit:
+            keep = n_limit - scored
+            Xb, Yb = Xb[:keep], Yb[:keep]
+        if len(Xb):
+            pred = np.asarray(model.predict(Xb))
+            correct += int((pred == Yb).sum())
+            scored += len(Xb)
+        if n_limit is not None and scored >= n_limit:
+            batches.close()  # releases the reader via its finally
+            break
+    if scored == 0:
+        raise ValueError("evaluate_stream: no rows scored")
+    return correct / scored, scored

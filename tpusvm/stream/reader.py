@@ -1,0 +1,198 @@
+"""Background-prefetching shard reader with a hard residency bound.
+
+The accelerator-feeding discipline (tf.data, Murray et al. 2021; PAPERS.md):
+IO for shard k+1 overlaps compute on shard k, so the consumer never stalls
+on disk — double buffering generalised to a depth-`prefetch_depth` pipeline.
+Concurrency model mirrors serve.batcher's: ONE daemon producer thread does
+all the loading, any consumer iterates; hand-off is a queue, shutdown is a
+sentinel, and a producer exception is re-raised in the consumer (never
+swallowed in a dead thread).
+
+The memory contract is enforced by construction, not convention: a
+semaphore with `prefetch_depth + 1` permits gates every shard LOAD, and a
+shard's permit is released only when the consumer moves past its block
+(or the reader closes). At any instant
+
+    resident shards = permits held <= prefetch_depth + 1
+
+counted across the producer's in-flight load, the queue, and the block the
+consumer is holding. `max_live_shards` records the high-water mark — the
+counting hook the tests assert on.
+
+Shard ORDER is deterministic: manifest order by default, or a fixed
+permutation drawn from np.random.default_rng(seed) — same seed, same
+traversal, on every platform (the tune/folds reproducibility rule applied
+to IO).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Iterator, Optional, Tuple
+
+import numpy as np
+
+from tpusvm.stream.format import ShardedDataset
+
+_SENTINEL = object()
+
+
+class ShardReader:
+    """Iterate a ShardedDataset's (X, Y) blocks with background prefetch.
+
+    Args:
+      dataset: an open ShardedDataset.
+      prefetch_depth: shards loaded ahead of the consumer (>= 1; 1 is
+        classic double buffering). Peak residency is prefetch_depth + 1
+        shards, enforced by a permit per resident shard.
+      seed: None = manifest order; an int = a deterministic shuffled
+        shard order (np.random.default_rng(seed).permutation).
+      scaler: optional fitted MinMaxScaler applied on the fly (e.g. the
+        manifest-fitted global scaler), so consumers see scaled rows
+        without a second pass over the data.
+      dtype: optional numpy dtype the X block is cast to after scaling.
+      verify: re-checksum each shard against the manifest on load.
+
+    Iterating yields (X, Y) per shard. `batches(m)` re-chunks the stream
+    into fixed m-row batches (last one short) without widening the
+    residency bound — a batch view borrows the current block.
+    """
+
+    def __init__(self, dataset: ShardedDataset, prefetch_depth: int = 2,
+                 seed: Optional[int] = None, scaler=None, dtype=None,
+                 verify: bool = False):
+        if prefetch_depth < 1:
+            raise ValueError(
+                f"prefetch_depth must be >= 1, got {prefetch_depth}"
+            )
+        self.dataset = dataset
+        self.prefetch_depth = prefetch_depth
+        self.scaler = scaler
+        self.dtype = dtype
+        self.verify = verify
+        order = np.arange(dataset.n_shards)
+        if seed is not None:
+            order = np.random.default_rng(seed).permutation(order)
+        self.shard_order = order
+        # residency accounting: one permit per resident shard
+        self._permits = threading.Semaphore(prefetch_depth + 1)
+        self._lock = threading.Lock()
+        self._live = 0
+        self.max_live_shards = 0
+        self._q: "queue.Queue" = queue.Queue()
+        self._stop = threading.Event()
+        self._consumer_holds = False
+        self._started = False
+        self._worker = threading.Thread(target=self._produce, daemon=True,
+                                        name="tpusvm-stream-reader")
+
+    # ---------------------------------------------------------- producer
+    def _acquire(self) -> bool:
+        """One permit per shard load; polls so close() can interrupt."""
+        while not self._stop.is_set():
+            if self._permits.acquire(timeout=0.05):
+                with self._lock:
+                    self._live += 1
+                    self.max_live_shards = max(self.max_live_shards,
+                                               self._live)
+                return True
+        return False
+
+    def _release(self) -> None:
+        with self._lock:
+            self._live -= 1
+        self._permits.release()
+
+    def _produce(self) -> None:
+        try:
+            for i in self.shard_order:
+                if not self._acquire():
+                    return  # closed while waiting for a permit
+                try:
+                    X, Y = self.dataset.load_shard(int(i),
+                                                   verify=self.verify)
+                    if self.scaler is not None:
+                        X = self.scaler.transform(X)
+                    if self.dtype is not None:
+                        X = np.asarray(X, self.dtype)
+                except BaseException:
+                    self._release()
+                    raise
+                self._q.put((X, Y))
+                if self._stop.is_set():
+                    return
+            self._q.put(_SENTINEL)
+        except BaseException as e:  # noqa: BLE001 — re-raised consumer-side
+            self._q.put(e)
+
+    # ---------------------------------------------------------- consumer
+    def __iter__(self) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
+        if self._started:
+            raise RuntimeError(
+                "ShardReader is single-pass; construct a new reader to "
+                "re-read (same seed = same order)"
+            )
+        self._started = True
+        self._worker.start()
+        try:
+            while True:
+                item = self._q.get()
+                if item is _SENTINEL:
+                    return
+                if isinstance(item, BaseException):
+                    raise item
+                if self._consumer_holds:
+                    self._release()  # moving past the previous block
+                self._consumer_holds = True
+                yield item
+                # NOTE: the yielded block's permit is released when the
+                # consumer asks for the NEXT block (or in close()) — the
+                # block it is still processing stays counted as resident.
+        finally:
+            self.close()
+
+    def batches(self, batch_size: int
+                ) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
+        """Fixed-size (X, Y) batches re-chunked across shard boundaries.
+
+        Peak residency is unchanged (a carried remainder is a copy of at
+        most batch_size - 1 rows, not a retained shard).
+        """
+        if batch_size < 1:
+            raise ValueError(f"batch_size must be >= 1, got {batch_size}")
+        rx, ry = None, None
+        for X, Y in self:
+            if rx is not None:
+                X = np.concatenate([rx, X])
+                Y = np.concatenate([ry, Y])
+                rx = ry = None
+            n_full = len(X) // batch_size * batch_size
+            for s in range(0, n_full, batch_size):
+                yield X[s:s + batch_size], Y[s:s + batch_size]
+            if n_full < len(X):
+                # copy: the remainder must not pin the whole shard block
+                rx, ry = X[n_full:].copy(), Y[n_full:].copy()
+        if rx is not None:
+            yield rx, ry
+
+    @property
+    def live_shards(self) -> int:
+        with self._lock:
+            return self._live
+
+    def close(self) -> None:
+        """Stop the producer and drop queued blocks. Idempotent."""
+        self._stop.set()
+        if self._started:
+            while True:
+                try:
+                    item = self._q.get_nowait()
+                except queue.Empty:
+                    break
+                if item is not _SENTINEL and not isinstance(item,
+                                                            BaseException):
+                    self._release()
+            if self._consumer_holds:
+                self._consumer_holds = False
+                self._release()

@@ -104,12 +104,17 @@ class _FoldCache:
     """Per-fold shared artifacts: scaled X (train fold order / val), row
     norms, labels. Built once; every grid point's fit and eval reuse the
     same device arrays, and rung subsets are prefix slices (so even the
-    norms cache is shared across rungs)."""
+    norms cache is shared across rungs).
 
-    def __init__(self, X: np.ndarray, Y: np.ndarray, fold: Fold, dtype,
+    rows_fn(indices) -> (len(indices), d) raw feature rows — a slice of
+    the in-memory array, or stream.gather_rows against a sharded dataset
+    (which loads only the shards carrying the fold's rows); the cache is
+    agnostic to where the bytes come from."""
+
+    def __init__(self, rows_fn, Y: np.ndarray, fold: Fold, dtype,
                  scale: bool):
-        Xtr = X[fold.train_idx]
-        Xval = X[fold.val_idx]
+        Xtr = rows_fn(fold.train_idx)
+        Xval = rows_fn(fold.val_idx)
         if scale:
             scaler = MinMaxScaler().fit(Xtr)
             Xtr = scaler.transform(Xtr)
@@ -146,8 +151,8 @@ def _point_row(C: float, gamma: float) -> Dict[str, Any]:
 
 
 def tune(
-    X: np.ndarray,
-    Y: np.ndarray,
+    X: Optional[np.ndarray],
+    Y: Optional[np.ndarray],
     grid: GridSpec,
     config: TuneConfig = TuneConfig(),
     *,
@@ -157,6 +162,7 @@ def tune(
     scale: bool = True,
     solver_opts: Optional[dict] = None,
     log_fn: Optional[Callable[[str], None]] = None,
+    dataset=None,
 ) -> TuneResult:
     """Cross-validated search over `grid`; returns the TuneResult table.
 
@@ -164,16 +170,38 @@ def tune(
     gamma are ignored — the grid supplies those per point. Fits use the
     blocked solver with the fold's cached row norms; extra static knobs
     (q, max_inner, ...) pass through solver_opts.
+
+    dataset: a stream.ShardedDataset used INSTEAD of (X, Y) — pass None
+    for both. Folds are computed from a labels-only manifest pass
+    (identical splits to the in-memory path: stratified_kfold is a pure
+    function of (Y, k, seed)), and each fold cache gathers only its own
+    rows, shard by shard (stream.gather_rows), so the monolithic array is
+    never materialised — peak residency is the fold caches plus one shard.
     """
-    X = np.asarray(X)
-    Y = np.asarray(Y)
+    if dataset is not None:
+        if X is not None or Y is not None:
+            raise ValueError("tune: pass (X, Y) or dataset=, not both")
+        from tpusvm.stream.assign import gather_rows
+
+        Y = dataset.load_labels()
+        n_rows, n_feat = dataset.n_rows, dataset.n_features
+
+        def rows_fn(idx):
+            return gather_rows(dataset, idx)
+    else:
+        X = np.asarray(X)
+        Y = np.asarray(Y)
+        n_rows, n_feat = X.shape
+
+        def rows_fn(idx):
+            return X[idx]
     accum = resolve_accum_dtype(accum_dtype)
     opts = dict(solver_opts or {})
     say = log_fn or (lambda msg: None)
     t_run = time.perf_counter()
 
     folds = stratified_kfold(Y, config.folds, seed=config.seed)
-    caches = [_FoldCache(X, Y, f, dtype, scale) for f in folds]
+    caches = [_FoldCache(rows_fn, Y, f, dtype, scale) for f in folds]
     n_full = min(c.n_train for c in caches)  # uniform rung cap: one
     # compiled solver shape per rung instead of one per ±1-row fold size
     points = grid.points()
@@ -287,8 +315,8 @@ def tune(
               "gamma_values": list(grid.gamma_values)},
         folds=config.folds,
         seed=config.seed,
-        n=int(X.shape[0]),
-        d=int(X.shape[1]),
+        n=int(n_rows),
+        d=int(n_feat),
         warm_start=config.warm_start,
         points=rows,
         winner=winner,
