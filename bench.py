@@ -48,7 +48,6 @@ import json
 import os
 import subprocess
 import sys
-import time
 
 import jax
 
@@ -504,8 +503,12 @@ def main():
                     f"fused canary: {msg}"
                 static_kwargs = dict(static_kwargs, fused_fupdate=False)
 
+    # end-of-run timing goes through the shared obs render path (the same
+    # three-line contract cli.py prints; single source: obs.report)
+    from tpusvm.utils import PhaseTimer
+
+    timer = PhaseTimer()
     log("compiling solver (AOT)...")
-    t0 = time.perf_counter()
     # Insurance for the unattended round-end run: a Mosaic lowering
     # regression must degrade the headline, not lose it. Degradation
     # ladder: tuned config (fused f-update resolves 'auto', i.e. ON for
@@ -547,26 +550,28 @@ def main():
         for flag in PALLAS_FLAG_RULES:
             xla_kw.pop(flag, None)
         ladder.append((xla_kw, "xla"))
-    for i, (kw, eng) in enumerate(ladder):
-        try:
-            compiled = blocked_smo_solve.lower(
-                Xd, Yd, **traced_kwargs, **kw
-            ).compile()
-            static_kwargs, engine = kw, eng
-            break
-        except Exception as e:  # noqa: BLE001 — any lowering/compile error
-            e_full = f"{type(e).__name__}: {e}"
-            fallback = (fallback + " | " if fallback else "") + e_full[:300]
-            log(f"WARNING: the {eng} config (rung {i}: "
-                f"fused_fupdate={kw.get('fused_fupdate', 'auto')!r}, "
-                f"layout={kw.get('pallas_layout', 'packed')}) failed to "
-                f"compile at full size. Full error:\n{e_full}")
-            if i == len(ladder) - 1:
-                # the always-compilable engine itself failed: nothing
-                # lower to fall to — surface the error rather than loop
-                raise
-            log("WARNING: trying the next ladder rung")
-    log(f"compile: {time.perf_counter() - t0:.1f}s")
+    with timer.phase("compile"):
+        for i, (kw, eng) in enumerate(ladder):
+            try:
+                compiled = blocked_smo_solve.lower(
+                    Xd, Yd, **traced_kwargs, **kw
+                ).compile()
+                static_kwargs, engine = kw, eng
+                break
+            except Exception as e:  # noqa: BLE001 — any lowering/compile
+                e_full = f"{type(e).__name__}: {e}"
+                fallback = (fallback + " | " if fallback else "") \
+                    + e_full[:300]
+                log(f"WARNING: the {eng} config (rung {i}: "
+                    f"fused_fupdate={kw.get('fused_fupdate', 'auto')!r}, "
+                    f"layout={kw.get('pallas_layout', 'packed')}) failed "
+                    f"to compile at full size. Full error:\n{e_full}")
+                if i == len(ladder) - 1:
+                    # the always-compilable engine itself failed: nothing
+                    # lower to fall to — surface the error rather than loop
+                    raise
+                log("WARNING: trying the next ladder rung")
+    log(f"compile: {timer['compile']:.1f}s")
 
     # Effective config via the solver's own resolution rules (the shared
     # helper blocked_smo_solve itself resolves through), computed from the
@@ -606,10 +611,10 @@ def main():
     # NOTE: jax.block_until_ready returns early on this environment's
     # experimental axon TPU runtime; a device->host copy is the only reliable
     # completion barrier, so the timed region ends when alpha lands on host.
-    t0 = time.perf_counter()
-    res = compiled(Xd, Yd, **traced_kwargs)
-    alpha_host = np.asarray(res.alpha)
-    train_s = time.perf_counter() - t0
+    with timer.phase("training"):
+        res = compiled(Xd, Yd, **traced_kwargs)
+        alpha_host = np.asarray(res.alpha)
+    train_s = timer["training"]
 
     status = Status(int(res.status))
     n_iter = int(res.n_iter)
@@ -635,6 +640,7 @@ def main():
         f"SVs={n_sv} b={float(res.b):.6f} train={train_s:.3f}s "
         f"~{hbm_gbps:.0f}GB/s streamed{peak_note}"
     )
+    log(timer.report())  # the shared three-line contract (obs.report)
     if status != Status.CONVERGED:
         log("WARNING: solver did not converge; reporting anyway")
 

@@ -1,0 +1,15 @@
+# known-bad: host callbacks inside a lax loop body (JX009) — per-iteration
+# device->host round trips; telemetry must be carry-resident instead
+import jax
+from jax import lax
+from jax.experimental import io_callback
+
+
+def solve(state0):
+    def body(state):
+        x, i = state
+        jax.debug.print("gap[{}] = {}", i, x[0])  # JX009: callback per iter
+        io_callback(lambda v: None, None, x)  # JX009: host escape per iter
+        return (x * 0.5, i + 1)
+
+    return lax.while_loop(lambda s: s[1] < 10, body, state0)

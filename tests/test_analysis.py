@@ -21,7 +21,7 @@ from tpusvm.analysis import all_rules, lint_file, lint_paths, lint_source
 REPO = Path(__file__).resolve().parent.parent
 CORPUS = REPO / "tests" / "analysis_corpus"
 RULE_IDS = ("JX001", "JX002", "JX003", "JX004", "JX005", "JX006",
-            "JX007", "JX008")
+            "JX007", "JX008", "JX009")
 
 
 # ---------------------------------------------------------------- registry

@@ -248,6 +248,43 @@ def test_sweep_p_tree_skips_non_power_of_two(capsys):
     assert _records(capsys) == []  # P=3 tree is skipped, nothing emitted
 
 
+def test_telemetry_overhead_smoke_schema(capsys):
+    # the convergence-telemetry cost harness (ISSUE 5): schema + the
+    # load-independent hard gate — the telemetry arm is BIT-identical to
+    # the off arm. The <= 3% overhead floor is asserted only on the
+    # committed full-size run (a smoke-shape CPU timing is pure noise)
+    from benchmarks import telemetry_overhead
+
+    rc = telemetry_overhead.main(["--smoke"])
+    assert rc == 0
+    recs = _records(capsys)
+    assert len(recs) == 1
+    r = recs[0]
+    assert r["bench"] == "telemetry_overhead"
+    assert r["workload"]["synthetic"] is True
+    assert r["bit_identical"] is True
+    assert r["t_off_s"] > 0 and r["t_on_s"] > 0
+    assert r["rounds_recorded"] >= 1
+    assert r["status"] == "CONVERGED"
+    assert r["final_gap"] is not None and r["final_gap"] <= 2e-5 * 1.001
+    assert r["violations"] == []
+    # the committed CPU record carries the same schema AND passes the
+    # acceptance gates this PR claims (<= 3% overhead, bit identity)
+    import json as _json
+    import os as _os
+
+    path = _os.path.join(_os.path.dirname(_os.path.dirname(
+        _os.path.abspath(__file__))), "benchmarks", "results",
+        "telemetry_overhead_cpu.jsonl")
+    committed = [_json.loads(line) for line in open(path)]
+    assert committed and set(r) <= set(committed[0])
+    full = committed[-1]
+    assert full["smoke"] is False
+    assert full["bit_identical"] is True
+    assert full["overhead_frac"] <= full["gate_frac"] == 0.03
+    assert full["violations"] == []
+
+
 def test_ingest_throughput_smoke_schema(capsys):
     from benchmarks import ingest_throughput
 

@@ -10,4 +10,5 @@ from tpusvm.analysis.rules import (  # noqa: F401
     jx006_global_config,
     jx007_debug_leftover,
     jx008_pallas_flags,
+    jx009_loop_callback,
 )

@@ -1,0 +1,108 @@
+"""JX009 — host escape inside a solver inner loop (telemetry must be
+carry-resident).
+
+The convergence-telemetry design rule, enforced: inside the body of a
+lax LOOP combinator (`lax.while_loop` / `lax.scan` / `lax.fori_loop` /
+`lax.map` / `lax.associative_scan`, and anything lexically nested in
+one), any host-callback insertion — `jax.debug.print`,
+`jax.debug.callback`, `jax.experimental.io_callback`,
+`jax.pure_callback`, `jax.experimental.host_callback.call` — or host
+materialisation of a tracer (`np.asarray`/`np.array`) schedules a
+device->host round trip PER ITERATION of the compiled hot loop. That is
+exactly how "just print the gap" observability destroys a solver whose
+entire design is zero host syncs until termination. The sanctioned
+pattern is the one `blocked_smo_solve(telemetry=T)` uses: write into a
+ring carried through the loop state and materialise once at the end,
+with the rest of the result.
+
+Scope is deliberately narrower than its siblings so each fires on its
+own hazard: JX007 covers debug hooks anywhere in kernel-path FILES;
+JX002 covers materialisation in any traced function and syncs in host
+loops; JX009 is specifically the per-iteration callback inside a
+compiled loop body, in any file. (Overlaps on the same line are
+possible in real code — that is two true findings, not a conflict; the
+single-hazard corpus snippets keep them separable.)
+
+Legitimate uses of `io_callback` OUTSIDE loop bodies (e.g. a one-shot
+checkpoint hook) are not flagged.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tpusvm.analysis.core import Finding, snippet_at
+from tpusvm.analysis.registry import Rule, register
+
+_CALLBACK_CALLS = {
+    "jax.debug.print",
+    "jax.debug.callback",
+    "jax.debug.breakpoint",
+    "jax.experimental.io_callback",
+    "jax.experimental.host_callback.call",
+    "jax.experimental.host_callback.id_tap",
+    "jax.pure_callback",
+}
+
+# loop combinators whose bodies re-execute per iteration (the cond/switch
+# combinators are single-shot and excluded: a callback there is JX007's
+# business when it matters)
+_LOOP_REASONS = (
+    "jax.lax.while_loop body",
+    "jax.lax.scan body",
+    "jax.lax.fori_loop body",
+    "jax.lax.map body",
+    "jax.lax.associative_scan body",
+)
+
+_HOST_MATERIALIZERS = {"numpy.asarray", "numpy.array", "numpy.copy"}
+
+
+def _in_loop_body(tf) -> bool:
+    """True when tf is a loop-combinator body or nested inside one."""
+    cur = tf
+    while cur is not None:
+        if cur.reason in _LOOP_REASONS:
+            return True
+        cur = cur.parent
+    return False
+
+
+@register
+class LoopHostCallback(Rule):
+    id = "JX009"
+    summary = ("host callback / materialisation inside a lax loop body "
+               "(a device->host round trip per iteration; telemetry "
+               "must be carry-resident)")
+
+    def check(self, ctx):
+        for tf in ctx.traced_functions:
+            if not _in_loop_body(tf):
+                continue
+            for node in tf.own_nodes:
+                if not isinstance(node, ast.Call):
+                    continue
+                resolved = ctx.resolve_call(node)
+                hit = None
+                if resolved in _CALLBACK_CALLS:
+                    hit = (f"{resolved} inserts a host callback into the "
+                           "compiled loop")
+                elif resolved in _HOST_MATERIALIZERS and any(
+                    ctx.expr_taints(a, tf.tracer_names) for a in node.args
+                ):
+                    hit = (f"{resolved.split('.')[-1]}() materialises loop "
+                           "state on the host")
+                if hit:
+                    yield Finding(
+                        rule=self.id, path=ctx.path, line=node.lineno,
+                        col=node.col_offset + 1,
+                        message=(
+                            f"{hit} — one device->host round trip PER "
+                            f"ITERATION of {tf.name!r} ({tf.reason}); "
+                            "carry the values through the loop state and "
+                            "materialise once at the end "
+                            "(blocked_smo_solve's telemetry ring is the "
+                            "house pattern)"
+                        ),
+                        snippet=snippet_at(ctx.lines, node.lineno),
+                    )

@@ -14,6 +14,9 @@ zero-flag run is a parity run.
     python -m tpusvm train --data shards/ --mode cascade --shards 8
     python -m tpusvm predict --model model.npz --data test.csv
     python -m tpusvm predict --model model.npz --data shards/
+    python -m tpusvm train --synthetic rings --n 500 --convergence 128 \
+        --trace run.jsonl
+    python -m tpusvm report run.jsonl
     python -m tpusvm info
 
 Output reproduces the reference's diagnostics contract (SURVEY.md
@@ -168,6 +171,13 @@ def _build_parser() -> argparse.ArgumentParser:
     mode.add_argument("--multiclass", action="store_true",
                       help="one-vs-rest over all labels instead of the "
                       "reference's binary '1 vs rest' mapping")
+    mode.add_argument(
+        "--convergence", type=int, default=0, metavar="T",
+        help="carry a T-slot convergence ring through the blocked "
+        "solver's outer loop (per-round Keerthi gap / update count / "
+        "status, zero host syncs, bit-transparent to the solution); "
+        "0 = off. Requires --mode single with the blocked solver; "
+        "renders via `tpusvm report` when combined with --trace")
     mode.add_argument("--class-parallel", action="store_true",
                       help="with --multiclass: shard the class axis over "
                       "the device mesh (one-vs-rest problems train "
@@ -199,8 +209,18 @@ def _build_parser() -> argparse.ArgumentParser:
     out.add_argument("--save", metavar="NPZ", help="save the trained model")
     out.add_argument("--jsonl", metavar="PATH",
                      help="append structured run events to a JSONL file")
-    out.add_argument("--profile", metavar="DIR",
-                     help="capture a jax.profiler trace of training")
+    out.add_argument("--trace", metavar="PATH",
+                     help="write a schema-versioned JSONL telemetry trace "
+                     "(phase spans, cascade rounds, convergence records, "
+                     "metric counters); render with `tpusvm report PATH`")
+    out.add_argument("--profile", "--xprof", metavar="DIR", dest="profile",
+                     help="capture a jax.profiler trace of training "
+                     "(kernel-level; view in TensorBoard/Perfetto)")
+    out.add_argument("--smoke", action="store_true",
+                     help="CI gate: tiny synthetic run with convergence "
+                     "telemetry on; asserts convergence, held-out "
+                     "accuracy, and (with --trace) a well-formed trace; "
+                     "non-zero exit on any failure")
     out.add_argument("-q", "--quiet", action="store_true")
 
     ing = sub.add_parser(
@@ -227,6 +247,9 @@ def _build_parser() -> argparse.ArgumentParser:
                      "parity with the generator, scaler-from-stats parity "
                      "with a full-array fit, and the prefetch residency "
                      "bound; non-zero exit on any failure")
+    ing.add_argument("--trace", metavar="PATH",
+                     help="write ingest phase spans + shard/stream "
+                     "counters to a JSONL telemetry trace")
     ing.add_argument("-q", "--quiet", action="store_true")
 
     pr = sub.add_parser("predict", parents=[common],
@@ -280,6 +303,9 @@ def _build_parser() -> argparse.ArgumentParser:
     sv.add_argument("--smoke-threads", type=int, default=8)
     sv.add_argument("--smoke-requests", type=int, default=32,
                     help="requests per smoke thread")
+    sv.add_argument("--trace", metavar="PATH",
+                    help="write serve phase spans + final per-model "
+                    "metric snapshots to a JSONL telemetry trace")
 
     tu = sub.add_parser(
         "tune", parents=[common],
@@ -348,6 +374,9 @@ def _build_parser() -> argparse.ArgumentParser:
     out2 = tu.add_argument_group("output")
     out2.add_argument("--results", metavar="JSON",
                       help="write the versioned TuneResult table here")
+    out2.add_argument("--trace", metavar="PATH",
+                      help="write search phase spans + per-point "
+                      "tune.point events to a JSONL telemetry trace")
     out2.add_argument("--save", metavar="NPZ",
                       help="save the winner model trained on the full data")
     out2.add_argument("--smoke", action="store_true",
@@ -362,6 +391,21 @@ def _build_parser() -> argparse.ArgumentParser:
     inf.add_argument("path", nargs="?", default=None,
                      help="optional artifact: a model .npz or a tune "
                      "results .json (auto-detected)")
+
+    rep = sub.add_parser(
+        "report", parents=[common],
+        help="render a --trace JSONL telemetry file: phase summary "
+        "(the reference's three-line timing contract), convergence-gap "
+        "table, and non-zero counters")
+    rep.add_argument("path", metavar="TRACE",
+                     help="trace file written by --trace on "
+                     "train/tune/serve/ingest")
+    rep.add_argument("--max-rows", type=int, default=40,
+                     help="convergence table rows before middle elision")
+    rep.add_argument("--smoke", action="store_true",
+                     help="CI gate: non-zero exit unless the trace "
+                     "parses at the current schema version and carries "
+                     "at least one phase span and one convergence record")
     return p
 
 
@@ -454,6 +498,18 @@ def _cmd_train(args) -> int:
     from tpusvm.models import BinarySVC, OneVsRestSVC
     from tpusvm.utils import PhaseTimer, RunLogger, trace
 
+    if args.smoke:
+        # the CI gate shape: tiny, CPU-friendly, deterministic, with the
+        # convergence ring ON so the trace carries a real gap trajectory
+        args.synthetic, args.train, args.data = "rings", None, None
+        args.test = None
+        args.n, args.n_test, args.n_limit = 240, 60, None
+        args.C, args.gamma = 10.0, 10.0
+        args.mode, args.multiclass = "single", False
+        args.solver = args.solver or "blocked"
+        if args.convergence == 0:
+            args.convergence = 32
+
     # "float64" (the default) = the library's "auto" resolution: f64
     # accumulators + x64 enabled — one source of truth for that rule. The
     # library's enabling-x64 warning is suppressed here: its remediation
@@ -521,10 +577,31 @@ def _cmd_train(args) -> int:
     if args.stratify and args.mode != "cascade":
         raise SystemExit("--stratify only applies to --mode cascade (it "
                          "changes how shards are dealt over the mesh)")
+    if args.convergence:
+        if args.convergence < 0:
+            raise SystemExit("--convergence must be >= 0")
+        solver_name = args.solver or ("pair" if args.multiclass
+                                      else "blocked")
+        if args.mode != "single" or args.multiclass \
+                or solver_name != "blocked":
+            raise SystemExit(
+                "--convergence needs --mode single with the blocked "
+                "solver (the ring is carried through "
+                "blocked_smo_solve's outer loop)"
+            )
+        if "telemetry" in solver_opts:
+            raise SystemExit("--convergence and --solver-opt telemetry= "
+                             "are the same knob; pass one")
+        solver_opts["telemetry"] = args.convergence
 
+    tracer = None
+    if args.trace:
+        from tpusvm.obs import Tracer
+
+        tracer = Tracer(args.trace, argv=["train"])
     log = RunLogger(jsonl_path=args.jsonl,
                     primary=(jax.process_index() == 0) and not args.quiet)
-    timer = PhaseTimer()
+    timer = PhaseTimer(tracer=tracer)
 
     dataset = None
     if args.data:
@@ -596,12 +673,14 @@ def _cmd_train(args) -> int:
                     model.fit_cascade_stream(
                         dataset, cc, verbose=not args.quiet,
                         checkpoint_path=args.checkpoint,
-                        resume=args.resume, stratified=args.stratify)
+                        resume=args.resume, stratified=args.stratify,
+                        tracer=tracer)
                 else:
                     model.fit_cascade(X, Y, cc, verbose=not args.quiet,
                                       checkpoint_path=args.checkpoint,
                                       resume=args.resume,
-                                      stratified=args.stratify)
+                                      stratified=args.stratify,
+                                      tracer=tracer)
                 log.info("cascade: %d rounds, converged = %s",
                          model.cascade_rounds_,
                          model.status_.name == "CONVERGED")
@@ -621,6 +700,7 @@ def _cmd_train(args) -> int:
                   sv_count=model.n_support_, status=model.status_.name,
                   train_time_s=timer["training"])
 
+    acc = None
     if Xt is not None and len(Xt):
         with timer.phase("prediction"):
             acc = model.score(Xt, Yt)
@@ -632,9 +712,59 @@ def _cmd_train(args) -> int:
         model.save(args.save)
         log.info("model saved to %s", args.save)
 
+    conv = getattr(model, "convergence_", None)
+    if conv is not None and not args.quiet:
+        from tpusvm.obs import format_gap_table
+
+        log.info("convergence (b_low - b_high per outer round):")
+        log.info("%s", format_gap_table(conv))
+    if tracer is not None:
+        if conv is not None:
+            from tpusvm.obs import to_trace_events
+
+            to_trace_events(tracer, conv)
+        from tpusvm.obs import default_registry
+
+        tracer.metrics_snapshot(default_registry().snapshot())
+
     log.info("%s", timer.report())
     log.event("timing", **timer.asdict())
     log.close()
+    if tracer is not None:
+        tracer.close()
+
+    if args.smoke:
+        failures = []
+        if model.status_.name != "CONVERGED":
+            failures.append(f"solver ended {model.status_.name}")
+        if acc is None or acc <= 0.8:
+            failures.append(f"held-out accuracy gate failed ({acc!r})")
+        if conv is None or len(conv["gap"]) == 0:
+            failures.append("no convergence telemetry recorded")
+        elif conv["gap"][-1] > 2.0 * args.tau * (1 + 1e-9):
+            failures.append(
+                f"final recorded gap {conv['gap'][-1]:g} exceeds the "
+                f"2*tau stopping criterion ({2 * args.tau:g})")
+        if args.trace:
+            from tpusvm.obs import read_trace
+            from tpusvm.obs.report import convergence_rows, phase_summary
+
+            try:
+                records = read_trace(args.trace)
+                phases, total = phase_summary(records)
+                if not phases:
+                    failures.append("trace carries no phase spans")
+                if not convergence_rows(records):
+                    failures.append("trace carries no convergence records")
+            except ValueError as e:
+                failures.append(f"trace unreadable: {e}")
+        if failures:
+            for f in failures:
+                print(f"TRAIN SMOKE FAILED: {f}")
+            return 1
+        print(f"train smoke ok: {model.n_support_} SVs, "
+              f"accuracy {acc:.4f}, "
+              f"{conv['rounds_recorded']} convergence rounds recorded")
     return 0
 
 
@@ -666,6 +796,7 @@ def _cmd_ingest(args) -> int:
     """Convert a CSV / synthetic generator into a sharded dataset dir."""
     from tpusvm.status import StreamStatus
     from tpusvm.stream import ingest_arrays, ingest_csv, open_dataset
+    from tpusvm.utils import PhaseTimer
 
     say = (lambda msg: None) if args.quiet else print
 
@@ -676,25 +807,45 @@ def _cmd_ingest(args) -> int:
     if (args.train is None) == (args.synthetic is None):
         raise SystemExit("ingest: pass exactly one of --train / --synthetic")
 
-    if args.train:
-        manifest = ingest_csv(
-            args.out, args.train, rows_per_shard=args.rows_per_shard,
-            n_limit=args.n_limit, binary=not args.multiclass,
-            positive_label=args.positive_label, block_rows=args.block_rows,
-        )
-    else:
-        # synthetic generators are in-memory anyway; shard their output
-        args.n_test = 0
-        X, Y, _, _ = _load_train_data(args)
-        manifest = ingest_arrays(
-            args.out, X, Y, rows_per_shard=args.rows_per_shard,
-            binary=not args.multiclass,
-            positive_label=None if args.multiclass else args.positive_label,
-        )
+    tracer = None
+    if args.trace:
+        from tpusvm.obs import Tracer
 
-    bad = [(manifest.shards[i].filename, s.name)
-           for i, s in enumerate(open_dataset(args.out).validate())
-           if s != StreamStatus.OK]
+        tracer = Tracer(args.trace, argv=["ingest"])
+    timer = PhaseTimer(tracer=tracer)
+
+    with timer.phase("ingest"):
+        if args.train:
+            manifest = ingest_csv(
+                args.out, args.train, rows_per_shard=args.rows_per_shard,
+                n_limit=args.n_limit, binary=not args.multiclass,
+                positive_label=args.positive_label,
+                block_rows=args.block_rows,
+            )
+        else:
+            # synthetic generators are in-memory anyway; shard their output
+            args.n_test = 0
+            X, Y, _, _ = _load_train_data(args)
+            manifest = ingest_arrays(
+                args.out, X, Y, rows_per_shard=args.rows_per_shard,
+                binary=not args.multiclass,
+                positive_label=(None if args.multiclass
+                                else args.positive_label),
+            )
+
+    with timer.phase("validate"):
+        bad = [(manifest.shards[i].filename, s.name)
+               for i, s in enumerate(open_dataset(args.out).validate())
+               if s != StreamStatus.OK]
+    if tracer is not None:
+        from tpusvm.obs import default_registry
+
+        tracer.event("ingest.manifest", n_rows=manifest.n_rows,
+                     n_features=manifest.n_features,
+                     n_shards=len(manifest.shards), out=args.out,
+                     valid=not bad)
+        tracer.metrics_snapshot(default_registry().snapshot())
+        tracer.close()
     if bad:
         print(f"ingest: wrote shards that FAIL validation: {bad}")
         return 1
@@ -702,6 +853,7 @@ def _cmd_ingest(args) -> int:
     say(f"ingested {manifest.n_rows} rows x {manifest.n_features} features "
         f"into {len(manifest.shards)} shards at {args.out}")
     say(f"class counts: {dict(sorted(stats.class_counts.items()))}")
+    say(timer.report())
     return 0
 
 
@@ -818,6 +970,7 @@ def _cmd_predict(args) -> int:
 
 
 def _cmd_serve(args) -> int:
+    import contextlib
     import json
     import os
 
@@ -831,6 +984,22 @@ def _cmd_serve(args) -> int:
         queue_size=args.queue_size,
         timeout_ms=args.timeout_ms,
     )
+    tracer = None
+    if args.trace:
+        from tpusvm.obs import Tracer
+
+        tracer = Tracer(args.trace, argv=["serve"])
+
+    def _trace_final_metrics():
+        if tracer is None:
+            return
+        for name in server.registry.names():
+            tracer.event("serve.metrics", model=name,
+                         snapshot=server.metrics(name))
+            tracer.metrics_snapshot(
+                server._worker(name).metrics.registry_snapshot())
+        tracer.close()
+
     server = Server(cfg, dtype=getattr(jnp, args.dtype))
     for spec in args.models:
         name, sep, path = spec.partition("=")
@@ -842,12 +1011,20 @@ def _cmd_serve(args) -> int:
         print(f"loaded {name}: {entry.kind}, {entry.n_sv} SVs, "
               f"{entry.n_features} features")
     if not args.no_warmup:
-        for name, n in server.warmup().items():
-            print(f"warmed {name}: {n} bucket executables compiled")
+        warm_span = (tracer.span("warmup", phase=True) if tracer
+                     else contextlib.nullcontext())
+        with warm_span:
+            for name, n in server.warmup().items():
+                print(f"warmed {name}: {n} bucket executables compiled")
 
     if args.smoke:
-        rc = _serve_smoke(server, args.smoke_threads, args.smoke_requests)
+        smoke_span = (tracer.span("smoke", phase=True) if tracer
+                      else contextlib.nullcontext())
+        with smoke_span:
+            rc = _serve_smoke(server, args.smoke_threads,
+                              args.smoke_requests)
         print(server.metrics_text(), end="")
+        _trace_final_metrics()
         server.close()
         return rc
 
@@ -865,6 +1042,7 @@ def _cmd_serve(args) -> int:
         httpd.shutdown()
         print(server.metrics_text(), end="")
         print(json.dumps(server.status()))
+        _trace_final_metrics()
         server.close()
     return 0
 
@@ -970,7 +1148,12 @@ def _cmd_tune(args) -> int:
             "auto" if args.accum == "float64" else None
         )
 
-    timer = PhaseTimer()
+    tracer = None
+    if args.trace:
+        from tpusvm.obs import Tracer
+
+        tracer = Tracer(args.trace, argv=["tune"])
+    timer = PhaseTimer(tracer=tracer)
     dataset = None
     if args.data:
         # streamed source: folds come from a labels-only manifest pass,
@@ -1010,6 +1193,7 @@ def _cmd_tune(args) -> int:
             solver_opts=_parse_solver_opts(args.solver_opt),
             log_fn=(lambda msg: None) if args.quiet else print,
             dataset=dataset,
+            tracer=tracer,
         )
     print(format_table(result))
     if args.results:
@@ -1037,6 +1221,11 @@ def _cmd_tune(args) -> int:
         model.save(args.save)
         say(f"model saved to {args.save}")
     say(timer.report())
+    if tracer is not None:
+        from tpusvm.obs import default_registry
+
+        tracer.metrics_snapshot(default_registry().snapshot())
+        tracer.close()
 
     if args.smoke:
         evaluated = [r for r in result.points
@@ -1127,6 +1316,60 @@ def _info_dataset(path: str) -> int:
     return 0
 
 
+def _cmd_report(args) -> int:
+    """Render a --trace JSONL telemetry file back into the reference's
+    human-readable contracts (phase timing block + convergence table)."""
+    from tpusvm.obs import read_trace
+    from tpusvm.obs.report import (
+        convergence_rows,
+        format_convergence_table,
+        nonzero_counters,
+        phase_summary,
+        render_phase_lines,
+    )
+
+    try:
+        records = read_trace(args.path)
+    except OSError as e:
+        raise SystemExit(f"report: cannot read {args.path!r} ({e})")
+    except ValueError as e:
+        if args.smoke:
+            print(f"REPORT SMOKE FAILED: {e}")
+            return 1
+        raise SystemExit(f"report: {e}")
+
+    phases, total = phase_summary(records)
+    conv = convergence_rows(records)
+    spans = sum(1 for r in records if r["kind"] == "span")
+    events = sum(1 for r in records if r["kind"] == "event")
+    print(f"trace: {args.path} ({spans} spans, {events} events)")
+    print()
+    print("convergence (b_low - b_high per outer round):")
+    print(format_convergence_table(conv, max_rows=args.max_rows))
+    print()
+    counters = nonzero_counters(records)
+    if counters:
+        print("counters:")
+        for line in counters:
+            print(f"  {line}")
+        print()
+    print(render_phase_lines(phases, total))
+
+    if args.smoke:
+        failures = []
+        if not phases:
+            failures.append("no phase spans in the trace")
+        if not conv:
+            failures.append("no convergence records in the trace")
+        if failures:
+            for f in failures:
+                print(f"REPORT SMOKE FAILED: {f}")
+            return 1
+        print(f"report smoke ok: {len(phases)} phases, "
+              f"{len(conv)} convergence rounds")
+    return 0
+
+
 def _cmd_info(args) -> int:
     if args.path:
         return _info_artifact(args.path)
@@ -1175,7 +1418,8 @@ def main(argv=None) -> int:
         jax.distributed.initialize(**kw)
     return {"train": _cmd_train, "ingest": _cmd_ingest,
             "predict": _cmd_predict, "serve": _cmd_serve,
-            "tune": _cmd_tune, "info": _cmd_info}[args.command](args)
+            "tune": _cmd_tune, "info": _cmd_info,
+            "report": _cmd_report}[args.command](args)
 
 
 if __name__ == "__main__":

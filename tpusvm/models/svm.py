@@ -86,6 +86,9 @@ class BinarySVC:
         self.n_iter_: int = 0
         self.status_: Status = Status.RUNNING
         self.train_time_s_: float = 0.0
+        # materialized convergence telemetry (obs.convergence.materialize
+        # output) when the blocked solver ran with telemetry=T > 0
+        self.convergence_: Optional[dict] = None
 
     # ------------------------------------------------------------------ fit
     def _scale_fit(self, X: np.ndarray) -> np.ndarray:
@@ -138,6 +141,11 @@ class BinarySVC:
         )
         alpha = np.asarray(res.alpha)  # device->host copy = completion barrier
         self.train_time_s_ = time.perf_counter() - t0
+        tele = getattr(res, "telemetry", None)
+        if tele is not None:
+            from tpusvm.obs.convergence import materialize
+
+            self.convergence_ = materialize(tele)
         sv = get_sv_indices(alpha, cfg.sv_tol)
         self.sv_X_ = Xs[sv]
         self.sv_Y_ = np.asarray(Y)[sv].astype(np.int32)
@@ -169,6 +177,7 @@ class BinarySVC:
         checkpoint_path: Optional[str] = None,
         resume: bool = False,
         stratified: bool = False,
+        tracer=None,
     ) -> "BinarySVC":
         """Distributed cascade training over a device mesh (MPI capability).
 
@@ -190,7 +199,7 @@ class BinarySVC:
             accum_dtype=self.accum_dtype, verbose=verbose,
             checkpoint_path=checkpoint_path, resume=resume,
             solver=self.solver, solver_opts=self.solver_opts,
-            stratified=stratified,
+            stratified=stratified, tracer=tracer,
         )
         return self._finish_cascade(res, t0)
 
@@ -203,6 +212,7 @@ class BinarySVC:
         checkpoint_path: Optional[str] = None,
         resume: bool = False,
         stratified: bool = False,
+        tracer=None,
     ) -> "BinarySVC":
         """Cascade training from a sharded dataset (tpusvm.stream).
 
@@ -230,7 +240,7 @@ class BinarySVC:
             dtype=self.dtype, accum_dtype=self.accum_dtype, verbose=verbose,
             checkpoint_path=checkpoint_path, resume=resume,
             solver=self.solver, solver_opts=self.solver_opts,
-            partition=part,
+            partition=part, tracer=tracer,
         )
         return self._finish_cascade(res, t0)
 

@@ -1,0 +1,44 @@
+"""tpusvm.obs — unified telemetry: metrics registry, JSONL tracing,
+on-device convergence telemetry, and the shared report renderers.
+
+Three pillars (see each module's docstring):
+  registry.py    — process-wide counters/gauges/histograms with exactly
+                   mergeable snapshots (serve/tune/stream/cascade share
+                   one vocabulary);
+  trace.py       — schema-versioned JSONL span/event tracer + PhaseTimer
+                   (the span adapter preserving the reference's
+                   three-line timing contract);
+  convergence.py — host half of the solver's carry-resident convergence
+                   ring (device half: solver/blocked.py telemetry=T).
+report.py renders all of it (`tpusvm report <trace.jsonl>`).
+"""
+
+from tpusvm.obs.registry import (
+    MetricsRegistry,
+    default_registry,
+    merge_snapshots,
+    render_snapshot_text,
+    reset_default_registry,
+)
+from tpusvm.obs.trace import PhaseTimer, Tracer, read_trace
+from tpusvm.obs.convergence import (
+    ConvergenceTelemetry,
+    format_gap_table,
+    materialize,
+    to_trace_events,
+)
+
+__all__ = [
+    "ConvergenceTelemetry",
+    "MetricsRegistry",
+    "PhaseTimer",
+    "Tracer",
+    "default_registry",
+    "format_gap_table",
+    "materialize",
+    "merge_snapshots",
+    "read_trace",
+    "render_snapshot_text",
+    "reset_default_registry",
+    "to_trace_events",
+]

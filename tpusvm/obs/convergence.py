@@ -1,0 +1,103 @@
+"""On-device solver convergence telemetry: the host-side half.
+
+The paper's entire subject — the Keerthi gap b_low - b_high collapsing to
+2*tau — was invisible at runtime: the solver runs as ONE lax.while_loop
+and materialises nothing until it terminates. The wrong fix is a host
+callback per round (jax.debug.print / io_callback — a device->host round
+trip inside the hot loop, now linted against as JX009). The right fix is
+the one the solver already uses for its RESULT: carry the telemetry in
+the loop state and materialise it once at the end.
+
+blocked_smo_solve(telemetry=T) threads a fixed-size ring of T slots
+through the outer-loop carry; every outer iteration writes its gap,
+inner-update count and end-of-round status into slot (i mod T) — pure
+scatter-into-carry, zero host syncs, bit-transparent to alpha/f (the
+telemetry arrays are written, never read, by the solve; asserted by
+tests/test_obs.py). The device half lives in solver/blocked.py; this
+module owns the dtype-free pieces: the result container, the ring
+unwrap, and the trace/table adapters.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, NamedTuple
+
+import numpy as np
+
+
+class ConvergenceTelemetry(NamedTuple):
+    """Device-side ring carried through the solver (leaves are arrays).
+
+    gap:    (T,) accum dtype — b_low - b_high at each recorded round
+            (NaN where no working set existed that round).
+    n_upd:  (T,) int32 — inner alpha updates the round performed.
+    status: (T,) int32 — Status value the round ended with.
+    count:  scalar int32 — total rounds recorded (may exceed T: the ring
+            then holds the LAST T rounds).
+    """
+
+    gap: Any
+    n_upd: Any
+    status: Any
+    count: Any
+
+
+def materialize(tele: ConvergenceTelemetry) -> Dict[str, Any]:
+    """Unwrap the ring into oldest-first host arrays.
+
+    Returns {"gap", "updates", "status" (np arrays, oldest round first),
+    "rounds_recorded" (total rounds the solver ran, >= len(gap) when the
+    ring wrapped), "wrapped" (bool)}.
+    """
+    gap = np.asarray(tele.gap)
+    n_upd = np.asarray(tele.n_upd)
+    status = np.asarray(tele.status)
+    count = int(tele.count)
+    T = gap.shape[0]
+    if count <= T:
+        order = np.arange(count)
+    else:
+        order = (count + np.arange(T)) % T  # oldest surviving slot first
+    return {
+        "gap": gap[order],
+        "updates": n_upd[order],
+        "status": status[order],
+        "rounds_recorded": count,
+        "wrapped": count > T,
+    }
+
+
+def to_trace_events(tracer, conv: Dict[str, Any]) -> None:
+    """Write a materialized telemetry dict as convergence.round events
+    (the records `tpusvm report` renders as the gap table)."""
+    from tpusvm.status import Status
+
+    first = conv["rounds_recorded"] - len(conv["gap"]) + 1
+    for i in range(len(conv["gap"])):
+        g = float(conv["gap"][i])
+        tracer.event(
+            "convergence.round",
+            round=first + i,
+            gap=None if np.isnan(g) else g,
+            updates=int(conv["updates"][i]),
+            status=Status(int(conv["status"][i])).name,
+        )
+
+
+def format_gap_table(conv: Dict[str, Any], max_rows: int = 40) -> str:
+    """Human-readable gap table straight from a materialized dict (the
+    same renderer `tpusvm report` uses on trace files)."""
+    from tpusvm.obs.report import format_convergence_table
+    from tpusvm.status import Status
+
+    first = conv["rounds_recorded"] - len(conv["gap"]) + 1
+    rows = []
+    for i in range(len(conv["gap"])):
+        g = float(conv["gap"][i])
+        rows.append({
+            "round": first + i,
+            "gap": None if np.isnan(g) else g,
+            "updates": int(conv["updates"][i]),
+            "status": Status(int(conv["status"][i])).name,
+        })
+    return format_convergence_table(rows, max_rows=max_rows)

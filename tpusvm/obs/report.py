@@ -1,0 +1,122 @@
+"""Render telemetry back into the reference's human-readable contracts.
+
+The SINGLE render path for end-of-run timing: `render_phase_lines` is the
+reference's three-line timing contract (`<phase> time: X.XXX s` per phase
+in first-entry order, then `elapsed time:`), used by PhaseTimer.report()
+(live runs: cli.py, bench.py) and by `tpusvm report` (trace files) — the
+two surfaces can no longer drift apart because they call the same
+function.
+
+`render_report` is the `tpusvm report <trace.jsonl>` body: phase summary
+reconstructed from phase spans, the convergence-gap table from
+convergence.round events, and any embedded metrics snapshots' non-zero
+counters.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterable, List, Tuple
+
+
+def render_phase_lines(acc: Dict[str, float], total: float) -> str:
+    """The reference's end-of-run timing block (SURVEY.md §5.1)."""
+    lines = [f"{name} time: {secs:.3f} s" for name, secs in acc.items()]
+    lines.append(f"elapsed time: {total:.3f} s")
+    return "\n".join(lines)
+
+
+def phase_summary(records: Iterable[dict]) -> Tuple[Dict[str, float], float]:
+    """(accumulated phase durations in first-entry order, total seconds)
+    from trace records.
+
+    Phases are spans written with attrs.phase=True (PhaseTimer). Total
+    comes from the `end` record when present, else the span envelope."""
+    acc: Dict[str, float] = {}
+    total = 0.0
+    t_min = t_max = None
+    for rec in records:
+        if rec["kind"] == "span":
+            t_min = rec["t0"] if t_min is None else min(t_min, rec["t0"])
+            t_max = rec["t1"] if t_max is None else max(t_max, rec["t1"])
+            if rec.get("attrs", {}).get("phase"):
+                name = rec["name"]
+                acc[name] = acc.get(name, 0.0) + rec["dur_s"]
+        elif rec["kind"] == "end":
+            total = rec["total_s"]
+    if not total and t_min is not None:
+        total = t_max - t_min
+    return acc, total
+
+
+def convergence_rows(records: Iterable[dict]) -> List[dict]:
+    """The convergence.round events, in file (= round) order."""
+    return [r["attrs"] for r in records
+            if r["kind"] == "event" and r["name"] == "convergence.round"]
+
+
+def format_convergence_table(rows: List[dict], max_rows: int = 40) -> str:
+    """Fixed-width outer-round table: round, Keerthi gap, updates, status.
+
+    Long runs are elided in the middle (first/last max_rows//2 rounds) —
+    the interesting structure is the head (cold-start collapse) and the
+    tail (the approach to 2*tau)."""
+    if not rows:
+        return "no convergence records in this trace"
+    head = ["round      gap            updates  status",
+            "-----      ---            -------  ------"]
+    idx = list(range(len(rows)))
+    if len(idx) > max_rows:
+        k = max_rows // 2
+        idx = idx[:k] + [None] + idx[-k:]
+    out = list(head)
+    for i in idx:
+        if i is None:
+            out.append(f"  ... {len(rows) - 2 * (max_rows // 2)} "
+                       "rounds elided ...")
+            continue
+        r = rows[i]
+        gap = r.get("gap")
+        gap_s = f"{gap:.6e}" if gap is not None else "n/a"
+        out.append(f"{r.get('round', i + 1):>5}  {gap_s:>13}  "
+                   f"{r.get('updates', 0):>7}  {r.get('status', '?')}")
+    return "\n".join(out)
+
+
+def nonzero_counters(records: Iterable[dict]) -> List[str]:
+    """`name{labels} value` lines for every non-zero counter/gauge in
+    embedded metrics snapshots (merged when several are present)."""
+    from tpusvm.obs.registry import merge_snapshots
+
+    snaps = [r["attrs"]["snapshot"] for r in records
+             if r["kind"] == "event" and r["name"] == "metrics.snapshot"]
+    if not snaps:
+        return []
+    merged = merge_snapshots(*snaps)
+    lines = []
+    for e in merged["metrics"]:
+        if e["type"] == "histogram":
+            if e["count"]:
+                lines.append(f"{e['name']} count={e['count']} "
+                             f"sum={e['sum']:g}")
+        elif e["value"]:
+            lab = ",".join(f"{k}={v}" for k, v in
+                           sorted(e["labels"].items()))
+            lines.append(f"{e['name']}{'{' + lab + '}' if lab else ''} "
+                         f"{e['value']:g}")
+    return lines
+
+
+def render_report(records: List[dict]) -> str:
+    """The `tpusvm report` body for one parsed trace."""
+    acc, total = phase_summary(records)
+    spans = sum(1 for r in records if r["kind"] == "span")
+    events = sum(1 for r in records if r["kind"] == "event")
+    parts = [f"trace: {spans} spans, {events} events", ""]
+    conv = convergence_rows(records)
+    parts += ["convergence (b_low - b_high per outer round):",
+              format_convergence_table(conv), ""]
+    counters = nonzero_counters(records)
+    if counters:
+        parts += ["counters:"] + ["  " + line for line in counters] + [""]
+    parts.append(render_phase_lines(acc, total))
+    return "\n".join(parts)

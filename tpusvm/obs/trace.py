@@ -1,0 +1,244 @@
+"""Schema-versioned JSONL span/event tracing + the PhaseTimer adapter.
+
+One trace file per run, one JSON object per line. Record kinds:
+
+  {"v": 1, "kind": "meta",  "t0": ..., "wall": ..., "argv": [...]}
+  {"v": 1, "kind": "span",  "id": 3, "parent": 1, "name": "training",
+   "t0": ..., "t1": ..., "dur_s": ..., "attrs": {...}}
+  {"v": 1, "kind": "event", "id": 7, "parent": 3, "name": "cascade.round",
+   "ts": ..., "attrs": {...}}
+  {"v": 1, "kind": "end",   "t1": ..., "total_s": ...}
+
+Spans nest (per thread — each thread keeps its own open-span stack, so a
+serve worker's spans parent correctly without cross-thread races); a
+span line is written when the span CLOSES, so the file is append-only
+and a crashed run still holds every completed span. Timestamps come from
+an injectable monotonic clock — tests pass a counter and get a
+bit-stable file; production uses time.perf_counter.
+
+`tpusvm report <trace.jsonl>` renders these files (tpusvm.obs.report);
+`read_trace` is the version-gated parser everything shares.
+
+PhaseTimer lives here as a thin span adapter: same accumulate-by-name
+surface and the reference's three-line report contract
+(`<phase> time: ... s` per phase + `elapsed time:` — SURVEY.md §5.1,
+previously implemented standalone in utils/timing.py, which now
+re-exports this one), but every phase entry also lands as a span in an
+attached Tracer, so cascade rounds, tune points, ingest shards and serve
+batches all come out in one trace file.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import threading
+import time
+from typing import Any, Dict, Iterator, List, Optional
+
+TRACE_SCHEMA_VERSION = 1
+
+
+def _jsonable(x: Any) -> Any:
+    import numpy as np
+
+    if isinstance(x, np.ndarray):
+        return x.tolist()
+    if isinstance(x, (np.integer, np.floating, np.bool_)):
+        return x.item()
+    raise TypeError(f"not JSON-serialisable: {type(x)}")
+
+
+class Tracer:
+    """Append-only JSONL trace writer with nested spans.
+
+    Args:
+      path: output file (opened for append so a driver can direct several
+        commands at one trace; the meta record delimits each run).
+      clock: monotonic float clock — injectable so tests are
+        deterministic (default time.perf_counter).
+      wall: wall-clock for the meta record only (default time.time).
+    """
+
+    def __init__(self, path: str, clock=None, wall=None,
+                 argv: Optional[List[str]] = None):
+        self._clock = clock or time.perf_counter
+        self._wall = wall or time.time
+        self._lock = threading.Lock()
+        self._local = threading.local()
+        self._next_id = 0
+        self._f = open(path, "a")
+        self.path = path
+        self._t0 = self._clock()
+        self._closed = False
+        rec = {"v": TRACE_SCHEMA_VERSION, "kind": "meta", "t0": self._t0,
+               "wall": self._wall()}
+        if argv is not None:
+            rec["argv"] = list(argv)
+        self._write(rec)
+
+    # ------------------------------------------------------------ plumbing
+    def _write(self, rec: dict) -> None:
+        line = json.dumps(rec, default=_jsonable)
+        with self._lock:
+            if self._closed:
+                return
+            self._f.write(line + "\n")
+            self._f.flush()
+
+    def _stack(self) -> List[int]:
+        st = getattr(self._local, "stack", None)
+        if st is None:
+            st = self._local.stack = []
+        return st
+
+    def _new_id(self) -> int:
+        with self._lock:
+            self._next_id += 1
+            return self._next_id
+
+    # ------------------------------------------------------------- surface
+    @contextlib.contextmanager
+    def span(self, name: str, **attrs: Any) -> Iterator[None]:
+        """Nested timed region; the record is written when it closes."""
+        sid = self._new_id()
+        stack = self._stack()
+        parent = stack[-1] if stack else None
+        stack.append(sid)
+        t0 = self._clock()
+        try:
+            yield
+        finally:
+            t1 = self._clock()
+            stack.pop()
+            self._write({
+                "v": TRACE_SCHEMA_VERSION, "kind": "span", "id": sid,
+                "parent": parent, "name": name, "t0": t0, "t1": t1,
+                "dur_s": t1 - t0, "attrs": attrs,
+            })
+
+    def event(self, name: str, **attrs: Any) -> None:
+        """Point-in-time record, parented to the innermost open span."""
+        stack = self._stack()
+        self._write({
+            "v": TRACE_SCHEMA_VERSION, "kind": "event",
+            "id": self._new_id(),
+            "parent": stack[-1] if stack else None,
+            "name": name, "ts": self._clock(), "attrs": attrs,
+        })
+
+    def metrics_snapshot(self, snapshot: dict) -> None:
+        """Embed a registry snapshot (obs.registry) as an event, so one
+        trace file carries the run's counters next to its spans."""
+        self.event("metrics.snapshot", snapshot=snapshot)
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        t1 = self._clock()
+        self._write({"v": TRACE_SCHEMA_VERSION, "kind": "end", "t1": t1,
+                     "total_s": t1 - self._t0})
+        with self._lock:
+            self._closed = True
+            self._f.close()
+
+    def __enter__(self) -> "Tracer":
+        return self
+
+    def __exit__(self, *exc: Any) -> None:
+        self.close()
+
+
+def read_trace(path: str) -> List[dict]:
+    """Parse a trace file; raises ValueError on schema mismatch.
+
+    Blank lines are tolerated (crash-truncated final lines are not —
+    a torn record is worth hearing about, not skipping silently)."""
+    records = []
+    with open(path) as f:
+        for i, line in enumerate(f, 1):
+            if not line.strip():
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError as e:
+                raise ValueError(
+                    f"{path}:{i}: not a JSON record ({e}); the trace "
+                    "file is corrupt or truncated"
+                ) from None
+            v = rec.get("v")
+            if v != TRACE_SCHEMA_VERSION:
+                raise ValueError(
+                    f"{path}:{i}: trace schema version {v!r} is not "
+                    f"supported (this build reads v{TRACE_SCHEMA_VERSION})"
+                )
+            records.append(rec)
+    return records
+
+
+class PhaseTimer:
+    """Accumulating named phase timer (span adapter).
+
+    >>> t = PhaseTimer()
+    >>> with t.phase("train"):
+    ...     pass
+    >>> t["train"] >= 0
+    True
+
+    Phases accumulate across repeated entries (the cascade enters "train"
+    once per round). `report()` returns the human-readable summary lines
+    in the reference's output contract (SURVEY.md Appendix A: three phase
+    timings), listing phases in first-entry order and ending with the
+    total. With a tracer attached, every phase entry is ALSO written as a
+    span (attrs: phase=True), which is how `tpusvm report` reconstructs
+    the same summary from the trace file alone.
+
+    On-device timing caveat: JAX dispatch is asynchronous, so a phase
+    that ends while device work is still in flight under-reports.
+    Callers must close each phase only after host materialisation of the
+    phase's result (np.asarray) — see utils/timing.py's original note.
+    """
+
+    def __init__(self, tracer: Optional[Tracer] = None) -> None:
+        self._acc: Dict[str, float] = {}
+        self._t0 = time.perf_counter()
+        self.tracer = tracer
+
+    @contextlib.contextmanager
+    def phase(self, name: str) -> Iterator[None]:
+        span = (self.tracer.span(name, phase=True) if self.tracer
+                else contextlib.nullcontext())
+        start = time.perf_counter()
+        try:
+            with span:
+                yield
+        finally:
+            self._acc[name] = self._acc.get(name, 0.0) + (
+                time.perf_counter() - start
+            )
+
+    def add(self, name: str, seconds: float) -> None:
+        """Accumulate an externally-measured duration (e.g. a per-round
+        time already captured by cascade_fit's history)."""
+        self._acc[name] = self._acc.get(name, 0.0) + seconds
+
+    def __getitem__(self, name: str) -> float:
+        return self._acc[name]
+
+    def __contains__(self, name: str) -> bool:
+        return name in self._acc
+
+    @property
+    def total(self) -> float:
+        """Wall-clock since construction (the reference's 'elapsed time')."""
+        return time.perf_counter() - self._t0
+
+    def asdict(self) -> Dict[str, float]:
+        d = dict(self._acc)
+        d["total"] = self.total
+        return d
+
+    def report(self) -> str:
+        from tpusvm.obs.report import render_phase_lines
+
+        return render_phase_lines(self._acc, self.total)

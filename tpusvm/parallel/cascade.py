@@ -38,6 +38,7 @@ rules match the reference exactly (see merge_dedup docstring).
 
 from __future__ import annotations
 
+import contextlib
 import functools
 import time
 import warnings
@@ -404,6 +405,7 @@ def cascade_fit(
     solver_opts: Optional[dict] = None,
     stratified: bool = False,
     partition=None,
+    tracer=None,
 ) -> CascadeResult:
     """Train a binary SVM with the distributed cascade.
 
@@ -442,6 +444,12 @@ def cascade_fit(
     input then cannot hand a leaf a single-class shard (whose solve dies
     NO_WORKING_SET). Global IDs are original row indices either way, so
     the dedup-by-ID merges and the ID-set convergence test are unchanged.
+
+    tracer: an obs.trace.Tracer; each round then lands as a
+    `cascade.round` span + event carrying the global SV count, b, and
+    the per-leaf/per-step merge sizes, SV counts and iteration counts —
+    the per-round diagnostics the reference printed as rank-0 text,
+    machine-readable in the run's one trace file.
     """
     if solver not in ("pair", "blocked"):
         raise ValueError(f"unknown solver {solver!r}")
@@ -543,45 +551,49 @@ def cascade_fit(
 
     for rnd in range(start_round, svm_config.max_rounds + 1):
         t0 = time.perf_counter()
-        while True:
-            out_global, b_all, diag = round_fn(part_bufs, global_sv)
-            diag = {k: np.asarray(v) for k, v in diag.items()}
-            if (
-                cc.topology == "star"
-                and merged_cap < full_merged_cap
-                and diag["merged_count"][:, 1].max() > merged_cap
-            ):
-                # The deduped worker-SV union overflowed the tight layer-2
-                # retrain buffer, so this round's merged solve saw a
-                # truncated union — its result is invalid. The
-                # concatenation bound n_shards*sv_cap always fits (the
-                # union draws at most sv_cap valid rows per shard), so
-                # transparently rebuild at that capacity, re-run the round
-                # (the inter-round state is untouched until the check
-                # passes), and keep the widened round_fn for the remaining
-                # rounds — the union grows with the global SV set, so a
-                # tight retry would just re-overflow. At full width the
-                # bound makes overflow impossible, hence no raise here.
-                warnings.warn(
-                    f"cascade round {rnd}: worker-SV union of "
-                    f"{diag['merged_count'][:, 1].max()} rows overflowed the "
-                    f"star merge buffer ({merged_cap}); retrying the round "
-                    f"with the full concatenation capacity "
-                    f"{full_merged_cap} (set star_merge_capacity to avoid "
-                    "the recompile)",
-                    RuntimeWarning,
-                    stacklevel=2,
-                )
-                merged_cap = full_merged_cap
-                round_fn = _build_round_fn(
-                    mesh, cc.topology, n_shards, train_cap, merged_cap,
-                    sv_cap, svm_config, accum_dtype, solver,
-                    dict(solver_opts or {}),
-                )
-                continue
-            break
-        new_global = jax.tree.map(np.asarray, out_global)
-        b = float(np.asarray(b_all))
+        round_span = (tracer.span("cascade.round", round=rnd)
+                      if tracer else contextlib.nullcontext())
+        with round_span:
+            while True:
+                out_global, b_all, diag = round_fn(part_bufs, global_sv)
+                diag = {k: np.asarray(v) for k, v in diag.items()}
+                if (
+                    cc.topology == "star"
+                    and merged_cap < full_merged_cap
+                    and diag["merged_count"][:, 1].max() > merged_cap
+                ):
+                    # The deduped worker-SV union overflowed the tight
+                    # layer-2 retrain buffer, so this round's merged solve
+                    # saw a truncated union — its result is invalid. The
+                    # concatenation bound n_shards*sv_cap always fits (the
+                    # union draws at most sv_cap valid rows per shard), so
+                    # transparently rebuild at that capacity, re-run the
+                    # round (the inter-round state is untouched until the
+                    # check passes), and keep the widened round_fn for the
+                    # remaining rounds — the union grows with the global SV
+                    # set, so a tight retry would just re-overflow. At full
+                    # width the bound makes overflow impossible, hence no
+                    # raise here.
+                    warnings.warn(
+                        f"cascade round {rnd}: worker-SV union of "
+                        f"{diag['merged_count'][:, 1].max()} rows "
+                        f"overflowed the star merge buffer ({merged_cap}); "
+                        f"retrying the round with the full concatenation "
+                        f"capacity {full_merged_cap} (set "
+                        "star_merge_capacity to avoid the recompile)",
+                        RuntimeWarning,
+                        stacklevel=2,
+                    )
+                    merged_cap = full_merged_cap
+                    round_fn = _build_round_fn(
+                        mesh, cc.topology, n_shards, train_cap, merged_cap,
+                        sv_cap, svm_config, accum_dtype, solver,
+                        dict(solver_opts or {}),
+                    )
+                    continue
+                break
+            new_global = jax.tree.map(np.asarray, out_global)
+            b = float(np.asarray(b_all))
         dt = time.perf_counter() - t0
         rounds = rnd
 
@@ -616,6 +628,21 @@ def cascade_fit(
             "status": diag["status"],
         }
         history.append(entry)
+        if tracer is not None:
+            # per-round / per-leaf telemetry: the diag arrays carry one
+            # row per merge step (tree) or layer (star) per shard
+            tracer.event(
+                "cascade.round",
+                round=rnd,
+                sv_count=len(ids_now),
+                b=b,
+                time_s=dt,
+                topology=cc.topology,
+                merged_count=diag["merged_count"].tolist(),
+                leaf_sv_count=diag["sv_count"].tolist(),
+                iters=diag["iters"].tolist(),
+                status=diag["status"].tolist(),
+            )
         bad = diag["status"][diag["status"] >= int(Status.INFEASIBLE_UV)]
         if bad.size:
             warnings.warn(

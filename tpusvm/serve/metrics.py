@@ -1,14 +1,23 @@
 """Serving metrics: counters, batch-occupancy histogram, latency percentiles.
 
-Everything is host-side Python (no JAX) and guarded by one lock — the
-request rates a single-host server sees (thousands/s) are far below where a
-lock becomes the bottleneck, and one lock keeps snapshot() consistent: a
-scrape never observes a request counted but its latency missing.
+Since the unified-telemetry round this is a thin adapter over the shared
+tpusvm.obs.registry primitives — serving, training, tuning and streaming
+now emit into one metric vocabulary, and a server's registry snapshot
+merges exactly with any other worker's (obs.registry.merge_snapshots).
+The OUTPUT contracts are unchanged from the private implementation this
+replaces: `snapshot()` returns the same dict (the serve smoke and HTTP
+/metrics consumers parse it) and `render_text()` the same
+`name{labels} value` lines — parity is asserted by
+tests/test_serve.py::test_metrics_snapshot_and_text.
 
-Latency percentiles come from a bounded reservoir of the most recent
-completions (default 4096) rather than a streaming sketch: exact over the
-window, O(window log window) only at scrape time, and the window bounds
-memory regardless of uptime.
+Everything is host-side Python (no JAX); one registry lock keeps a
+scrape consistent (a request is never observed counted with its latency
+missing). Latency percentiles come from a bounded reservoir of the most
+recent completions (default 4096) rather than a streaming sketch: exact
+over the window, O(window log window) only at scrape time, and the
+window bounds memory regardless of uptime. (The reservoir is the one
+piece that stays outside the registry: exact windowed percentiles are
+not a mergeable aggregate, and the serving SLO checks want exactness.)
 """
 
 from __future__ import annotations
@@ -16,6 +25,8 @@ from __future__ import annotations
 import collections
 import threading
 from typing import Dict, Optional, Sequence
+
+from tpusvm.obs.registry import MetricsRegistry
 
 _COUNTERS = (
     "requests",      # rows accepted into the queue
@@ -29,26 +40,41 @@ _COUNTERS = (
 
 
 class Metrics:
-    """Thread-safe serving counters for one model."""
+    """Thread-safe serving counters for one model (registry-backed)."""
 
     def __init__(self, buckets: Sequence[int], latency_window: int = 4096):
-        self._lock = threading.Lock()
-        self._counts: Dict[str, int] = {k: 0 for k in _COUNTERS}
+        self.registry = MetricsRegistry()
+        self._counts = {k: self.registry.counter(f"serve.{k}")
+                        for k in _COUNTERS}
         # per-bucket occupancy: how many batches flushed at this bucket
         # size, and how many real (non-padding) rows they carried
-        self._bucket_batches: Dict[int, int] = {int(b): 0 for b in buckets}
-        self._bucket_rows: Dict[int, int] = {int(b): 0 for b in buckets}
+        self._buckets = sorted(int(b) for b in buckets)
+        self._bucket_batches = {
+            b: self.registry.counter("serve.bucket_batches", bucket=str(b))
+            for b in self._buckets
+        }
+        self._bucket_rows = {
+            b: self.registry.counter("serve.bucket_rows", bucket=str(b))
+            for b in self._buckets
+        }
+        self._lock = threading.Lock()
         self._lat = collections.deque(maxlen=latency_window)
 
     def inc(self, name: str, n: int = 1) -> None:
-        with self._lock:
-            self._counts[name] += n
+        self._counts[name].inc(n)
 
     def observe_batch(self, bucket: int, rows: int) -> None:
-        with self._lock:
-            self._counts["batches"] += 1
-            self._bucket_batches[bucket] = self._bucket_batches.get(bucket, 0) + 1
-            self._bucket_rows[bucket] = self._bucket_rows.get(bucket, 0) + rows
+        bucket = int(bucket)
+        if bucket not in self._bucket_batches:
+            # late-registered bucket (direct-path chunking can exceed the
+            # configured set); get-or-create keeps the accounting complete
+            self._bucket_batches[bucket] = self.registry.counter(
+                "serve.bucket_batches", bucket=str(bucket))
+            self._bucket_rows[bucket] = self.registry.counter(
+                "serve.bucket_rows", bucket=str(bucket))
+        self._counts["batches"].inc()
+        self._bucket_batches[bucket].inc()
+        self._bucket_rows[bucket].inc(rows)
 
     def observe_latency(self, seconds: float) -> None:
         with self._lock:
@@ -63,11 +89,14 @@ class Metrics:
         return sorted_lat[idx]
 
     def snapshot(self) -> dict:
-        """One consistent JSON-able view of every counter and derived stat."""
+        """One consistent JSON-able view of every counter and derived stat
+        (schema unchanged across the registry migration)."""
+        counts = {k: c.value for k, c in self._counts.items()}
+        batches: Dict[int, int] = {b: c.value
+                                   for b, c in self._bucket_batches.items()}
+        rows: Dict[int, int] = {b: c.value
+                                for b, c in self._bucket_rows.items()}
         with self._lock:
-            counts = dict(self._counts)
-            batches = dict(self._bucket_batches)
-            rows = dict(self._bucket_rows)
             lat = sorted(self._lat)
         total_rows = sum(rows.values())
         total_batches = sum(batches.values())
@@ -92,6 +121,11 @@ class Metrics:
                 "max": lat[-1] if lat else None,
             },
         }
+
+    def registry_snapshot(self) -> dict:
+        """The mergeable obs.registry view of the same counters (for
+        cross-worker aggregation / trace embedding)."""
+        return self.registry.snapshot()
 
     def render_text(self, prefix: str = "tpusvm_serve", labels: str = "") -> str:
         """Plaintext /metrics-style dump (one `name{labels} value` per line)."""

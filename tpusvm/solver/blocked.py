@@ -44,6 +44,7 @@ import numpy as np
 from jax import lax
 
 from tpusvm.config import pallas_flag_errors
+from tpusvm.obs.convergence import ConvergenceTelemetry
 from tpusvm.ops.rbf import rbf_cross, rbf_cross_matvec, rbf_matvec, sq_norms
 from tpusvm.ops.selection import i_high_mask, i_low_mask
 from tpusvm.solver.analytic import pair_update
@@ -178,6 +179,13 @@ class _OuterState(NamedTuple):
     f_exact: jax.Array    # bool: f freshly reconstructed from alpha, with no
                           # accumulated per-round deltas on top (refine mode)
     n_refines: jax.Array  # reconstructions done so far (refine mode)
+    # convergence telemetry ring (telemetry=T > 0; shape-(0,) when off):
+    # written every outer-loop body execution, never read by the solve —
+    # the carry-resident alternative to a host callback per round
+    tele_gap: jax.Array     # (T,) accum dtype: b_low - b_high per round
+    tele_upd: jax.Array     # (T,) int32: inner updates that round
+    tele_status: jax.Array  # (T,) int32: end-of-round Status
+    tele_i: jax.Array       # scalar int32: rounds recorded so far
 
 
 def _inner_smo(K_BB, y_B, a_B, f_B, active_B, C, eps, tau, max_inner,
@@ -318,7 +326,7 @@ def _inner_smo(K_BB, y_B, a_B, f_B, active_B, C, eps, tau, max_inner,
                      "accum_dtype", "inner", "refine", "max_refines", "wss",
                      "matmul_precision", "selection", "fused_fupdate",
                      "pallas_layout", "pallas_eta_exclude",
-                     "pallas_multipair"),
+                     "pallas_multipair", "telemetry"),
 )
 def blocked_smo_solve(
     X: jax.Array,
@@ -347,6 +355,7 @@ def blocked_smo_solve(
     pallas_layout: str = "packed",
     pallas_eta_exclude: bool = False,
     pallas_multipair: int = 1,
+    telemetry: int = 0,
 ) -> SMOResult:
     """Train to the reference's stopping criterion with blocked working sets.
 
@@ -481,6 +490,22 @@ def blocked_smo_solve(
     cap semantics above still apply: if more alphas are live than the cap,
     the rebuild is skipped and the claim is accepted on the drifted f —
     in fast mode size the cap generously above the expected SV count.
+
+    telemetry (static): 0 (default) = off. T > 0 = carry a T-slot
+    convergence ring through the outer loop: every outer-loop body
+    execution writes its Keerthi gap (b_low - b_high; NaN when no
+    working set existed), inner-update count, and end-of-round status
+    into slot (round mod T), and the ring comes back on
+    SMOResult.telemetry (obs.convergence.ConvergenceTelemetry) —
+    materialised once with the rest of the result, exactly like alpha.
+    ZERO host syncs are added inside the loop (the arrays are
+    carry-resident writes; a per-round host callback is the JX009
+    anti-pattern this replaces), and the solve is bit-transparent to the
+    flag: the telemetry arrays are written, never read, so alpha/f/b and
+    every status are bit-identical with it on or off
+    (tests/test_obs.py asserts this; benchmarks/telemetry_overhead.py
+    bounds the time cost at <= 3%). When the solve runs more than T
+    outer rounds the ring holds the LAST T (count says how many ran).
     """
     n = Y.shape[0]
     dtype = X.dtype
@@ -498,6 +523,11 @@ def blocked_smo_solve(
     if selection not in ("auto", "exact", "approx"):
         raise ValueError(
             f"selection must be auto|exact|approx, got {selection!r}"
+        )
+    if not isinstance(telemetry, int) or telemetry < 0:
+        raise ValueError(
+            f"telemetry must be a non-negative int ring size, "
+            f"got {telemetry!r}"
         )
     q, inner, wss, selection = resolve_solver_config(
         n, q, inner=inner, wss=wss, selection=selection
@@ -730,6 +760,8 @@ def blocked_smo_solve(
 
         n_outer = st.n_outer + jnp.where(proceed, 1, 0).astype(jnp.int32)
         n_updates = st.n_updates + upd
+        tele_gap, tele_upd, tele_status, tele_i = (
+            st.tele_gap, st.tele_upd, st.tele_status, st.tele_i)
         # zero progress: surface the inner numerical bail-out that caused it
         # (same statuses as smo_solve on the same degenerate data), generic
         # STALLED otherwise
@@ -763,8 +795,21 @@ def blocked_smo_solve(
                 ),
             ),
         ).astype(jnp.int32)
+        if telemetry:
+            # carry-resident telemetry: pure scatters into ring slot
+            # (round mod T) — written, never read, so the solve's
+            # trajectory is bit-identical with the ring on or off, and
+            # nothing here touches the host until the loop terminates
+            t_idx = tele_i % telemetry
+            gap = jnp.where(found, b_low - b_high,
+                            jnp.array(jnp.nan, adt))
+            tele_gap = tele_gap.at[t_idx].set(gap)
+            tele_upd = tele_upd.at[t_idx].set(upd)
+            tele_status = tele_status.at[t_idx].set(status)
+            tele_i = tele_i + 1
         return _OuterState(alpha, f, b_high, b_low, n_updates, n_outer,
-                           status, f_exact, n_refines)
+                           status, f_exact, n_refines,
+                           tele_gap, tele_upd, tele_status, tele_i)
 
     init = _OuterState(
         alpha=alpha0,
@@ -778,6 +823,12 @@ def blocked_smo_solve(
         # reconstructions of f(alpha0)
         f_exact=jnp.array(True),
         n_refines=jnp.int32(0),
+        # NaN-filled gap slots distinguish "never written" from a real
+        # gap in short solves; shape (0,) keeps the carry free when off
+        tele_gap=jnp.full((telemetry,), jnp.nan, adt),
+        tele_upd=jnp.zeros((telemetry,), jnp.int32),
+        tele_status=jnp.zeros((telemetry,), jnp.int32),
+        tele_i=jnp.int32(0),
     )
     final = lax.while_loop(lambda s: s.status == Status.RUNNING, body, init)
     return SMOResult(
@@ -789,4 +840,8 @@ def blocked_smo_solve(
         status=final.status,
         n_outer=final.n_outer,
         n_refines=final.n_refines,
+        telemetry=(ConvergenceTelemetry(
+            gap=final.tele_gap, n_upd=final.tele_upd,
+            status=final.tele_status, count=final.tele_i,
+        ) if telemetry else None),
     )

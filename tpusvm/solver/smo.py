@@ -29,7 +29,7 @@ All numerical constants and tie-breaks match the serial oracle
 from __future__ import annotations
 
 import functools
-from typing import NamedTuple, Optional
+from typing import Any, NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
@@ -73,6 +73,11 @@ class SMOResult(NamedTuple):
     n_outer: Optional[jax.Array] = None
     # blocked solver only: f reconstructions done by refine mode
     n_refines: Optional[jax.Array] = None
+    # blocked solver only, telemetry=T > 0: the carry-resident
+    # convergence ring (obs.convergence.ConvergenceTelemetry), None when
+    # telemetry is off — the default, so the pair solver and every
+    # existing caller see an unchanged result surface
+    telemetry: Optional[Any] = None
 
 
 def _body(state: SMOState, X, Y, valid, sn, C, gamma, eps, tau, max_iter):

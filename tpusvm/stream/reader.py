@@ -53,6 +53,15 @@ class ShardReader:
         without a second pass over the data.
       dtype: optional numpy dtype the X block is cast to after scaling.
       verify: re-checksum each shard against the manifest on load.
+      metrics: an obs.registry.MetricsRegistry for the pipeline health
+        counters (default: the process-wide default_registry) —
+        `stream.shards_loaded` (loads completed),
+        `stream.producer_stalls` (loads that had to WAIT for a permit:
+        the consumer is the bottleneck — healthy), and
+        `stream.consumer_stalls` (consumer polls that found the queue
+        empty: disk is the bottleneck — raise prefetch_depth), plus the
+        `stream.live_shards` high-water gauge (the residency bound the
+        tests audit via max_live_shards).
 
     Iterating yields (X, Y) per shard. `batches(m)` re-chunks the stream
     into fixed m-row batches (last one short) without widening the
@@ -61,7 +70,7 @@ class ShardReader:
 
     def __init__(self, dataset: ShardedDataset, prefetch_depth: int = 2,
                  seed: Optional[int] = None, scaler=None, dtype=None,
-                 verify: bool = False):
+                 verify: bool = False, metrics=None):
         if prefetch_depth < 1:
             raise ValueError(
                 f"prefetch_depth must be >= 1, got {prefetch_depth}"
@@ -75,6 +84,14 @@ class ShardReader:
         if seed is not None:
             order = np.random.default_rng(seed).permutation(order)
         self.shard_order = order
+        if metrics is None:
+            from tpusvm.obs.registry import default_registry
+
+            metrics = default_registry()
+        self._loaded = metrics.counter("stream.shards_loaded")
+        self._producer_stalls = metrics.counter("stream.producer_stalls")
+        self._consumer_stalls = metrics.counter("stream.consumer_stalls")
+        self._live_gauge = metrics.gauge("stream.live_shards")
         # residency accounting: one permit per resident shard
         self._permits = threading.Semaphore(prefetch_depth + 1)
         self._lock = threading.Lock()
@@ -90,13 +107,20 @@ class ShardReader:
     # ---------------------------------------------------------- producer
     def _acquire(self) -> bool:
         """One permit per shard load; polls so close() can interrupt."""
+        stalled = False
         while not self._stop.is_set():
             if self._permits.acquire(timeout=0.05):
                 with self._lock:
                     self._live += 1
                     self.max_live_shards = max(self.max_live_shards,
                                                self._live)
+                self._live_gauge.set_max(self.max_live_shards)
                 return True
+            if not stalled:
+                # first miss only: one stalled LOAD = one stall, however
+                # many 50ms polls it spans
+                stalled = True
+                self._producer_stalls.inc()
         return False
 
     def _release(self) -> None:
@@ -119,6 +143,7 @@ class ShardReader:
                 except BaseException:
                     self._release()
                     raise
+                self._loaded.inc()
                 self._q.put((X, Y))
                 if self._stop.is_set():
                     return
@@ -137,7 +162,13 @@ class ShardReader:
         self._worker.start()
         try:
             while True:
-                item = self._q.get()
+                try:
+                    item = self._q.get_nowait()
+                except queue.Empty:
+                    # the consumer outran the producer: disk/IO is the
+                    # bottleneck for this stretch
+                    self._consumer_stalls.inc()
+                    item = self._q.get()
                 if item is _SENTINEL:
                     return
                 if isinstance(item, BaseException):

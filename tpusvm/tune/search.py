@@ -163,6 +163,7 @@ def tune(
     solver_opts: Optional[dict] = None,
     log_fn: Optional[Callable[[str], None]] = None,
     dataset=None,
+    tracer=None,
 ) -> TuneResult:
     """Cross-validated search over `grid`; returns the TuneResult table.
 
@@ -177,6 +178,11 @@ def tune(
     function of (Y, k, seed)), and each fold cache gathers only its own
     rows, shard by shard (stream.gather_rows), so the monolithic array is
     never materialised — peak residency is the fold caches plus one shard.
+
+    tracer: an obs.trace.Tracer; every scored point then lands as a
+    `tune.point` event (C, gamma, rung, subset size, CV accuracy, update
+    count, warm-seed count) and the winner as `tune.winner` — the search
+    trajectory in the run's one trace file.
     """
     if dataset is not None:
         if X is not None or Y is not None:
@@ -257,6 +263,13 @@ def tune(
             warm_seeded=row["warm_seeded"]
             + sum(s is not None for s in seeds),
         )
+        if tracer is not None:
+            tracer.event(
+                "tune.point", C=C, gamma=gamma, rung=rung, n_subset=m,
+                cv_accuracy=row["cv_accuracy"], n_updates=updates,
+                warm_seeded=sum(s is not None for s in seeds),
+                wall_s=time.perf_counter() - t0,
+            )
         return row
 
     if config.schedule == "grid":
@@ -309,6 +322,8 @@ def tune(
               "cv_accuracy": win["cv_accuracy"]}
     say(f"tune: winner C={win['C']:g} gamma={win['gamma']:g} "
         f"cv={win['cv_accuracy']:.4f}")
+    if tracer is not None:
+        tracer.event("tune.winner", **winner)
     return TuneResult(
         schedule=config.schedule,
         grid={"C_values": list(grid.C_values),

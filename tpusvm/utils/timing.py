@@ -1,88 +1,28 @@
-"""Phase timing and profiling hooks.
+"""Phase timing and profiling hooks (compatibility shim).
 
-The reference's observability is three coarse wall-clock phase timers printed
-at the end of every run — training / prediction / total — implemented three
-different ways (chrono in main3.cpp:335-414, cudaEvent triplet in
-gpu_svm_main3.cu:516-694, chrono-on-rank-0 in mpi_svm_main2.cpp:408-409,
-771-782; SURVEY.md §5.1). PhaseTimer is the single framework replacement:
-named phases measured with perf_counter, reported in the same
-three-line contract, plus arbitrary extra phases (data loading, scaling,
-compilation) the reference never measured.
-
-On-device timing caveat: JAX dispatch is asynchronous, so a phase that ends
-while device work is still in flight under-reports. Callers must close each
-phase only after host materialisation of the phase's result (np.asarray),
-which is how the solvers already synchronise (models/svm.py fit). On this
-environment's TPU runtime `jax.block_until_ready` is not a reliable barrier
-(see .claude/skills/verify/SKILL.md) — materialisation is.
+The reference's observability is three coarse wall-clock phase timers
+printed at the end of every run — training / prediction / total —
+implemented three different ways (chrono in main3.cpp:335-414, cudaEvent
+triplet in gpu_svm_main3.cu:516-694, chrono-on-rank-0 in
+mpi_svm_main2.cpp:408-409, 771-782; SURVEY.md §5.1). PhaseTimer is the
+single framework replacement; since the unified-telemetry round it lives
+in tpusvm.obs.trace as a span adapter (each phase also lands in an
+attached JSONL Tracer), and this module re-exports it so every
+`from tpusvm.utils import PhaseTimer` import keeps working.
 
 trace() wraps jax.profiler for real kernel-level traces — the idiomatic
-deep-profiling path the reference lacks entirely.
+deep-profiling path the reference lacks entirely (`--profile/--xprof` on
+the CLI).
 """
 
 from __future__ import annotations
 
 import contextlib
-import time
-from typing import Dict, Iterator, Optional
+from typing import Iterator, Optional
 
+from tpusvm.obs.trace import PhaseTimer  # noqa: F401 — re-export
 
-class PhaseTimer:
-    """Accumulating named phase timer.
-
-    >>> t = PhaseTimer()
-    >>> with t.phase("train"):
-    ...     pass
-    >>> t["train"] >= 0
-    True
-
-    Phases accumulate across repeated entries (the cascade enters "train"
-    once per round). `report()` returns the human-readable summary lines in
-    the reference's output contract (SURVEY.md Appendix A: three phase
-    timings), listing phases in first-entry order and ending with the total.
-    """
-
-    def __init__(self) -> None:
-        self._acc: Dict[str, float] = {}
-        self._t0 = time.perf_counter()
-
-    @contextlib.contextmanager
-    def phase(self, name: str) -> Iterator[None]:
-        start = time.perf_counter()
-        try:
-            yield
-        finally:
-            self._acc[name] = self._acc.get(name, 0.0) + (
-                time.perf_counter() - start
-            )
-
-    def add(self, name: str, seconds: float) -> None:
-        """Accumulate an externally-measured duration (e.g. a per-round time
-        already captured by cascade_fit's history)."""
-        self._acc[name] = self._acc.get(name, 0.0) + seconds
-
-    def __getitem__(self, name: str) -> float:
-        return self._acc[name]
-
-    def __contains__(self, name: str) -> bool:
-        return name in self._acc
-
-    @property
-    def total(self) -> float:
-        """Wall-clock since construction (the reference's 'elapsed time')."""
-        return time.perf_counter() - self._t0
-
-    def asdict(self) -> Dict[str, float]:
-        d = dict(self._acc)
-        d["total"] = self.total
-        return d
-
-    def report(self) -> str:
-        lines = [
-            f"{name} time: {secs:.3f} s" for name, secs in self._acc.items()
-        ]
-        lines.append(f"elapsed time: {self.total:.3f} s")
-        return "\n".join(lines)
+__all__ = ["PhaseTimer", "trace"]
 
 
 @contextlib.contextmanager
