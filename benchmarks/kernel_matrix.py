@@ -1,0 +1,200 @@
+"""Kernel-matrix benchmark: linear fast path vs generic K-row path vs RBF.
+
+The acceptance bar for the linear family's primal fast path (ISSUE 6):
+on a fixed synthetic grid, routing the blocked solver's error-vector
+contraction through X @ (X_B^T coef) (kernels/linear.py, kernel_fast=True)
+must be >= 1.5x faster wall-clock than the generic blocked K-row path
+(kernel_fast=False) AT EQUAL SOLUTIONS — both arms converged, same SV set
+(tau-band allowance, the fuzz-parity criterion) and b within the
+classification band. RBF and poly(degree=2) rows ride along per cell so
+the artifact reads as the full kernel matrix's cost picture at one shape.
+
+Workload: overlapping Gaussian blobs (linearly separable with margin
+noise) scaled to [0,1]^d — a problem every family CONVERGES on, so the
+equal-solutions clause is meaningful (the mnist-like recipe drives linear
+to MAX_ITER, where trajectories at the cutoff are not comparable).
+
+Timing protocol: both linear arms AOT-compiled, run INTERLEAVED, min
+across repeats (the house CPU-timing noise-rejection protocol,
+benchmarks/telemetry_overhead.py); every timed run ends at host
+materialisation of alpha.
+
+Usage: python benchmarks/kernel_matrix.py [--smoke] [--repeats 5]
+           [--jsonl PATH]
+Emits one JSON line per (cell, engine) plus a summary line; committed
+run: benchmarks/results/kernel_matrix_cpu.jsonl.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from benchmarks.common import emit, log, pin_platform, workload_record  # noqa: E402
+
+pin_platform()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_enable_x64", True)
+
+SPEEDUP_GATE = 1.5  # full-size runs only; --smoke checks parity gates
+
+# (n, d, sep, C): blobs geometry per grid cell. sep < 2 leaves class
+# overlap, so the solve does real working-set rounds instead of one pass.
+GRID = [
+    (8192, 128, 1.5, 1.0),
+    (8192, 256, 1.5, 1.0),
+    (4096, 256, 1.0, 1.0),
+]
+
+# (engine tag, kernel family, kernel_fast, extra config)
+ENGINES = [
+    ("rbf", "rbf", True, {}),
+    ("poly-d2", "poly", True, {"degree": 2, "coef0": 1.0}),
+    ("linear-generic", "linear", False, {}),
+    ("linear-fast", "linear", True, {}),
+]
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--smoke", action="store_true",
+                    help="tiny shape (schema/CI run): equal-solutions "
+                    "gates only, no speedup floor")
+    ap.add_argument("--repeats", type=int, default=5,
+                    help="interleaved timed repeats per engine (min kept)")
+    ap.add_argument("--q", type=int, default=512)
+    ap.add_argument("--max-inner", type=int, default=512)
+    ap.add_argument("--seed", type=int, default=7, help="data seed")
+    ap.add_argument("--jsonl", default=None,
+                    help="also append records to this file")
+    args = ap.parse_args(argv)
+    grid = [(512, 32, 1.0, 1.0)] if args.smoke else GRID
+    if args.smoke:
+        args.q, args.max_inner, args.repeats = 128, 128, 2
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    from benchmarks.common import h2d_sync
+    from tpusvm.data import MinMaxScaler, blobs
+    from tpusvm.solver.blocked import blocked_smo_solve
+    from tpusvm.status import Status
+
+    out = open(args.jsonl, "a") if args.jsonl else None
+
+    def emit_rec(rec):
+        emit(rec)
+        if out:
+            out.write(json.dumps(rec) + "\n")
+
+    violations = []
+    speedups = []
+    for n, d, sep, C in grid:
+        gen_kwargs = dict(n=n, d=d, sep=sep, seed=args.seed)
+        X, Y = blobs(**gen_kwargs)
+        Xs = MinMaxScaler().fit_transform(X).astype(np.float32)
+        Xd, Yd = jnp.asarray(Xs), jnp.asarray(Y)
+        h2d_sync(Xd, Yd)
+        # hyper = traced operands (re-passed at every compiled call);
+        # static = baked into the executable at lower() time
+        hyper = dict(C=C, gamma=0.05, tau=1e-5, max_iter=400000)
+        static = dict(q=args.q, max_inner=args.max_inner,
+                      max_outer=4000, accum_dtype=jnp.float64)
+
+        log(f"cell n={n} d={d} sep={sep}: compiling {len(ENGINES)} "
+            "engines (AOT)...")
+        compiled = {}
+        for tag, kern, fast, extra in ENGINES:
+            compiled[tag] = blocked_smo_solve.lower(
+                Xd, Yd, kernel=kern, kernel_fast=fast,
+                **{k: extra[k] for k in ("degree",) if k in extra},
+                coef0=extra.get("coef0", 0.0), **static, **hyper,
+            ).compile()
+
+        def timed(tag, extra):
+            t0 = time.perf_counter()
+            res = compiled[tag](Xd, Yd, coef0=extra.get("coef0", 0.0),
+                                **hyper)
+            alpha = np.asarray(res.alpha)  # completion barrier
+            return time.perf_counter() - t0, res, alpha
+
+        # one untimed warm run per engine, then interleaved timed repeats
+        for tag, _, _, extra in ENGINES:
+            timed(tag, extra)
+        times = {tag: [] for tag, _, _, _ in ENGINES}
+        finals = {}
+        for _ in range(args.repeats):
+            for tag, _, _, extra in ENGINES:
+                dt, res, alpha = timed(tag, extra)
+                times[tag].append(dt)
+                finals[tag] = (res, alpha)
+
+        cell = {}
+        for tag, kern, fast, extra in ENGINES:
+            res, alpha = finals[tag]
+            sv = set(np.nonzero(alpha > 1e-8)[0].tolist())
+            status = Status(int(res.status))
+            rec = {
+                "bench": "kernel_matrix", "smoke": args.smoke,
+                "workload": workload_record(blobs, **gen_kwargs),
+                "n": n, "d": d, "C": C,
+                "q": args.q, "max_inner": args.max_inner,
+                "engine": tag, "kernel": kern, "kernel_fast": fast,
+                "wall_s": round(min(times[tag]), 6),
+                "repeats": args.repeats,
+                "n_updates": int(res.n_iter) - 1,
+                "n_outer": int(res.n_outer),
+                "n_sv": len(sv),
+                "b": float(res.b),
+                "status": status.name,
+                "platform": jax.default_backend(),
+            }
+            cell[tag] = (rec, sv)
+            if status != Status.CONVERGED:
+                violations.append(
+                    f"n={n} d={d} {tag}: ended {status.name}")
+            emit_rec(rec)
+
+        # the equal-solutions + speedup verdict for the linear pair
+        gen_rec, gen_sv = cell["linear-generic"]
+        fast_rec, fast_sv = cell["linear-fast"]
+        sym = len(gen_sv ^ fast_sv)
+        allowed = max(2, len(gen_sv) // 25)
+        db = abs(gen_rec["b"] - fast_rec["b"])
+        speedup = gen_rec["wall_s"] / fast_rec["wall_s"]
+        speedups.append(speedup)
+        if sym > allowed:
+            violations.append(
+                f"n={n} d={d}: fast/generic SV sym diff {sym} > {allowed}")
+        if db > 2e-3:
+            violations.append(f"n={n} d={d}: fast/generic |db|={db:.2e}")
+        if not args.smoke and speedup < SPEEDUP_GATE:
+            violations.append(
+                f"n={n} d={d}: linear fast path speedup {speedup:.2f} "
+                f"< {SPEEDUP_GATE}")
+
+    summary = {
+        "summary": True, "bench": "kernel_matrix", "smoke": args.smoke,
+        "cells": len(grid),
+        "engines": [t for t, _, _, _ in ENGINES],
+        "speedup_gate": SPEEDUP_GATE,
+        "linear_fast_speedups": [round(s, 3) for s in speedups],
+        "min_speedup": round(min(speedups), 3),
+        "violations": violations,
+        "platform": jax.default_backend(),
+    }
+    emit_rec(summary)
+    if out:
+        out.close()
+    return 1 if violations else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

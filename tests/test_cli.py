@@ -306,3 +306,90 @@ def test_cli_class_parallel_allows_distributed(monkeypatch):
     assert calls  # the MPI_Init equivalent ran
     # the REAL 2-process execution of this path lives in
     # tests/test_distributed.py::test_two_process_class_parallel_multiclass
+
+
+# ------------------------------------------------------- kernel/task matrix
+def test_cli_train_kernel_smoke_cells(capsys):
+    """The CI kernel-matrix smoke cells: linear SVC and rbf SVR, each
+    with its own workload and gate (blobs/accuracy, sine/R^2)."""
+    rc = main(["train", "--kernel", "linear", "--smoke", "-q"])
+    out = capsys.readouterr().out
+    assert rc == 0, out
+    assert "train smoke ok [linear/svc]" in out
+
+    rc = main(["train", "--task", "svr", "--smoke", "-q"])
+    out = capsys.readouterr().out
+    assert rc == 0, out
+    assert "train smoke ok [rbf/svr]" in out
+
+
+def test_cli_svr_train_predict_info(tmp_path, capsys):
+    model = str(tmp_path / "svr.npz")
+    rc = main(["train", "--task", "svr", "--synthetic", "sine", "--d", "2",
+               "--n", "200", "--n-test", "50", "--gamma", "20",
+               "--save", model])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "r2 = " in out and "rmse = " in out
+
+    rc = main(["info", model])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "model: epsilon-SVR" in out and "epsilon=0.1" in out
+
+    # regression CSV: continuous last column round-trips through predict
+    from tpusvm.data import svr_sine
+
+    X, t = svr_sine(n=60, d=2, seed=5)
+    csv = str(tmp_path / "t.csv")
+    with open(csv, "w") as fh:
+        fh.write("a,b,target\n")
+        for row, ti in zip(X, t):
+            fh.write(",".join(repr(float(v)) for v in row)
+                     + f",{float(ti)!r}\n")
+    rc = main(["predict", "--model", model, "--data", csv])
+    assert rc == 0
+    assert "r2 = " in capsys.readouterr().out
+
+
+def test_cli_calibrate_and_proba(tmp_path, capsys):
+    from tpusvm.data import rings, write_csv
+
+    model = str(tmp_path / "cal.npz")
+    rc = main(["train", "--synthetic", "rings", "--n", "200",
+               "--C", "10", "--gamma", "10", "--calibrate", "2",
+               "--save", model, "-q"])
+    assert rc == 0
+    capsys.readouterr()
+    rc = main(["info", model])
+    assert "calibrated: yes" in capsys.readouterr().out and rc == 0
+
+    X, Y = rings(n=20, seed=9)
+    csv = str(tmp_path / "t.csv")
+    write_csv(csv, X, Y)
+    rc = main(["predict", "--model", model, "--data", csv, "--proba"])
+    assert rc == 0
+    probs = [float(l) for l in capsys.readouterr().out.splitlines()]
+    assert len(probs) == 20 and all(0.0 <= p <= 1.0 for p in probs)
+
+    # an uncalibrated model refuses --proba with a clear message
+    plain = str(tmp_path / "plain.npz")
+    rc = main(["train", "--synthetic", "rings", "--n", "150", "--C", "10",
+               "--gamma", "10", "--save", plain, "-q"])
+    assert rc == 0
+    capsys.readouterr()
+    with pytest.raises(SystemExit, match="no Platt coefficients"):
+        main(["predict", "--model", plain, "--data", csv, "--proba"])
+
+
+def test_cli_kernel_task_flag_validation(capsys):
+    with pytest.raises(SystemExit, match="--task svr requires --mode"):
+        main(["train", "--task", "svr", "--synthetic", "sine",
+              "--mode", "cascade"])
+    with pytest.raises(SystemExit, match="requires --task svr"):
+        main(["train", "--synthetic", "sine", "--n", "50"])
+    with pytest.raises(SystemExit, match="requires --task svc"):
+        main(["train", "--task", "svr", "--synthetic", "sine",
+              "--calibrate", "2"])
+    with pytest.raises(SystemExit, match="--calibrate needs"):
+        main(["train", "--synthetic", "rings", "--calibrate", "1"])

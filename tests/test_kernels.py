@@ -1,0 +1,241 @@
+"""tpusvm.kernels: dispatch correctness, the linear fast path, Platt math,
+and solver-level parity of every kernel family against the f64 oracle.
+
+The RBF rows are the refactor's bit-transparency anchor: dispatch with
+family="rbf" must return byte-identical arrays to the pre-refactor
+ops/rbf.py calls (it IS those calls). Linear/poly are checked against
+plain NumPy f64 references, and each family's full solve against
+oracle.smo_train with the same config.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpusvm import kernels
+from tpusvm.config import SVMConfig
+from tpusvm.data import MinMaxScaler, blobs, rings
+from tpusvm.kernels.platt import fit_platt, log_loss, platt_proba
+from tpusvm.kernels.svr import collapse_duals, doubled_problem
+from tpusvm.ops.rbf import rbf_cross, rbf_matvec, rbf_rows_at, sq_norms
+
+jax.config.update("jax_enable_x64", True)
+
+
+def _data(n=64, d=5, seed=0):
+    rng = np.random.default_rng(seed)
+    return jnp.asarray(rng.random((n, d)), jnp.float32)
+
+
+# ---------------------------------------------------------------- dispatch
+def test_validate_family():
+    for fam in ("rbf", "linear", "poly"):
+        assert kernels.validate_family(fam) == fam
+    with pytest.raises(ValueError, match="unknown kernel family"):
+        kernels.validate_family("sigmoid")
+
+
+def test_needs_norms_only_rbf():
+    assert kernels.needs_norms("rbf")
+    assert not kernels.needs_norms("linear")
+    assert not kernels.needs_norms("poly")
+
+
+def test_rbf_dispatch_bit_identical_to_ops():
+    X = _data()
+    idx = jnp.asarray([3, 17], jnp.int32)
+    sn = sq_norms(X)
+    got = kernels.rows_at("rbf", X, idx, gamma=0.5, sn=sn)
+    want = rbf_rows_at(X, idx, 0.5, sn)
+    assert np.asarray(got).tobytes() == np.asarray(want).tobytes()
+    got = kernels.cross("rbf", X, X[:8], gamma=0.5)
+    want = rbf_cross(X, X[:8], 0.5)
+    assert np.asarray(got).tobytes() == np.asarray(want).tobytes()
+    coef = jnp.asarray(np.linspace(-1, 1, X.shape[0]), jnp.float32)
+    got = kernels.matvec("rbf", X, coef, gamma=0.5)
+    want = rbf_matvec(X, coef, 0.5)
+    assert np.asarray(got).tobytes() == np.asarray(want).tobytes()
+
+
+def test_linear_rows_and_cross_match_numpy():
+    X = _data()
+    Xf = np.asarray(X, np.float64)
+    idx = jnp.asarray([0, 9], jnp.int32)
+    got = np.asarray(kernels.rows_at("linear", X, idx, gamma=0.5))
+    np.testing.assert_allclose(got, Xf[[0, 9]] @ Xf.T, rtol=1e-5)
+    got = np.asarray(kernels.cross("linear", X, X[:8], gamma=0.5))
+    np.testing.assert_allclose(got, Xf @ Xf[:8].T, rtol=1e-5)
+
+
+@pytest.mark.parametrize("degree", [1, 2, 3])
+def test_poly_values_match_numpy(degree):
+    X = _data()
+    Xf = np.asarray(X, np.float64)
+    gamma, coef0 = 0.7, 1.3
+    got = np.asarray(kernels.cross("poly", X, X[:8], gamma=gamma,
+                                   coef0=coef0, degree=degree))
+    want = (gamma * (Xf @ Xf[:8].T) + coef0) ** degree
+    np.testing.assert_allclose(got, want, rtol=2e-5)
+
+
+def test_linear_fast_and_generic_cross_matvec_agree():
+    X = _data(200, 16)
+    XB = _data(32, 16, seed=3)
+    coef = jnp.asarray(np.random.default_rng(1).normal(size=32), jnp.float32)
+    fast = np.asarray(kernels.cross_matvec("linear", X, XB, coef,
+                                           gamma=0.0, fast=True))
+    gen = np.asarray(kernels.cross_matvec("linear", X, XB, coef,
+                                          gamma=0.0, fast=False, block=64))
+    # association differs (primal collapse vs blocked K-row), so agreement
+    # is to f32 matmul reordering noise, not bitwise
+    np.testing.assert_allclose(fast, gen, rtol=1e-4, atol=1e-5)
+
+
+def test_poly_cross_matvec_blocks_match_flat():
+    X = _data(150, 8)
+    XB = _data(16, 8, seed=5)
+    coef = jnp.asarray(np.random.default_rng(2).normal(size=16), jnp.float32)
+    blocked = np.asarray(kernels.cross_matvec(
+        "poly", X, XB, coef, gamma=0.5, coef0=1.0, degree=2, block=64))
+    flat = np.asarray(kernels.cross("poly", X, XB, gamma=0.5, coef0=1.0,
+                                    degree=2) @ coef)
+    np.testing.assert_allclose(blocked, flat, rtol=1e-4, atol=1e-5)
+
+
+# ------------------------------------------------------------ svr doubling
+def test_doubled_problem_layout():
+    t = np.asarray([0.5, -1.0, 2.0])
+    Y2, z = doubled_problem(t, 0.1)
+    np.testing.assert_array_equal(Y2, [1, 1, 1, -1, -1, -1])
+    np.testing.assert_allclose(z, [0.4, -1.1, 1.9, 0.6, -0.9, 2.1])
+
+
+def test_doubled_problem_rejects_bad_input():
+    with pytest.raises(ValueError, match="1-D"):
+        doubled_problem(np.zeros((2, 2)), 0.1)
+    with pytest.raises(ValueError, match="epsilon"):
+        doubled_problem(np.zeros(3), -0.5)
+
+
+def test_collapse_duals():
+    beta = np.asarray([1.0, 0.0, 0.25, 0.5, 2.0, 0.25])
+    np.testing.assert_allclose(collapse_duals(beta), [0.5, -2.0, 0.0])
+    with pytest.raises(ValueError, match="2n"):
+        collapse_duals(np.zeros(5))
+
+
+# ------------------------------------------------------------------- platt
+def test_platt_fit_recovers_signal_and_is_monotone():
+    rng = np.random.default_rng(0)
+    y = np.where(rng.random(600) < 0.5, 1, -1)
+    f = y * rng.uniform(0.5, 2.0, 600) + rng.normal(0, 0.5, 600)
+    A, B = fit_platt(f, y)
+    assert A < 0  # informative scores fit a decreasing exp => increasing p
+    grid = np.linspace(-6, 6, 101)
+    p = platt_proba(grid, A, B)
+    assert np.all(np.diff(p) > 0)
+    assert log_loss(platt_proba(f, A, B), y) \
+        < log_loss((f > 0).astype(float), y)
+
+
+def test_platt_fit_handles_separable_scores():
+    y = np.concatenate([np.ones(50), -np.ones(50)]).astype(np.int32)
+    f = y * 3.0
+    A, B = fit_platt(f, y)  # Bayes-shrunk targets keep this defined
+    assert np.isfinite(A) and np.isfinite(B) and A < 0
+
+
+def test_platt_fit_rejects_single_class():
+    with pytest.raises(ValueError, match="both classes"):
+        fit_platt(np.ones(10), np.ones(10))
+
+
+def test_platt_proba_overflow_stable():
+    p = platt_proba(np.asarray([-1e4, 1e4]), -5.0, 0.0)
+    assert np.all(np.isfinite(p))
+    assert p[0] < 1e-10 and p[1] > 1 - 1e-10
+
+
+# -------------------------------------------- solver parity vs the oracle
+def _parity(cfg, X, Y, targets=None, q=128):
+    """Both solvers vs the f64 oracle at the cross-engine standard."""
+    from tpusvm.oracle import get_sv_indices, smo_train
+    from tpusvm.solver import smo_solve
+    from tpusvm.solver.blocked import blocked_smo_solve
+
+    o = smo_train(X, Y, cfg, targets=targets)
+    assert o.status.name == "CONVERGED"
+    tgt = None if targets is None else jnp.asarray(targets)
+    common = dict(C=cfg.C, gamma=cfg.gamma, eps=cfg.eps, tau=cfg.tau,
+                  kernel=cfg.kernel, degree=cfg.degree, coef0=cfg.coef0,
+                  accum_dtype=jnp.float64, targets=tgt)
+    pair = smo_solve(jnp.asarray(X, jnp.float64), jnp.asarray(Y), **common)
+    blk = blocked_smo_solve(jnp.asarray(X, jnp.float32), jnp.asarray(Y),
+                            q=q, **common)
+    sv_o = set(get_sv_indices(o.alpha).tolist())
+    # f64 features: exact SV-set match (the fuzz harness standard)
+    assert set(get_sv_indices(np.asarray(pair.alpha)).tolist()) == sv_o
+    assert abs(float(pair.b) - o.b) < 2e-3
+    sv_b = set(get_sv_indices(np.asarray(blk.alpha)).tolist())
+    assert len(sv_b ^ sv_o) <= max(2, len(sv_o) // 25)
+    assert abs(float(blk.b) - o.b) < 2e-2
+    return o
+
+
+def test_linear_solvers_match_oracle():
+    X, Y = blobs(n=220, d=6, seed=11)
+    Xs = MinMaxScaler().fit_transform(X)
+    _parity(SVMConfig(C=1.0, kernel="linear"), Xs, Y)
+
+
+def test_poly_solvers_match_oracle():
+    X, Y = rings(n=220, seed=12)
+    Xs = MinMaxScaler().fit_transform(X)
+    _parity(SVMConfig(C=10.0, gamma=1.0, kernel="poly", degree=3,
+                      coef0=1.0), Xs, Y)
+
+
+def test_linear_generic_path_reaches_same_solution():
+    from tpusvm.oracle import get_sv_indices
+    from tpusvm.solver.blocked import blocked_smo_solve
+
+    X, Y = blobs(n=220, d=6, seed=13)
+    Xs = MinMaxScaler().fit_transform(X)
+    res = {}
+    for fast in (True, False):
+        r = blocked_smo_solve(jnp.asarray(Xs, jnp.float32), jnp.asarray(Y),
+                              q=128, C=1.0, kernel="linear",
+                              kernel_fast=fast, accum_dtype=jnp.float64)
+        assert int(r.status) == 1  # CONVERGED
+        res[fast] = (set(get_sv_indices(np.asarray(r.alpha)).tolist()),
+                     float(r.b))
+    assert len(res[True][0] ^ res[False][0]) <= 2
+    assert abs(res[True][1] - res[False][1]) < 2e-3
+
+
+def test_fused_fupdate_true_rejected_off_rbf():
+    from tpusvm.solver.blocked import blocked_smo_solve
+
+    X, Y = blobs(n=64, d=4, seed=1)
+    with pytest.raises(ValueError, match="RBF pipeline only"):
+        blocked_smo_solve(jnp.asarray(X, jnp.float32), jnp.asarray(Y),
+                          kernel="linear", fused_fupdate=True)
+
+
+def test_solver_rejects_unknown_family():
+    from tpusvm.solver import smo_solve
+
+    X, Y = blobs(n=32, d=3, seed=1)
+    with pytest.raises(ValueError, match="unknown kernel family"):
+        smo_solve(jnp.asarray(X, jnp.float32), jnp.asarray(Y),
+                  kernel="sigmoid")
+
+
+def test_config_validates_kernel_fields():
+    with pytest.raises(ValueError, match="unknown kernel family"):
+        SVMConfig(kernel="tanh")
+    with pytest.raises(ValueError, match="degree"):
+        SVMConfig(degree=0)
+    with pytest.raises(ValueError, match="epsilon"):
+        SVMConfig(epsilon=-0.1)

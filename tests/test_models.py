@@ -156,6 +156,103 @@ def test_model_format_version_roundtrip_and_rejection(tmp_path, kind):
         cls.load(missing)
 
 
+def _rewrite_npz(src: str, dst: str, drop=(), **replace):
+    """Rewrite an npz dropping keys and/or replacing values."""
+    with np.load(src, allow_pickle=False) as z:
+        arrays = {k: z[k] for k in z.files if k not in drop}
+    arrays.update({k: np.asarray(v) for k, v in replace.items()})
+    np.savez(dst, **arrays)
+
+
+def test_v1_artifact_loads_with_implicit_rbf_default(tmp_path):
+    """Pre-kernel (format v1) files predate the kernel config fields:
+    stripping them and retagging version 1 must load as the implicit RBF
+    family with bit-identical scoring."""
+    from tpusvm.models import load_any
+
+    X, Y = rings(n=150, seed=8)
+    m = BinarySVC(CFG, dtype=jnp.float64).fit(X, Y)
+    p = str(tmp_path / "v2.npz")
+    m.save(p)
+    v1 = str(tmp_path / "v1.npz")
+    _rewrite_npz(p, v1,
+                 drop=("config_kernel", "config_degree", "config_coef0",
+                       "config_epsilon"),
+                 format_version=1)
+    m2 = load_any(v1, dtype=jnp.float64)
+    assert m2.config.kernel == "rbf"
+    assert m2.config.degree == 3 and m2.config.coef0 == 0.0
+    np.testing.assert_array_equal(
+        m2.decision_function(X[:20]), m.decision_function(X[:20]))
+
+
+def test_unknown_kernel_name_rejected_with_specific_error(tmp_path):
+    from tpusvm.models.serialization import load_model
+
+    X, Y = rings(n=120, seed=9)
+    m = BinarySVC(CFG).fit(X, Y)
+    p = str(tmp_path / "good.npz")
+    m.save(p)
+    bad = str(tmp_path / "bad_kernel.npz")
+    _rewrite_npz(p, bad, config_kernel="sigmoid")
+    with pytest.raises(ValueError, match="kernel family 'sigmoid'"):
+        load_model(bad)
+    with pytest.raises(ValueError, match="kernel family 'sigmoid'"):
+        BinarySVC.load(bad)
+
+
+def test_kernel_config_roundtrips_through_npz(tmp_path):
+    from tpusvm.data import blobs
+
+    X, Y = blobs(n=150, d=4, seed=10)
+    cfg = SVMConfig(C=1.0, gamma=0.5, kernel="poly", degree=2, coef0=1.5)
+    m = BinarySVC(cfg, dtype=jnp.float64).fit(X, Y)
+    p = str(tmp_path / "poly.npz")
+    m.save(p)
+    m2 = BinarySVC.load(p, dtype=jnp.float64)
+    assert m2.config.kernel == "poly"
+    assert m2.config.degree == 2
+    assert m2.config.coef0 == 1.5
+    np.testing.assert_array_equal(
+        m2.decision_function(X[:20]), m.decision_function(X[:20]))
+
+
+def test_model_task_sniff(tmp_path):
+    from tpusvm.data import svr_sine
+    from tpusvm.models import EpsilonSVR
+    from tpusvm.models.serialization import model_task
+
+    X, Y = rings(n=120, seed=11)
+    BinarySVC(CFG).fit(X, Y).save(str(tmp_path / "svc.npz"))
+    assert model_task(str(tmp_path / "svc.npz")) == "svc"
+
+    Xm, Ym = _four_class_data(n=120, seed=11)
+    OneVsRestSVC(SVMConfig(C=10.0, gamma=2.0)).fit(Xm, Ym).save(
+        str(tmp_path / "ovr.npz"))
+    assert model_task(str(tmp_path / "ovr.npz")) == "ovr"
+
+    Xr, tr = svr_sine(n=120, d=1, seed=11)
+    EpsilonSVR(SVMConfig(C=10.0, gamma=20.0)).fit(Xr, tr).save(
+        str(tmp_path / "svr.npz"))
+    assert model_task(str(tmp_path / "svr.npz")) == "svr"
+
+
+def test_binary_svc_linear_and_poly_fit_predict(tmp_path):
+    from tpusvm.data import blobs
+
+    X, Y = blobs(n=200, d=5, seed=12)
+    for cfg in (SVMConfig(C=1.0, kernel="linear"),
+                SVMConfig(C=1.0, gamma=1.0, kernel="poly", degree=2,
+                          coef0=1.0)):
+        m = BinarySVC(cfg).fit(X, Y)
+        assert m.status_.name == "CONVERGED"
+        assert m.score(X, Y) > 0.95
+        p = str(tmp_path / f"{cfg.kernel}.npz")
+        m.save(p)
+        m2 = BinarySVC.load(p)
+        np.testing.assert_array_equal(m2.predict(X), m.predict(X))
+
+
 def test_fit_warns_on_non_convergence():
     import warnings as w
     X, Y = rings(n=200, seed=8)

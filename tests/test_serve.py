@@ -389,6 +389,93 @@ def test_http_endpoint_roundtrip(binary_model):
             httpd.shutdown()
 
 
+# -------------------------------------------------- kernel/task matrix
+def test_svr_serving_matches_direct_estimator(tmp_path):
+    """SVR models serve predicted VALUES — bit-identical to the direct
+    estimator call (same scaler arithmetic, same bucket executables)."""
+    from tpusvm.data import svr_sine
+    from tpusvm.models import EpsilonSVR
+
+    X, t = svr_sine(n=200, d=1, noise=0.05, seed=3)
+    model = EpsilonSVR(SVMConfig(C=10.0, gamma=20.0, epsilon=0.1)).fit(X, t)
+    Xq, _ = svr_sine(n=12, d=1, noise=0.05, seed=44)
+    # pad to the serve floor geometry: direct calls at multiples of 2 rows
+    ref = model.predict(Xq)
+    p = str(tmp_path / "svr.npz")
+    model.save(p)
+    with Server(ServeConfig(max_batch=8)) as srv:
+        entry = srv.load_model("svr", p)
+        assert entry.kind == "svr"
+        srv.warmup()
+        results = srv.submit_many("svr", Xq)
+        assert all(r.ok for r in results)
+        served = np.asarray([float(r.label) for r in results])
+        np.testing.assert_array_equal(served, np.asarray(ref, served.dtype))
+        scores, labels = srv.predict_direct("svr", Xq)
+        np.testing.assert_array_equal(scores, labels)  # value IS the score
+        assert srv.metrics("svr")["recompiles"] == 0
+
+
+def test_poly_model_serves_through_kernel_routed_executable():
+    from tpusvm.data import blobs
+
+    X, Y = blobs(n=200, d=4, seed=5)
+    model = BinarySVC(SVMConfig(C=1.0, gamma=1.0, kernel="poly",
+                                degree=2, coef0=1.0),
+                      dtype=jnp.float64).fit(X, Y)
+    Xq = X[:8]
+    with Server(ServeConfig(max_batch=8), dtype=jnp.float64) as srv:
+        srv.add_model("poly", model)
+        srv.warmup()
+        scores, labels = srv.predict_direct("poly", Xq)
+        np.testing.assert_array_equal(scores,
+                                      model.decision_function(Xq))
+        assert srv.status()["models"]["poly"]["kernel"] == "poly"
+
+
+def test_http_proba_field_matches_predict_proba(tmp_path):
+    """Calibrated binary model over HTTP gains a proba field, bit-equal
+    to the offline predict_proba on the same rows; uncalibrated models
+    serve no such field."""
+    import urllib.error  # noqa: F401  (match the module's other tests)
+    from tpusvm.serve.http import make_http_server, start_http_thread
+
+    X, Y = rings(n=240, seed=1)
+    cal = BinarySVC(SVMConfig(C=10.0, gamma=10.0), dtype=jnp.float64)
+    cal.fit(X, Y)
+    cal.calibrate(X, Y, folds=2, seed=0)
+    plain = BinarySVC(SVMConfig(C=10.0, gamma=10.0),
+                      dtype=jnp.float64).fit(X, Y)
+    Xq, _ = rings(n=6, seed=8)
+    ref = cal.predict_proba(Xq)[:, 1]
+    with Server(ServeConfig(max_batch=8), dtype=jnp.float64) as srv:
+        srv.add_model("cal", cal)
+        srv.add_model("plain", plain)
+        srv.warmup()
+        httpd = make_http_server(srv, port=0)
+        start_http_thread(httpd)
+        try:
+            port = httpd.server_address[1]
+            body = json.dumps({"instances": Xq.tolist()}).encode()
+
+            def post(name):
+                req = urllib.request.Request(
+                    f"http://127.0.0.1:{port}/v1/models/{name}:predict",
+                    data=body,
+                    headers={"Content-Type": "application/json"})
+                return json.loads(urllib.request.urlopen(req).read())
+
+            resp = post("cal")
+            assert "proba" in resp
+            np.testing.assert_array_equal(np.asarray(resp["proba"]), ref)
+            assert all(0.0 <= p <= 1.0 for p in resp["proba"])
+            assert "proba" not in post("plain")
+        finally:
+            httpd.shutdown()
+    assert srv.status()["models"]["cal"]["calibrated"] is True
+    assert srv.status()["models"]["plain"]["calibrated"] is False
+
+
 # -------------------------------------------------------------------- CLI
 def test_cli_serve_smoke(tmp_path, capsys, binary_model):
     from tpusvm.cli import main

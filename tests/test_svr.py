@@ -1,0 +1,152 @@
+"""EpsilonSVR: the doubled-variable regression task end to end.
+
+Covers the estimator surface (fit/predict/score/save-load), oracle parity
+of the doubled solve, the epsilon-tube property (residuals of interior
+SVs sit at the tube boundary), and the twin-pair degeneracy argument
+(identical doubled rows can never be selected as a violating pair, so
+the solve terminates CONVERGED, not NONPOS_ETA).
+"""
+
+import warnings
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpusvm.config import SVMConfig
+from tpusvm.data import MinMaxScaler, svr_sine
+from tpusvm.kernels.svr import collapse_duals
+from tpusvm.models import EpsilonSVR, load_any
+from tpusvm.oracle import svr_train
+from tpusvm.status import Status
+
+jax.config.update("jax_enable_x64", True)
+
+
+def _cfg(**kw):
+    base = dict(C=10.0, gamma=20.0, epsilon=0.1)
+    base.update(kw)
+    return SVMConfig(**base)
+
+
+def _fit(n=240, seed=0, solver="blocked", **cfg_kw):
+    X, t = svr_sine(n=n, d=1, noise=0.05, seed=seed)
+    model = EpsilonSVR(config=_cfg(**cfg_kw), solver=solver)
+    model.fit(X, t)
+    return model, X, t
+
+
+def test_svr_fits_sine():
+    model, X, t = _fit()
+    assert model.status_ == Status.CONVERGED
+    assert model.score(X, t) > 0.9
+    # held-out
+    Xh, th = svr_sine(n=100, d=1, noise=0.05, seed=99)
+    assert model.score(Xh, th) > 0.85
+
+
+def test_svr_oracle_parity():
+    X, t = svr_sine(n=200, d=1, noise=0.05, seed=3)
+    Xs = MinMaxScaler().fit_transform(X)
+    cfg = _cfg()
+    o = svr_train(Xs, t, cfg)
+    assert o.status == Status.CONVERGED
+    coef_o = collapse_duals(o.alpha)
+    sv_o = set(np.nonzero(np.abs(coef_o) > cfg.sv_tol)[0].tolist())
+
+    model = EpsilonSVR(config=cfg, scale=False)
+    model.fit(Xs, t)
+    sv_m = set(model.sv_ids_.tolist())
+    assert len(sv_m ^ sv_o) <= max(2, len(sv_o) // 25)
+    assert abs(model.b_ - o.b) < 2.5e-2
+
+
+def test_svr_pair_solver_matches_blocked():
+    m_blk, X, t = _fit(seed=5)
+    m_pair, _, _ = _fit(seed=5, solver="pair")
+    assert m_pair.status_ == Status.CONVERGED
+    sym = set(m_blk.sv_ids_.tolist()) ^ set(m_pair.sv_ids_.tolist())
+    assert len(sym) <= max(2, len(m_blk.sv_ids_) // 10)
+    np.testing.assert_allclose(m_pair.predict(X), m_blk.predict(X),
+                               atol=5e-2)
+
+
+def test_svr_epsilon_tube_property():
+    # interior SVs (0 < |coef| < C) sit ON the tube: |t - y(x)| ~ epsilon
+    model, X, t = _fit()
+    cfg = model.config
+    pred = model.predict(X)
+    coef = model.sv_coef_
+    interior = (np.abs(coef) > 1e-6) & (np.abs(coef) < cfg.C - 1e-6)
+    if interior.any():
+        resid = np.abs(t[model.sv_ids_[interior]]
+                       - pred[model.sv_ids_[interior]])
+        np.testing.assert_allclose(resid, cfg.epsilon, atol=2e-2)
+    # non-SV rows are strictly inside the tube (up to solver tolerance)
+    non_sv = np.setdiff1d(np.arange(len(t)), model.sv_ids_)
+    assert np.all(np.abs(t[non_sv] - pred[non_sv])
+                  <= cfg.epsilon + 1e-2)
+
+
+def test_svr_duplicate_rows_do_not_stall():
+    # the doubling makes every row appear twice with opposite labels and
+    # eta = 0 between the twins; the selection argument (kernels/svr.py)
+    # says that pair is never violating — the solve must end CONVERGED
+    X, t = svr_sine(n=120, d=1, noise=0.0, seed=7)
+    model = EpsilonSVR(config=_cfg())
+    with warnings.catch_warnings():
+        warnings.simplefilter("error", RuntimeWarning)  # no non-conv warn
+        model.fit(X, t)
+    assert model.status_ == Status.CONVERGED
+
+
+def test_svr_save_load_roundtrip(tmp_path):
+    model, X, t = _fit()
+    p = str(tmp_path / "svr.npz")
+    model.save(p)
+    loaded = load_any(p)
+    assert isinstance(loaded, EpsilonSVR)
+    assert loaded.config.epsilon == model.config.epsilon
+    np.testing.assert_array_equal(loaded.sv_coef_, model.sv_coef_)
+    np.testing.assert_allclose(loaded.predict(X), model.predict(X),
+                               atol=0)  # bit-identical scoring path
+
+
+def test_svr_load_rejects_classifier_artifact(tmp_path):
+    from tpusvm.data import rings
+    from tpusvm.models import BinarySVC
+
+    X, Y = rings(n=120, seed=0)
+    clf = BinarySVC(config=SVMConfig(C=10.0, gamma=10.0)).fit(X, Y)
+    p = str(tmp_path / "clf.npz")
+    clf.save(p)
+    with pytest.raises(ValueError, match="not an EpsilonSVR"):
+        EpsilonSVR.load(p)
+    # but load_any dispatches correctly
+    assert isinstance(load_any(p), BinarySVC)
+
+
+def test_svr_unfitted_raises():
+    with pytest.raises(RuntimeError, match="not fitted"):
+        EpsilonSVR().predict(np.zeros((2, 2)))
+
+
+def test_svr_linear_kernel():
+    # linear SVR on a linear target: near-perfect fit, tiny SV set
+    rng = np.random.default_rng(0)
+    X = rng.uniform(-1, 1, (200, 3))
+    t = X @ np.asarray([1.0, -2.0, 0.5]) + 0.3
+    model = EpsilonSVR(config=SVMConfig(C=10.0, kernel="linear",
+                                        epsilon=0.05))
+    model.fit(X, t)
+    assert model.status_ == Status.CONVERGED
+    assert model.score(X, t) > 0.99
+
+
+def test_svr_solver_opts_and_telemetry():
+    X, t = svr_sine(n=150, d=1, noise=0.05, seed=1)
+    model = EpsilonSVR(config=_cfg(), solver_opts={"telemetry": 16})
+    model.fit(X, t)
+    assert model.convergence_ is not None
+    assert model.convergence_["rounds_recorded"] >= 1

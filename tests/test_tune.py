@@ -217,6 +217,48 @@ def test_tune_config_validation():
         TuneConfig(patience=0)
 
 
+# ---------------------------------------------------------- kernel families
+def test_tune_over_kernel_families():
+    """Each family runs the full schedule with its own warm store; rows
+    and the winner carry kernel identity; the winner is the global CV
+    argmax (rbf must beat linear on rings — structurally non-linear)."""
+    X, Y = rings(n=200, seed=4)
+    res = tune(X, Y, make_grid([1.0, 10.0], [2.0]), _cfg(),
+               kernels=["rbf", "linear"])
+    assert [k["kernel"] for k in res.kernels] == ["rbf", "linear"]
+    assert len(res.points) == 4  # 2 points x 2 families
+    fams = [r["kernel"] for r in res.points]
+    assert fams == ["rbf", "rbf", "linear", "linear"]
+    assert res.winner["kernel"] == "rbf"
+    rbf_best = max(r["cv_accuracy"] for r in res.points
+                   if r["kernel"] == "rbf")
+    lin_best = max(r["cv_accuracy"] for r in res.points
+                   if r["kernel"] == "linear")
+    assert rbf_best > lin_best  # rings are not linearly separable
+    # per-family warm chaining: the SECOND point of each family seeds
+    for fam in ("rbf", "linear"):
+        rows = [r for r in res.points if r["kernel"] == fam]
+        assert rows[0]["warm_seeded"] == 0
+        assert rows[1]["warm_seeded"] == res.folds
+
+
+def test_normalize_kernel_specs():
+    from tpusvm.tune import normalize_kernel_specs
+
+    base = SVMConfig(degree=2, coef0=1.0)
+    specs = normalize_kernel_specs(["linear", {"kernel": "poly"}], base)
+    assert specs == [
+        {"kernel": "linear", "degree": 2, "coef0": 1.0},
+        {"kernel": "poly", "degree": 2, "coef0": 1.0},
+    ]
+    assert normalize_kernel_specs(None, base) == [
+        {"kernel": "rbf", "degree": 2, "coef0": 1.0}]
+    with pytest.raises(ValueError, match="duplicate kernel spec"):
+        normalize_kernel_specs(["rbf", "rbf"], base)
+    with pytest.raises(ValueError, match="unknown kernel family"):
+        normalize_kernel_specs(["sigmoid"], base)
+
+
 # ----------------------------------------------------------------- results
 def test_tune_result_roundtrip_and_table(tmp_path, rings_data):
     X, Y = rings_data
@@ -228,8 +270,9 @@ def test_tune_result_roundtrip_and_table(tmp_path, rings_data):
     assert back.winner == res.winner
     assert back.points == res.points
     assert back.schedule == "grid" and back.warm_start is True
+    assert back.kernels == [{"kernel": "rbf", "degree": 3, "coef0": 0.0}]
     table = format_table(back)
-    assert "winner: C=1" in table and "EVALUATED" in table
+    assert "winner: kernel=rbf C=1" in table and "EVALUATED" in table
 
 
 def test_tune_result_version_gate(tmp_path):
@@ -245,10 +288,16 @@ def test_tune_result_version_gate(tmp_path):
         load_tune_result(p2)
     # versioned and right kind but missing fields: loud, named error
     p3 = str(tmp_path / "torn.json")
-    json.dump({"kind": "tpusvm-tune-result", "format_version": 1,
+    json.dump({"kind": "tpusvm-tune-result", "format_version": 2,
                "winner": {}}, open(p3, "w"))
     with pytest.raises(ValueError, match="missing tune-result fields"):
         load_tune_result(p3)
+    # v1 files (pre-kernel-axis) hit the version gate, not a field error
+    p4 = str(tmp_path / "v1.json")
+    json.dump({"kind": "tpusvm-tune-result", "format_version": 1,
+               "winner": {}}, open(p4, "w"))
+    with pytest.raises(ValueError, match="unsupported tune-results format"):
+        load_tune_result(p4)
 
 
 # --------------------------------------------------------------------- cli

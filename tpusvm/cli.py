@@ -95,9 +95,9 @@ def _build_parser() -> argparse.ArgumentParser:
                          help="held-out CSV to evaluate on")
         src.add_argument(
             "--synthetic",
-            choices=["mnist-like", "blobs", "rings"],
+            choices=["mnist-like", "blobs", "rings", "sine"],
             help="generate a deterministic synthetic dataset instead of "
-            "reading CSVs",
+            "reading CSVs (sine: continuous targets — --task svr only)",
         )
         if sharded:
             src.add_argument(
@@ -183,6 +183,28 @@ def _build_parser() -> argparse.ArgumentParser:
                       "the device mesh (one-vs-rest problems train "
                       "chip-parallel; requires the pair solver)")
 
+    kt = tr.add_argument_group("kernel / task (tpusvm.kernels)")
+    kt.add_argument("--kernel", choices=["rbf", "linear", "poly"],
+                    default="rbf",
+                    help="kernel family; rbf (default) = the reference's "
+                    "kernel, linear gets a primal-friendly fast path, "
+                    "poly = (gamma*x.z + coef0)^degree")
+    kt.add_argument("--degree", type=int, default=3,
+                    help="polynomial degree (--kernel poly)")
+    kt.add_argument("--coef0", type=float, default=0.0,
+                    help="polynomial additive term (--kernel poly)")
+    kt.add_argument("--task", choices=["svc", "svr"], default="svc",
+                    help="svc = classification (default); svr = "
+                    "epsilon-insensitive regression over the doubled "
+                    "variable set (CSV/synthetic labels are then "
+                    "CONTINUOUS targets)")
+    kt.add_argument("--epsilon", type=float, default=0.1,
+                    help="SVR tube half-width (--task svr)")
+    kt.add_argument("--calibrate", type=int, default=0, metavar="K",
+                    help="fit Platt-scaled predict_proba on K held-out "
+                    "folds after training (binary --task svc, --mode "
+                    "single); the saved model then serves a proba field")
+
     hp = tr.add_argument_group("hyperparameters (defaults = reference constants)")
     hp.add_argument("--preset", choices=["mnist", "banknote", "debug"],
                     default=None, help="named (C, gamma) preset")
@@ -267,7 +289,11 @@ def _build_parser() -> argparse.ArgumentParser:
                     help="sharded --data: rows per scoring batch")
     pr.add_argument("--scores", action="store_true",
                     help="print decision scores instead of accuracy (one "
-                    "line per row; multiclass: one column per class)")
+                    "line per row; multiclass: one column per class; "
+                    "svr: the regressed values)")
+    pr.add_argument("--proba", action="store_true",
+                    help="print Platt-calibrated P(y=+1) per row "
+                    "(requires a binary model trained with --calibrate)")
     pr.add_argument("--mesh-predict", action="store_true",
                     help="shard the test rows over the local device mesh "
                     "(zero-collective sharded serving)")
@@ -315,6 +341,18 @@ def _build_parser() -> argparse.ArgumentParser:
     tu.set_defaults(multiclass=False)  # _load_train_data reads it
 
     space = tu.add_argument_group("search space")
+    space.add_argument("--kernels", metavar="LIST", default=None,
+                       help="comma-separated kernel families to search "
+                       "alongside (C, gamma), e.g. rbf,linear,poly "
+                       "(default: rbf only); each family runs the full "
+                       "schedule over shared fold caches and the winner "
+                       "is the global CV argmax")
+    space.add_argument("--degree", type=int, default=3,
+                       help="polynomial degree for the poly family")
+    space.add_argument("--coef0", type=float, default=1.0,
+                       help="polynomial additive term for the poly family "
+                       "(default 1.0 — coef0=0 with an odd degree cannot "
+                       "shift the decision surface)")
     space.add_argument("--C-grid", metavar="LIST", dest="C_grid",
                        help="comma-separated C values (overrides "
                        "--center-C/--span/--step)")
@@ -422,6 +460,15 @@ def _load_train_data(args) -> Tuple[np.ndarray, np.ndarray, Optional[np.ndarray]
             "pass exactly one of --train / --synthetic / --data"
         )
     if args.train:
+        if getattr(args, "task", "svc") == "svr":
+            # regression: the last CSV column is a CONTINUOUS target
+            from tpusvm.data.csv_reader import read_csv_regression
+
+            X, Y = read_csv_regression(args.train, n_limit=args.n_limit)
+            Xt = Yt = None
+            if args.test:
+                Xt, Yt = read_csv_regression(args.test)
+            return X, Y, Xt, Yt
         binary = not args.multiclass
         X, Y = read_csv_fast(args.train, n_limit=args.n_limit,
                              binary_labels=binary,
@@ -447,6 +494,11 @@ def _load_train_data(args) -> Tuple[np.ndarray, np.ndarray, Optional[np.ndarray]
                               label_noise=BENCH_LABEL_NOISE)
     elif args.synthetic == "blobs":
         X, Y = blobs(n=n_total, d=args.d, seed=args.seed)
+    elif args.synthetic == "sine":
+        # continuous regression targets (--task svr); d=2 recommended
+        from tpusvm.data.synthetic import svr_sine
+
+        X, Y = svr_sine(n=n_total, d=args.d, seed=args.seed)
     else:
         X, Y = rings(n=n_total, seed=args.seed)
     if args.n_limit is not None:
@@ -500,11 +552,25 @@ def _cmd_train(args) -> int:
 
     if args.smoke:
         # the CI gate shape: tiny, CPU-friendly, deterministic, with the
-        # convergence ring ON so the trace carries a real gap trajectory
-        args.synthetic, args.train, args.data = "rings", None, None
+        # convergence ring ON so the trace carries a real gap trajectory.
+        # The workload matches the (kernel, task) cell under test: rings
+        # NEED the RBF kernel (linear fails on them by construction), so
+        # linear/poly smoke runs separable blobs, and --task svr runs the
+        # sine regression problem with an R^2 gate.
+        if args.task == "svr":
+            args.synthetic, args.d = "sine", 2
+            args.C, args.gamma, args.epsilon = 10.0, 20.0, 0.1
+        elif args.kernel == "rbf":
+            args.synthetic = "rings"
+            args.C, args.gamma = 10.0, 10.0
+        else:
+            args.synthetic, args.d = "blobs", 6
+            args.C, args.gamma = 1.0, 1.0
+            if args.kernel == "poly" and args.coef0 == 0.0:
+                args.coef0 = 1.0  # odd-degree poly needs the affine term
+        args.train = args.data = None
         args.test = None
         args.n, args.n_test, args.n_limit = 240, 60, None
-        args.C, args.gamma = 10.0, 10.0
         args.mode, args.multiclass = "single", False
         args.solver = args.solver or "blocked"
         if args.convergence == 0:
@@ -526,14 +592,17 @@ def _cmd_train(args) -> int:
     if args.dtype == "float64":
         jax.config.update("jax_enable_x64", True)
 
+    kernel_kw = dict(kernel=args.kernel, degree=args.degree,
+                     coef0=args.coef0, epsilon=args.epsilon)
     if args.preset:
         cfg = preset(args.preset, tau=args.tau, eps=args.eps,
                      sv_tol=args.sv_tol, max_iter=args.max_iter,
-                     max_rounds=args.max_rounds)
+                     max_rounds=args.max_rounds, **kernel_kw)
     else:
         cfg = SVMConfig(C=args.C, gamma=args.gamma, tau=args.tau,
                         eps=args.eps, sv_tol=args.sv_tol,
-                        max_iter=args.max_iter, max_rounds=args.max_rounds)
+                        max_iter=args.max_iter, max_rounds=args.max_rounds,
+                        **kernel_kw)
 
     solver_opts = _parse_solver_opts(args.solver_opt)
 
@@ -555,8 +624,9 @@ def _cmd_train(args) -> int:
         fn = blocked_smo_solve if solver_name == "blocked" else smo_solve
         # arrays and the hyperparameters with dedicated CLI flags are not
         # --solver-opt material (passing them twice would TypeError in fit)
-        flagged = {"C", "gamma", "eps", "tau", "max_iter", "accum_dtype"}
-        reserved = {"X", "Y", "valid", "alpha0", "sn"} | flagged
+        flagged = {"C", "gamma", "eps", "tau", "max_iter", "accum_dtype",
+                   "kernel", "degree", "coef0"}
+        reserved = {"X", "Y", "valid", "alpha0", "sn", "targets"} | flagged
         known = set(inspect.signature(fn).parameters) - reserved
         bad = sorted(set(solver_opts) - known)
         if bad:
@@ -566,6 +636,31 @@ def _cmd_train(args) -> int:
                 f"{bad}; known: {sorted(known)}"
                 + (f" (use the dedicated flags for {hint})" if hint else "")
             )
+    if args.task == "svr":
+        if args.mode != "single":
+            raise SystemExit("--task svr requires --mode single (the "
+                             "doubled-variable solve; cascade/oracle SVR "
+                             "is a future PR)")
+        if args.multiclass:
+            raise SystemExit("--task svr is a regression task; "
+                             "--multiclass does not apply")
+        if args.data:
+            raise SystemExit("--task svr reads CSVs (--train, continuous "
+                             "last column) or --synthetic sine; sharded "
+                             "--data datasets carry class labels")
+        if args.calibrate:
+            raise SystemExit("--calibrate fits class probabilities; it "
+                             "requires --task svc")
+    elif args.synthetic == "sine":
+        raise SystemExit("--synthetic sine generates continuous targets; "
+                         "it requires --task svr")
+    if args.calibrate:
+        if args.calibrate < 2:
+            raise SystemExit("--calibrate needs >= 2 folds")
+        if args.multiclass or args.mode != "single":
+            raise SystemExit("--calibrate applies to binary --mode single "
+                             "training (Platt scaling of the binary "
+                             "decision function)")
     if args.class_parallel and not args.multiclass:
         raise SystemExit("--class-parallel requires --multiclass (it "
                          "shards the one-vs-rest class axis)")
@@ -640,7 +735,17 @@ def _cmd_train(args) -> int:
     log.info("n = %d, n_features = %d", n, n_features)
     log.event("data", n=n, n_features=n_features, mode=args.mode,
               streamed=dataset is not None)
-    if args.multiclass:
+    if args.task == "svr":
+        from tpusvm.models import EpsilonSVR
+
+        model = EpsilonSVR(config=cfg, dtype=dtype,
+                           scale=not args.no_scale,
+                           accum_dtype=accum_dtype,
+                           solver=args.solver or "blocked",
+                           solver_opts=solver_opts)
+        with timer.phase("training"), trace(args.profile):
+            model.fit(X, Y)
+    elif args.multiclass:
         if args.mode != "single":
             raise SystemExit("--multiclass currently supports --mode single")
         if args.class_parallel and args.solver == "blocked":
@@ -700,13 +805,29 @@ def _cmd_train(args) -> int:
                   sv_count=model.n_support_, status=model.status_.name,
                   train_time_s=timer["training"])
 
+    if args.calibrate:
+        # held-out-fold Platt scaling; the saved model then carries
+        # (platt_a, platt_b) and serve adds a proba field
+        with timer.phase("calibration"):
+            model.calibrate(X, Y, folds=args.calibrate)
+        log.info("calibrated: Platt A=%.6f B=%.6f", *model.platt_)
+        log.event("calibrate", folds=args.calibrate,
+                  platt_a=model.platt_[0], platt_b=model.platt_[1])
+
     acc = None
     if Xt is not None and len(Xt):
         with timer.phase("prediction"):
             acc = model.score(Xt, Yt)
         m = len(Yt)
-        log.info("accuracy = %.4f (%d/%d)", acc, round(acc * m), m)
-        log.event("eval", accuracy=acc, m=m)
+        if args.task == "svr":
+            # score() is R^2 for the regression task
+            rmse = float(np.sqrt(np.mean(
+                (model.predict(Xt) - np.asarray(Yt, np.float64)) ** 2)))
+            log.info("r2 = %.4f  rmse = %.4f (%d rows)", acc, rmse, m)
+            log.event("eval", r2=acc, rmse=rmse, m=m)
+        else:
+            log.info("accuracy = %.4f (%d/%d)", acc, round(acc * m), m)
+            log.event("eval", accuracy=acc, m=m)
 
     if args.save:
         model.save(args.save)
@@ -734,11 +855,12 @@ def _cmd_train(args) -> int:
         tracer.close()
 
     if args.smoke:
+        gate_name = "r2" if args.task == "svr" else "accuracy"
         failures = []
         if model.status_.name != "CONVERGED":
             failures.append(f"solver ended {model.status_.name}")
         if acc is None or acc <= 0.8:
-            failures.append(f"held-out accuracy gate failed ({acc!r})")
+            failures.append(f"held-out {gate_name} gate failed ({acc!r})")
         if conv is None or len(conv["gap"]) == 0:
             failures.append("no convergence telemetry recorded")
         elif conv["gap"][-1] > 2.0 * args.tau * (1 + 1e-9):
@@ -762,8 +884,8 @@ def _cmd_train(args) -> int:
             for f in failures:
                 print(f"TRAIN SMOKE FAILED: {f}")
             return 1
-        print(f"train smoke ok: {model.n_support_} SVs, "
-              f"accuracy {acc:.4f}, "
+        print(f"train smoke ok [{args.kernel}/{args.task}]: "
+              f"{model.n_support_} SVs, {gate_name} {acc:.4f}, "
               f"{conv['rounds_recorded']} convergence rounds recorded")
     return 0
 
@@ -806,6 +928,10 @@ def _cmd_ingest(args) -> int:
         raise SystemExit("ingest: --out DIR is required (or --smoke)")
     if (args.train is None) == (args.synthetic is None):
         raise SystemExit("ingest: pass exactly one of --train / --synthetic")
+    if args.synthetic == "sine":
+        raise SystemExit("ingest shards labelled datasets; --synthetic "
+                         "sine generates continuous SVR targets "
+                         "(train --task svr reads it directly)")
 
     tracer = None
     if args.trace:
@@ -904,16 +1030,26 @@ def _ingest_smoke(args, say) -> int:
 
 def _cmd_predict(args) -> int:
     from tpusvm.data.native_io import read_csv_fast
-    from tpusvm.models import BinarySVC, OneVsRestSVC
-    from tpusvm.models.serialization import is_multiclass_model
+    from tpusvm.models import load_any
+    from tpusvm.models.serialization import model_task
     from tpusvm.stream import is_dataset_dir
     from tpusvm.utils import PhaseTimer
 
     timer = PhaseTimer()
-    # dispatch on the saved state; multiclass labels then stay raw instead
-    # of the reference's binary != 1 -> -1 mapping
-    multiclass = is_multiclass_model(args.model)
-    model = (OneVsRestSVC if multiclass else BinarySVC).load(args.model)
+    # dispatch on the saved state (binary/OVR/SVR); multiclass labels
+    # stay raw instead of the reference's binary != 1 -> -1 mapping
+    task = model_task(args.model)
+    multiclass = task == "ovr"
+    model = load_any(args.model)
+    if args.proba:
+        if task != "svc" or getattr(model, "platt_", None) is None:
+            raise SystemExit(
+                "--proba needs a calibrated binary model (train with "
+                "--calibrate); this artifact carries no Platt coefficients"
+            )
+    if task == "svr" and is_dataset_dir(args.data):
+        raise SystemExit("svr models read CSV test data (--data CSV with "
+                         "a continuous last column)")
     if is_dataset_dir(args.data):
         # streamed scoring off the shards: peak memory is the reader's
         # prefetch bound + one batch, regardless of dataset size
@@ -943,9 +1079,14 @@ def _cmd_predict(args) -> int:
         print(timer.report())
         return 0
     with timer.phase("data"):
-        X, Y = read_csv_fast(args.data, n_limit=args.n_limit,
-                             binary_labels=not multiclass,
-                             positive_label=args.positive_label)
+        if task == "svr":
+            from tpusvm.data.csv_reader import read_csv_regression
+
+            X, Y = read_csv_regression(args.data, n_limit=args.n_limit)
+        else:
+            X, Y = read_csv_fast(args.data, n_limit=args.n_limit,
+                                 binary_labels=not multiclass,
+                                 positive_label=args.positive_label)
     mesh = None
     if args.mesh_predict:
         import jax
@@ -954,12 +1095,26 @@ def _cmd_predict(args) -> int:
 
         devs = jax.local_devices()
         mesh = make_mesh(len(devs), devices=devs)
+    if args.proba:
+        proba = model.predict_proba(X, mesh=mesh)[:, 1]
+        for p in proba:
+            print(f"{p:.15f}")
+        return 0
     if args.scores:
-        scores = np.asarray(model.decision_function(X, mesh=mesh))
+        kw = {} if task == "svr" else {"mesh": mesh}
+        scores = np.asarray(model.decision_function(X, **kw))
         if len(scores):  # reshape(n, -1) is ambiguous on 0 rows;
             # an empty CSV must print nothing, as the old loop did
             for row in scores.reshape(len(scores), -1):
                 print(" ".join(f"{s:.15f}" for s in row))
+        return 0
+    if task == "svr":
+        with timer.phase("prediction"):
+            r2 = model.score(X, Y)
+        rmse = float(np.sqrt(np.mean(
+            (model.predict(X) - np.asarray(Y, np.float64)) ** 2)))
+        print(f"r2 = {r2:.4f}  rmse = {rmse:.4f} ({len(Y)} rows)")
+        print(timer.report())
         return 0
     with timer.phase("prediction"):
         acc = model.score(X, Y, mesh=mesh)
@@ -1109,14 +1264,22 @@ def _cmd_tune(args) -> int:
 
     if args.smoke:
         # the CI gate shape: tiny, CPU-friendly, deterministic — 2 folds,
-        # a 2x2 grid bracketing the rings problem's good region, so the
-        # whole run (including the winner's full-data retrain) is seconds
-        args.synthetic, args.train, args.test = "rings", None, None
-        args.data = None
+        # a 2x2 grid, so the whole run (including the winner's full-data
+        # retrain) is seconds. Single-family smoke keeps the historical
+        # rings problem; a --kernels family sweep runs separable blobs
+        # instead (rings structurally fail the linear family, and the
+        # smoke gates every family's points)
+        multi_family = args.kernels and "," in args.kernels
+        args.synthetic = "blobs" if multi_family else "rings"
+        args.d = 6
+        args.train, args.test, args.data = None, None, None
         args.n, args.n_test, args.n_limit = 240, 60, None
         args.folds, args.fold_seed = 2, 0
         args.C_grid, args.gamma_grid = "1,8", "1,8"
         args.schedule = "grid"
+    if args.synthetic == "sine":
+        raise SystemExit("tune is a classification search; --synthetic "
+                         "sine is --task svr training data")
 
     if args.C_grid or args.gamma_grid:
         if not (args.C_grid and args.gamma_grid):
@@ -1130,7 +1293,10 @@ def _cmd_tune(args) -> int:
                         span=args.span, step=args.step)
 
     base = SVMConfig(tau=args.tau, eps=args.eps, sv_tol=args.sv_tol,
-                     max_iter=args.max_iter)
+                     max_iter=args.max_iter, degree=args.degree,
+                     coef0=args.coef0)
+    kernel_specs = (None if not args.kernels
+                    else [k.strip() for k in args.kernels.split(",")])
     config = TuneConfig(
         folds=args.folds, seed=args.fold_seed, schedule=args.schedule,
         eta=args.eta, min_rung=args.min_rung,
@@ -1194,6 +1360,7 @@ def _cmd_tune(args) -> int:
             log_fn=(lambda msg: None) if args.quiet else print,
             dataset=dataset,
             tracer=tracer,
+            kernels=kernel_specs,
         )
     print(format_table(result))
     if args.results:
@@ -1201,9 +1368,12 @@ def _cmd_tune(args) -> int:
         say(f"results written to {args.results}")
 
     # the winner becomes a normal model: full-data fit with the winning
-    # point, saved in the standard .npz format
+    # point (kernel family included), saved in the standard .npz format
     win_cfg = dataclasses.replace(base, C=result.winner["C"],
-                                  gamma=result.winner["gamma"])
+                                  gamma=result.winner["gamma"],
+                                  kernel=result.winner["kernel"],
+                                  degree=result.winner["degree"],
+                                  coef0=result.winner["coef0"])
     model = BinarySVC(config=win_cfg, dtype=getattr(jnp, args.dtype),
                       scale=not args.no_scale)
     with timer.phase("final-train"):
@@ -1230,11 +1400,15 @@ def _cmd_tune(args) -> int:
     if args.smoke:
         evaluated = [r for r in result.points
                      if r["status"] == TuneStatus.EVALUATED.name]
-        # beyond the very first point every fold fit must have found a
-        # warm seed; a regression that silently runs everything cold
-        # would still "pass" on accuracy alone
-        warm_ok = all(r["warm_seeded"] == args.folds
-                      for r in evaluated[1:])
+        # beyond each FAMILY's first point every fold fit must have found
+        # a warm seed (warm stores are per-family — duals do not transfer
+        # across kernel geometries); a regression that silently runs
+        # everything cold would still "pass" on accuracy alone
+        warm_ok = True
+        for fam in {r["kernel"] for r in evaluated}:
+            fam_rows = [r for r in evaluated if r["kernel"] == fam]
+            warm_ok &= all(r["warm_seeded"] == args.folds
+                           for r in fam_rows[1:])
         acc_ok = all(r["cv_accuracy"] is not None
                      and r["cv_accuracy"] > 0.5 for r in evaluated)
         final_ok = test_acc is not None and test_acc > 0.8
@@ -1242,8 +1416,11 @@ def _cmd_tune(args) -> int:
             print(f"TUNE SMOKE FAILED: warm_ok={warm_ok} acc_ok={acc_ok} "
                   f"final_ok={final_ok} (test_acc={test_acc})")
             return 1
-        print(f"tune smoke ok: {len(evaluated)} points, "
-              f"winner C={result.winner['C']:g} "
+        print(f"tune smoke ok: {len(evaluated)} points over "
+              f"{len(result.kernels)} kernel famil"
+              f"{'ies' if len(result.kernels) > 1 else 'y'}, "
+              f"winner kernel={result.winner['kernel']} "
+              f"C={result.winner['C']:g} "
               f"gamma={result.winner['gamma']:g}, "
               f"test_acc={test_acc:.4f}")
     return 0
@@ -1260,29 +1437,42 @@ def _info_artifact(path: str) -> int:
     if is_tune_result(path):
         print(format_table(load_tune_result(path)))
         return 0
-    from tpusvm.models.serialization import is_multiclass_model, load_model
+    from tpusvm.models.serialization import load_model, model_task
 
     try:
-        multiclass = is_multiclass_model(path)
+        task = model_task(path)
     except (OSError, ValueError) as e:
         raise SystemExit(
             f"info: {path!r} is neither a tune-results JSON nor a "
             f"readable model artifact ({e})"
         )
     state, config = load_model(path)
-    kind = "multiclass (one-vs-rest)" if multiclass else "binary"
+    kind = {"ovr": "multiclass (one-vs-rest)", "svr": "epsilon-SVR"}.get(
+        task, "binary")
     print(f"model: {kind}")
-    if multiclass:
+    if task == "ovr":
         print(f"classes: {state['classes'].tolist()}")
         print(f"SV union: {state['sv_X'].shape[0]}")
         print(f"n_features: {state['sv_X'].shape[1]}")
     else:
-        print(f"SV count: {len(state['sv_alpha'])}")
+        sv_key = "sv_coef" if task == "svr" else "sv_alpha"
+        print(f"SV count: {len(state[sv_key])}")
         print(f"n_features: {state['sv_X'].shape[1]}")
         print(f"b = {float(state['b']):.15f}")
+    kern = f"kernel: {config.kernel}"
+    if config.kernel == "poly":
+        kern += f" (degree={config.degree} coef0={config.coef0:g})"
+    print(kern)
     print(f"config: C={config.C:g} gamma={config.gamma:g} "
-          f"tau={config.tau:g} sv_tol={config.sv_tol:g}")
+          f"tau={config.tau:g} sv_tol={config.sv_tol:g}"
+          + (f" epsilon={config.epsilon:g}" if task == "svr" else ""))
     print(f"scaled: {bool(state.get('scale', False))}")
+    if task == "svc":
+        if "platt_a" in state:
+            print(f"calibrated: yes (Platt A={float(state['platt_a']):.6f} "
+                  f"B={float(state['platt_b']):.6f})")
+        else:
+            print("calibrated: no")
     return 0
 
 

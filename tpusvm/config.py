@@ -14,6 +14,12 @@ import dataclasses
 from typing import Optional
 
 
+# Supported kernel families (tpusvm.kernels). Lives here — not in the
+# kernels package — so config/serialization can validate names without
+# importing the JAX-backed dispatch module.
+KERNEL_FAMILIES = ("rbf", "linear", "poly")
+
+
 @dataclasses.dataclass(frozen=True)
 class SVMConfig:
     """Hyperparameters and numerical tolerances of the SMO solver.
@@ -21,7 +27,8 @@ class SVMConfig:
     Attributes:
       C: box constraint (reference main3.cpp:342 — C=10 for MNIST, 1 for banknote).
       gamma: RBF width, K(a,b)=exp(-gamma*||a-b||^2) (main3.cpp:95 — 0.00125 for
-        MNIST, 0.125 for banknote/debug).
+        MNIST, 0.125 for banknote/debug); for kernel="poly" the dot-product
+        scale (gamma*a.b + coef0)^degree; unused by kernel="linear".
       tau: stopping tolerance; converged when b_low <= b_high + 2*tau
         (main3.cpp:196, :213).
       eps: index-set tolerance for I_high/I_low membership, eta positivity guard,
@@ -29,6 +36,14 @@ class SVMConfig:
       sv_tol: alpha > sv_tol defines a support vector (main3.cpp:297).
       max_iter: SMO update cap (main3.cpp:198).
       max_rounds: cascade round cap (mpi_svm_main3.cpp:544).
+      kernel: kernel family, one of KERNEL_FAMILIES; "rbf" (the default) is
+        the reference's only kernel, so a zero-flag config stays a parity
+        config.
+      degree: polynomial degree (kernel="poly" only; static — each degree
+        compiles its own solver).
+      coef0: polynomial additive term (kernel="poly" only; traced).
+      epsilon: the epsilon-SVR tube half-width (EpsilonSVR only; ignored by
+        classification).
     """
 
     C: float = 10.0
@@ -38,6 +53,21 @@ class SVMConfig:
     sv_tol: float = 1e-8
     max_iter: int = 100000
     max_rounds: int = 50
+    kernel: str = "rbf"
+    degree: int = 3
+    coef0: float = 0.0
+    epsilon: float = 0.1
+
+    def __post_init__(self):
+        if self.kernel not in KERNEL_FAMILIES:
+            raise ValueError(
+                f"unknown kernel family {self.kernel!r}; supported: "
+                f"{list(KERNEL_FAMILIES)}"
+            )
+        if self.degree < 1:
+            raise ValueError(f"degree must be >= 1, got {self.degree}")
+        if self.epsilon < 0:
+            raise ValueError(f"epsilon must be >= 0, got {self.epsilon}")
 
 
 @dataclasses.dataclass(frozen=True)

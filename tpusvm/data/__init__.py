@@ -1,7 +1,13 @@
 from tpusvm.data.csv_reader import read_csv, read_csv_blocks, write_csv
 from tpusvm.data.partition import Partition, partition
 from tpusvm.data.scaler import MinMaxScaler, merge_minmax
-from tpusvm.data.synthetic import blobs, mnist_like, mnist_like_multiclass, rings
+from tpusvm.data.synthetic import (
+    blobs,
+    mnist_like,
+    mnist_like_multiclass,
+    rings,
+    svr_sine,
+)
 
 __all__ = [
     "read_csv",
@@ -15,4 +21,5 @@ __all__ = [
     "rings",
     "mnist_like",
     "mnist_like_multiclass",
+    "svr_sine",
 ]

@@ -77,6 +77,38 @@ def read_csv(
     return X, Y
 
 
+def read_csv_regression(
+    filename: str,
+    n_limit: Optional[int] = None,
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Read a CSV whose last column is a CONTINUOUS regression target.
+
+    Same layout rules as read_csv (header discarded, <2-field rows
+    skipped, n_limit cap) but the target keeps its float value instead of
+    the reference's int-parse + one-vs-rest mapping — the epsilon-SVR
+    input path. Returns (X float64, t float64).
+    """
+    xs = []
+    ts = []
+    kept = 0
+    with open(filename, "r") as f:
+        header = f.readline()
+        n_features = len(header.rstrip("\n").split(",")) - 1
+        for line in f:
+            if n_limit is not None and kept >= n_limit:
+                break
+            fields = line.rstrip("\n").split(",")
+            if len(fields) < 2:
+                continue
+            kept += 1
+            xs.append([float(v) for v in fields[:-1]])
+            ts.append(float(fields[-1]))
+    if not ts:
+        return (np.zeros((0, max(n_features, 0)), np.float64),
+                np.zeros((0,), np.float64))
+    return np.asarray(xs, np.float64), np.asarray(ts, np.float64)
+
+
 def read_csv_blocks(
     filename: str,
     block_rows: int = 8192,

@@ -76,6 +76,27 @@ def rings(
     return X[perm], Y[perm]
 
 
+def svr_sine(
+    n: int = 400, d: int = 2, noise: float = 0.05, seed: int = 0
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Smooth regression problem for epsilon-SVR: continuous targets.
+
+    X uniform on [-3, 3]^d; the target is a sine of the first coordinate
+    plus small linear terms of the rest (so every feature carries signal
+    but the problem stays dominated by a 1-D nonlinearity an RBF machine
+    resolves easily), plus gaussian target noise. Returns (X, t) with t
+    float64 — the labels column is a CONTINUOUS target, not a class.
+    """
+    rng = np.random.default_rng(seed)
+    X = rng.uniform(-3.0, 3.0, size=(n, d))
+    t = np.sin(X[:, 0])
+    for j in range(1, d):
+        t = t + 0.25 * X[:, j]
+    if noise > 0:
+        t = t + rng.normal(0, noise, size=n)
+    return X, t
+
+
 def mnist_like_multiclass(
     n: int = 60000, d: int = 784, n_classes: int = 10, rank: int = 32, seed: int = 587,
     noise: float = 0.0,

@@ -1,0 +1,36 @@
+"""tpusvm.kernels — the pluggable kernel/task matrix.
+
+The solvers' SMO structure is kernel-agnostic (SURVEY §0: K-row
+computation, error-vector update, working-set selection); this package
+factors the kernel touchpoints behind a static family dispatch
+(dispatch.py: "rbf" | "linear" | "poly") and hosts the two task
+extensions built on it — the epsilon-SVR variable doubling (svr.py) and
+Platt probability calibration (platt.py).
+"""
+
+from tpusvm.config import KERNEL_FAMILIES
+from tpusvm.kernels.dispatch import (
+    cross,
+    cross_matvec,
+    matvec,
+    needs_norms,
+    rows_at,
+    validate_family,
+)
+from tpusvm.kernels.platt import fit_platt, log_loss, platt_proba
+from tpusvm.kernels.svr import collapse_duals, doubled_problem
+
+__all__ = [
+    "KERNEL_FAMILIES",
+    "rows_at",
+    "cross",
+    "cross_matvec",
+    "matvec",
+    "needs_norms",
+    "validate_family",
+    "doubled_problem",
+    "collapse_duals",
+    "fit_platt",
+    "platt_proba",
+    "log_loss",
+]

@@ -1,0 +1,114 @@
+"""Static kernel-family dispatch: the solver's single kernel touchpoint.
+
+The SMO machinery (analytic 2-alpha update, Keerthi selection, the blocked
+outer loop) only touches the kernel through four computations — a K-row
+batch for the selected indices, the small K_BB working-set matrix, the
+blocked K(X, X_B) @ coef error-vector contraction, and the warm-start
+K @ coef reconstruction. This module routes each of those through the
+family named by a STATIC string (`kernel` is a jit static argname in both
+solvers), so the dispatch happens at trace time and every family compiles
+to exactly its own program:
+
+  - "rbf":    the existing ops/rbf.py implementations, called with
+              byte-identical arguments — the refactor is bit-transparent
+              to every pre-existing RBF trajectory;
+  - "linear": K(x, z) = x.z — no precomputables at all (needs_norms is
+              False, so solvers skip the sq_norms pass entirely), and the
+              blocked contraction has a primal fast path
+              X @ (X_B^T coef) that never materialises a kernel slab
+              (kernels/linear.py);
+  - "poly":   K(x, z) = (gamma x.z + coef0)^degree — the same dot-form
+              matmuls as linear with a pointwise affine+power epilogue
+              (kernels/poly.py). `degree` is static (a Python int power),
+              gamma/coef0 are traced scalars like gamma everywhere else.
+
+Family validation raises the same clear error everywhere (solvers,
+serialization, config) via `validate_family`.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+
+from tpusvm.config import KERNEL_FAMILIES
+from tpusvm.kernels import linear as _lin
+from tpusvm.kernels import poly as _poly
+from tpusvm.ops import rbf as _rbf
+
+
+def validate_family(family: str) -> str:
+    if family not in KERNEL_FAMILIES:
+        raise ValueError(
+            f"unknown kernel family {family!r}; supported: "
+            f"{list(KERNEL_FAMILIES)}"
+        )
+    return family
+
+
+def needs_norms(family: str) -> bool:
+    """Whether the family consumes per-row squared norms (sq_norms).
+
+    Only RBF does (the distance-dot trick); linear/poly solvers skip the
+    O(n*d) norms pass and carry sn=None.
+    """
+    return validate_family(family) == "rbf"
+
+
+def rows_at(family: str, X: jax.Array, idx: jax.Array, *, gamma, coef0=0.0,
+            degree: int = 3, sn: Optional[jax.Array] = None,
+            precision=None) -> jax.Array:
+    """K(X[idx[k]], X[j]) for a small static-size index vector. (k, n)."""
+    if family == "rbf":
+        return _rbf.rbf_rows_at(X, idx, gamma, sn, precision)
+    if family == "linear":
+        return _lin.linear_rows_at(X, idx, precision)
+    validate_family(family)
+    return _poly.poly_rows_at(X, idx, gamma, coef0, degree, precision)
+
+
+def cross(family: str, XA: jax.Array, XB: jax.Array, *, gamma, coef0=0.0,
+          degree: int = 3, snA: Optional[jax.Array] = None,
+          snB: Optional[jax.Array] = None, precision=None) -> jax.Array:
+    """Full K(XA, XB) kernel matrix, shape (nA, nB)."""
+    if family == "rbf":
+        return _rbf.rbf_cross(XA, XB, gamma, snA, snB, precision)
+    if family == "linear":
+        return _lin.linear_cross(XA, XB, precision)
+    validate_family(family)
+    return _poly.poly_cross(XA, XB, gamma, coef0, degree, precision)
+
+
+def cross_matvec(family: str, X: jax.Array, XB: jax.Array, coef: jax.Array,
+                 *, gamma, coef0=0.0, degree: int = 3,
+                 sn: Optional[jax.Array] = None, block: int = 8192,
+                 precision=None, fast: bool = True) -> jax.Array:
+    """sum_k coef_k K(x_i, xb_k) for all i — the blocked f update. (n,).
+
+    fast only affects "linear": True (default) computes the primal form
+    X @ (X_B^T coef) — one (d,) intermediate, no (n, q) kernel slab, no
+    row-norm traffic; False runs the generic blocked K-row path (the
+    benchmark control arm, benchmarks/kernel_matrix.py).
+    """
+    if family == "rbf":
+        return _rbf.rbf_cross_matvec(X, XB, coef, gamma, sn, block,
+                                     precision)
+    if family == "linear":
+        return _lin.linear_cross_matvec(X, XB, coef, block=block,
+                                        precision=precision, fast=fast)
+    validate_family(family)
+    return _poly.poly_cross_matvec(X, XB, coef, gamma, coef0, degree,
+                                   block=block, precision=precision)
+
+
+def matvec(family: str, X: jax.Array, coef: jax.Array, *, gamma, coef0=0.0,
+           degree: int = 3, block: int = 1024, precision=None) -> jax.Array:
+    """sum_j coef_j K(x_j, x_i) for all i — warm-start f reconstruction."""
+    if family == "rbf":
+        return _rbf.rbf_matvec(X, coef, gamma, block, precision)
+    if family == "linear":
+        return _lin.linear_matvec(X, coef, precision=precision)
+    validate_family(family)
+    return _poly.poly_matvec(X, coef, gamma, coef0, degree, block=block,
+                             precision=precision)

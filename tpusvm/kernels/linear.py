@@ -1,0 +1,83 @@
+"""Linear kernel K(x, z) = x.z, with the primal-friendly fast path.
+
+The linear family needs NO per-kernel precomputables (no row norms, no
+distance trick, no exp): every computation is a plain MXU matmul over X.
+That structure admits an optimisation the other families cannot express —
+the blocked error-vector contraction K(X, X_B) @ coef collapses to
+
+    X @ (X_B^T @ coef)
+
+because K(X, X_B) = X X_B^T: fold the coefficient vector into a single
+(d,) weight delta first, then one (n, d) x (d,) matvec applies it to every
+row. The generic path streams X once AND materialises (block, q) kernel
+slabs per block; the primal form streams X once with a q*d-flop prologue
+and no slab at all — the "linear gets a dedicated primal-friendly fast
+path" design (ROADMAP Scenario diversity; measured in
+benchmarks/results/kernel_matrix_cpu.jsonl). Both forms are kept: the
+generic path is the benchmark control arm and the template the poly
+family shares.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from tpusvm.ops.rbf import _prec
+
+
+def linear_row(X: jax.Array, x: jax.Array, precision=None) -> jax.Array:
+    """K(x, X[j]) for all j. Shape (n,)."""
+    return jnp.matmul(X, x, precision=_prec(precision))
+
+
+def linear_rows_at(X: jax.Array, idx: jax.Array, precision=None) -> jax.Array:
+    """K(X[idx[k]], X[j]) — one (k, d) x (d, n) matvec, no row-norm
+    traffic (the K-row IS the matmul for this family). Shape (k, n)."""
+    return jnp.matmul(X[idx], X.T, precision=_prec(precision))
+
+
+def linear_cross(XA: jax.Array, XB: jax.Array, precision=None) -> jax.Array:
+    """Full K(XA, XB) = XA @ XB^T, shape (nA, nB)."""
+    return jnp.matmul(XA, XB.T, precision=_prec(precision))
+
+
+def linear_cross_matvec(X: jax.Array, XB: jax.Array, coef: jax.Array, *,
+                        block: int = 8192, precision=None,
+                        fast: bool = True) -> jax.Array:
+    """sum_k coef_k (x_i . xb_k) for all i. Shape (n,).
+
+    fast=True: the primal form X @ (XB^T coef) — O(q*d + n*d) flops, zero
+    kernel-slab memory. fast=False: the generic blocked K-row path (same
+    loop structure as rbf_cross_matvec minus the distance/exp epilogue) —
+    O(n*q*d) flops and a (block, q) slab per step; kept as the measured
+    control arm. Both compute the same sum (association differs, so
+    results agree to normal f32 matmul reordering noise, not bitwise).
+    """
+    coef = coef.astype(X.dtype)
+    if fast:
+        w = jnp.matmul(XB.T, coef, precision=_prec(precision))  # (d,)
+        return jnp.matmul(X, w, precision=_prec(precision))
+
+    n, d = X.shape
+    block = min(block, n)
+    nb = -(-n // block)
+
+    def step(_, start):
+        zero = jnp.zeros((), start.dtype)
+        Xblk = jax.lax.dynamic_slice(X, (start, zero), (block, d))
+        K = jnp.matmul(Xblk, XB.T, precision=_prec(precision))
+        return None, K @ coef
+
+    starts = jnp.minimum(
+        jnp.arange(nb, dtype=jnp.int32) * block, max(n - block, 0)
+    )
+    _, chunks = jax.lax.scan(step, None, starts)
+    body = chunks[:-1].reshape(-1)
+    tail = chunks[-1, (nb * block - n):]
+    return jnp.concatenate([body, tail]).astype(X.dtype)
+
+
+def linear_matvec(X: jax.Array, coef: jax.Array, precision=None) -> jax.Array:
+    """sum_j coef_j (x_j . x_i) for all i = X @ (X^T coef). Shape (n,)."""
+    return linear_cross_matvec(X, X, coef, precision=precision, fast=True)

@@ -1,0 +1,79 @@
+"""Polynomial kernel K(x, z) = (gamma * x.z + coef0)^degree.
+
+Structurally the linear family's matmuls with a pointwise affine + power
+epilogue — the "powered dot" precomputable the kernel interface names:
+every computation forms the dot product first (one MXU matmul, exactly the
+linear family's shape) and applies the epilogue elementwise on the result
+tile. `degree` is a STATIC Python int (the power unrolls at trace time;
+integer powers of possibly-negative bases are exact), gamma and coef0 are
+traced scalars so a (gamma, coef0) sweep reuses one compiled solver, the
+same contract as RBF's gamma everywhere else.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from tpusvm.ops.rbf import _prec
+
+
+def _epilogue(dots: jax.Array, gamma, coef0, degree: int) -> jax.Array:
+    return (gamma * dots + coef0) ** degree
+
+
+def poly_row(X: jax.Array, x: jax.Array, gamma, coef0, degree: int,
+             precision=None) -> jax.Array:
+    """K(x, X[j]) for all j. Shape (n,)."""
+    return _epilogue(jnp.matmul(X, x, precision=_prec(precision)),
+                     gamma, coef0, degree)
+
+
+def poly_rows_at(X: jax.Array, idx: jax.Array, gamma, coef0, degree: int,
+                 precision=None) -> jax.Array:
+    """K(X[idx[k]], X[j]) via one (k, d) x (d, n) matmul. Shape (k, n)."""
+    dots = jnp.matmul(X[idx], X.T, precision=_prec(precision))
+    return _epilogue(dots, gamma, coef0, degree)
+
+
+def poly_cross(XA: jax.Array, XB: jax.Array, gamma, coef0, degree: int,
+               precision=None) -> jax.Array:
+    """Full K(XA, XB), shape (nA, nB)."""
+    dots = jnp.matmul(XA, XB.T, precision=_prec(precision))
+    return _epilogue(dots, gamma, coef0, degree)
+
+
+def poly_cross_matvec(X: jax.Array, XB: jax.Array, coef: jax.Array, gamma,
+                      coef0, degree: int, *, block: int = 8192,
+                      precision=None) -> jax.Array:
+    """sum_k coef_k K(x_i, xb_k) for all i, blocked over i. Shape (n,).
+
+    The non-linearity of the epilogue rules out the linear family's primal
+    collapse, so this is the generic blocked K-row path: a (block, q) tile
+    per step, never the full (n, q) slab.
+    """
+    n, d = X.shape
+    block = min(block, n)
+    nb = -(-n // block)
+    coef = coef.astype(X.dtype)
+
+    def step(_, start):
+        zero = jnp.zeros((), start.dtype)
+        Xblk = jax.lax.dynamic_slice(X, (start, zero), (block, d))
+        dots = jnp.matmul(Xblk, XB.T, precision=_prec(precision))
+        return None, _epilogue(dots, gamma, coef0, degree) @ coef
+
+    starts = jnp.minimum(
+        jnp.arange(nb, dtype=jnp.int32) * block, max(n - block, 0)
+    )
+    _, chunks = jax.lax.scan(step, None, starts)
+    body = chunks[:-1].reshape(-1)
+    tail = chunks[-1, (nb * block - n):]
+    return jnp.concatenate([body, tail]).astype(X.dtype)
+
+
+def poly_matvec(X: jax.Array, coef: jax.Array, gamma, coef0, degree: int, *,
+                block: int = 1024, precision=None) -> jax.Array:
+    """sum_j coef_j K(x_j, x_i) for all i. Shape (n,)."""
+    return poly_cross_matvec(X, X, coef, gamma, coef0, degree, block=block,
+                             precision=precision)

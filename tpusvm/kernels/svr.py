@@ -1,0 +1,73 @@
+"""Epsilon-SVR as a doubled-variable classification-shaped SMO problem.
+
+The SVR dual over (alpha, alpha*) maps exactly onto the classification SMO
+skeleton the solvers already implement (Keerthi et al., "Improvements to
+SMO for SVM regression"): stack beta = [alpha; alpha*] over 2n variables
+with labels y = [+1]*n + [-1]*n and PSEUDO-TARGETS
+
+    z_i     = t_i - epsilon   (the alpha half,  y = +1)
+    z_{i+n} = t_i + epsilon   (the alpha* half, y = -1)
+
+Then the error vector f_i = sum_j beta_j y_j K_ij - z_i satisfies
+dL/dbeta_i = y_i f_i — identical to classification, where f_i uses z = y.
+Every downstream piece is untouched: the I_high/I_low index sets, the
+Keerthi (b_high, b_low) stopping rule, the analytic 2-alpha update with
+the s = y_h*y_l box, warm starts, the blocked working-set machinery. The
+solvers expose this through one new operand (`targets=z`, defaulting to
+z = Y, i.e. classification); everything else is "the same SMO skeleton".
+
+The degenerate twin pair (i, i+n) — identical feature rows, opposite
+labels, eta = 0 — can never be selected as a violating pair: their f
+values differ by exactly 2*epsilon with f_i the LARGER (z_i is smaller),
+in the non-violating direction, and f updates shift both by the same
+amount (identical K rows), so the gap is invariant. The eta <= eps guard
+stays as the backstop for duplicates already present in the data, as in
+classification.
+
+Prediction collapses the doubling: coef_i = beta_i - beta_{i+n} =
+alpha_i - alpha*_i, and the regressed value is
+
+    y(x) = sum_i coef_i K(x, x_i) - b
+
+with b = (b_high + b_low)/2 from the solver — the SAME form as the
+classification decision function (the sign convention matches because the
+KKT condition for an interior alpha_i reads f_i = b there too), so
+solver/predict.py, serve's bucket executables, and the serialization
+state layout all serve SVR models with zero new score paths.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import numpy as np
+
+
+def doubled_problem(t: np.ndarray, epsilon: float
+                    ) -> Tuple[np.ndarray, np.ndarray]:
+    """(Y2, z) for the 2n-variable problem; X doubles by concatenation.
+
+    Y2 is the {+1, -1} label stacking, z the pseudo-target vector the
+    solvers take as `targets`. Pure NumPy so the f64 oracle shares the
+    construction byte-for-byte with the estimators.
+    """
+    t = np.asarray(t, np.float64)
+    if t.ndim != 1:
+        raise ValueError(f"targets must be 1-D, got shape {t.shape}")
+    if epsilon < 0:
+        raise ValueError(f"epsilon must be >= 0, got {epsilon}")
+    n = len(t)
+    Y2 = np.concatenate([np.ones(n, np.int32), -np.ones(n, np.int32)])
+    z = np.concatenate([t - epsilon, t + epsilon])
+    return Y2, z
+
+
+def collapse_duals(beta: np.ndarray) -> np.ndarray:
+    """Signed dual coefficients coef = alpha - alpha* from the 2n betas."""
+    beta = np.asarray(beta)
+    if beta.ndim != 1 or beta.shape[0] % 2:
+        raise ValueError(
+            f"expected a flat 2n dual vector, got shape {beta.shape}"
+        )
+    n = beta.shape[0] // 2
+    return beta[:n] - beta[n:]

@@ -1,5 +1,24 @@
 from tpusvm.models.ovr import OneVsRestSVC
-from tpusvm.models.serialization import load_model, save_model
+from tpusvm.models.serialization import load_model, model_task, save_model
 from tpusvm.models.svm import BinarySVC
+from tpusvm.models.svr import EpsilonSVR
 
-__all__ = ["BinarySVC", "OneVsRestSVC", "save_model", "load_model"]
+
+def load_any(path: str, dtype=None):
+    """Load any saved model artifact with the right estimator class.
+
+    Dispatches on the state layout (serialization.model_task): OvR states
+    carry `classes`, SVR states a `task` marker, everything else — every
+    v1 file included — is a BinarySVC. The single loader `tpusvm predict`
+    and serve's ModelEntry.from_path share.
+    """
+    import jax.numpy as jnp
+
+    dtype = jnp.float32 if dtype is None else dtype
+    kind = model_task(path)
+    cls = {"ovr": OneVsRestSVC, "svr": EpsilonSVR}.get(kind, BinarySVC)
+    return cls.load(path, dtype=dtype)
+
+
+__all__ = ["BinarySVC", "OneVsRestSVC", "EpsilonSVR", "save_model",
+           "load_model", "load_any", "model_task"]

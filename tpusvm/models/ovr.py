@@ -36,6 +36,7 @@ chips"). TPU-native design:
 
 from __future__ import annotations
 
+import functools
 import time
 import warnings
 from typing import Optional
@@ -47,7 +48,7 @@ import numpy as np
 from tpusvm.config import SVMConfig, resolve_accum_dtype
 from tpusvm.data.scaler import MinMaxScaler
 from tpusvm.models.serialization import load_model, save_model
-from tpusvm.ops.rbf import rbf_cross, sq_norms
+from tpusvm.ops.rbf import sq_norms
 from tpusvm.solver.smo import smo_solve
 from tpusvm.status import Status
 
@@ -154,6 +155,7 @@ class OneVsRestSVC:
                 return blocked_smo_solve(
                     Xd, y, C=cfg.C, gamma=cfg.gamma, eps=cfg.eps,
                     tau=cfg.tau, max_iter=cfg.max_iter,
+                    kernel=cfg.kernel, degree=cfg.degree, coef0=cfg.coef0,
                     accum_dtype=accum_dtype, **self.solver_opts,
                 )
         else:
@@ -161,6 +163,7 @@ class OneVsRestSVC:
                 return smo_solve(
                     Xarr, y, C=cfg.C, gamma=cfg.gamma, eps=cfg.eps,
                     tau=cfg.tau, max_iter=cfg.max_iter,
+                    kernel=cfg.kernel, degree=cfg.degree, coef0=cfg.coef0,
                     accum_dtype=accum_dtype, **self.solver_opts,
                 )
 
@@ -308,6 +311,9 @@ class OneVsRestSVC:
             jnp.asarray(self.coef_, self.dtype),
             jnp.asarray(self.b_, self.dtype),
             self.config.gamma,
+            self.config.coef0,
+            kernel=self.config.kernel,
+            degree=self.config.degree,
         )
         return np.asarray(scores[:m])
 
@@ -348,7 +354,12 @@ class OneVsRestSVC:
         return model
 
 
-@jax.jit
-def _ovr_scores(Xq, X_sv, coef, b, gamma):
-    K = rbf_cross(Xq, X_sv, gamma, snB=sq_norms(X_sv))  # (m, n_sv)
+@functools.partial(jax.jit, static_argnames=("kernel", "degree"))
+def _ovr_scores(Xq, X_sv, coef, b, gamma, coef0=0.0, *, kernel="rbf",
+                degree=3):
+    from tpusvm import kernels
+
+    snB = sq_norms(X_sv) if kernels.needs_norms(kernel) else None
+    K = kernels.cross(kernel, Xq, X_sv, gamma=gamma, coef0=coef0,
+                      degree=degree, snB=snB)  # (m, n_sv)
     return K @ coef.T - b[None, :]

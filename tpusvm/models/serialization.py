@@ -1,10 +1,24 @@
-"""Model persistence: save/load = (SV X, Y, alpha, ids, b, scaler, config).
+"""Model persistence: save/load = (SV X, Y/coef, alpha, ids, b, scaler, config).
 
 The reference intended but never enabled model persistence — the final-model
 dump is commented out (mpi_svm_main3.cpp:754-770: final_sv_ids/labels/
 alphas/b.txt). This implements that intent properly as a single .npz
 (SURVEY.md §5.4): everything needed to predict — support vectors, duals,
 bias, the train-set min/max of the scaler, and the hyperparameters.
+
+Format history:
+  v1  binary/OvR RBF classifiers; config carries only numeric fields.
+  v2  the kernel/task matrix (this version): config gains the kernel
+      family + degree/coef0/epsilon, state may carry a `task` marker
+      ("svr" for EpsilonSVR; absent = classification), SVR states store
+      signed `sv_coef` instead of (sv_Y, sv_alpha), and calibrated
+      classifiers add `platt_a`/`platt_b`.
+
+Compatibility contract: v1 files LOAD — their configs predate the kernel
+fields, which default to the implicit RBF family (bit-identical scoring to
+the build that wrote them). v2 files with an unknown kernel name fail with
+a specific error (written by a newer/tampered tpusvm), never a downstream
+shape or math error.
 """
 
 from __future__ import annotations
@@ -14,9 +28,10 @@ from typing import Any, Dict
 
 import numpy as np
 
-from tpusvm.config import SVMConfig
+from tpusvm.config import KERNEL_FAMILIES, SVMConfig
 
-_FORMAT_VERSION = 1
+_FORMAT_VERSION = 2
+_SUPPORTED_VERSIONS = (1, 2)
 
 
 def _norm(path: str) -> str:
@@ -32,6 +47,22 @@ def is_multiclass_model(path: str) -> bool:
     load."""
     with np.load(_norm(path), allow_pickle=False) as z:
         return "classes" in z.files
+
+
+def model_task(path: str) -> str:
+    """Artifact kind sniff: "ovr" | "svr" | "svc".
+
+    Dispatch key for loaders (`tpusvm predict`, serve's from_path): OvR
+    states carry `classes`, SVR states a `task` marker; anything else is a
+    binary classifier (including every v1 file, which predates the
+    marker).
+    """
+    with np.load(_norm(path), allow_pickle=False) as z:
+        if "classes" in z.files:
+            return "ovr"
+        if "task" in z.files:
+            return str(z["task"].item())
+    return "svc"
 
 
 def save_model(path: str, state: Dict[str, Any], config: SVMConfig) -> None:
@@ -50,7 +81,9 @@ def load_model(path: str):
     trained must fail loudly and specifically — a missing field means "not
     a tpusvm model" (or one predating versioning), an unknown version means
     "written by a different tpusvm"; neither may surface as a KeyError from
-    whichever state field happens to be read first.
+    whichever state field happens to be read first. The kernel family gets
+    the same treatment: a v2 file naming a family this build does not
+    implement fails HERE, not as a dispatch error mid-request.
     """
     with np.load(_norm(path), allow_pickle=False) as z:
         if "format_version" not in z.files:
@@ -60,13 +93,13 @@ def load_model(path: str):
                 "versioning; retrain and re-save it)"
             )
         version = int(z["format_version"])
-        if version != _FORMAT_VERSION:
+        if version not in _SUPPORTED_VERSIONS:
             raise ValueError(
                 f"unsupported model format version {version} in "
-                f"{_norm(path)!r}: this build reads version "
-                f"{_FORMAT_VERSION}"
+                f"{_norm(path)!r}: this build reads versions "
+                f"{list(_SUPPORTED_VERSIONS)}"
             )
-        cfg_fields = {f.name for f in dataclasses.fields(SVMConfig)}
+        cfg_fields = SVMConfig.__dataclass_fields__
         cfg_kwargs = {}
         state = {}
         for key in z.files:
@@ -78,8 +111,22 @@ def load_model(path: str):
                     # host-side numpy .item() on an npz scalar, not a
                     # device sync  # tpusvm: disable=JX002
                     val = z[key].item()
-                    ftype = SVMConfig.__dataclass_fields__[name].type
-                    cfg_kwargs[name] = int(val) if ftype == "int" else float(val)
+                    ftype = cfg_fields[name].type
+                    if ftype == "int":
+                        cfg_kwargs[name] = int(val)
+                    elif ftype == "float":
+                        cfg_kwargs[name] = float(val)
+                    else:
+                        cfg_kwargs[name] = str(val)
             else:
                 state[key] = z[key]
+    # v1 files predate the kernel fields: absent keys fall through to the
+    # SVMConfig defaults — the implicit RBF family they were trained with
+    family = cfg_kwargs.get("kernel", "rbf")
+    if family not in KERNEL_FAMILIES:
+        raise ValueError(
+            f"{_norm(path)!r} names kernel family {family!r}, which this "
+            f"build does not implement (supported: {list(KERNEL_FAMILIES)}"
+            "); the artifact was written by a newer tpusvm or tampered with"
+        )
     return state, SVMConfig(**cfg_kwargs)

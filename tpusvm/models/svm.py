@@ -38,10 +38,12 @@ from tpusvm.status import Status
 
 
 class BinarySVC:
-    """Binary RBF-kernel SVM trained with on-device SMO.
+    """Binary SVM trained with on-device SMO (kernel from config.kernel:
+    rbf, linear, or poly — tpusvm.kernels).
 
     Attributes after fit: sv_X_, sv_Y_, sv_alpha_, sv_ids_, b_, n_iter_,
-    status_, train_time_s_, scaler_.
+    status_, train_time_s_, scaler_; after calibrate(): platt_ (A, B) and
+    predict_proba becomes available.
     """
 
     def __init__(
@@ -89,6 +91,8 @@ class BinarySVC:
         # materialized convergence telemetry (obs.convergence.materialize
         # output) when the blocked solver ran with telemetry=T > 0
         self.convergence_: Optional[dict] = None
+        # Platt sigmoid (A, B) after calibrate(); enables predict_proba
+        self.platt_: Optional[tuple] = None
 
     # ------------------------------------------------------------------ fit
     def _scale_fit(self, X: np.ndarray) -> np.ndarray:
@@ -136,6 +140,9 @@ class BinarySVC:
             eps=cfg.eps,
             tau=cfg.tau,
             max_iter=cfg.max_iter,
+            kernel=cfg.kernel,
+            degree=cfg.degree,
+            coef0=cfg.coef0,
             accum_dtype=resolve_accum_dtype(self.accum_dtype),
             **self.solver_opts,
         )
@@ -291,13 +298,15 @@ class BinarySVC:
             coef,
             jnp.asarray(self.b_, self.dtype),
         )
+        kern = dict(gamma=self.config.gamma, kernel=self.config.kernel,
+                    degree=self.config.degree, coef0=self.config.coef0)
         if mesh is not None:
             # the FLAT matmul: the blocked variant's reshape+scan destroys
             # row sharding (XLA all-gathers the test set onto every
             # device); flat partitions cleanly — see decision_function_flat
-            scores = _decision_flat(*args, gamma=self.config.gamma)
+            scores = _decision_flat(*args, **kern)
         else:
-            scores = _decision(*args, gamma=self.config.gamma)
+            scores = _decision(*args, **kern)
         return np.asarray(scores[:m])
 
     def predict(self, X: np.ndarray, mesh=None) -> np.ndarray:
@@ -308,6 +317,54 @@ class BinarySVC:
 
     def score(self, X: np.ndarray, Y: np.ndarray, mesh=None) -> float:
         return float((self.predict(X, mesh=mesh) == np.asarray(Y)).mean())
+
+    # ---------------------------------------------------------- calibration
+    def calibrate(self, X: np.ndarray, Y: np.ndarray, folds: int = 3,
+                  seed: int = 0) -> "BinarySVC":
+        """Fit Platt-scaled predict_proba on held-out fold scores.
+
+        Fits `folds` clones on stratified train splits (the same
+        deterministic tune/folds splits the CV search uses), pools their
+        OUT-OF-FOLD decision scores, and fits the Platt sigmoid on that
+        pool (tpusvm.kernels.platt — held-out scores are the calibration
+        discipline Platt 1999 prescribes; in-sample scores of bound SVs
+        would bias the sigmoid overconfident). The sigmoid then maps THIS
+        model's decision_function; call after (or before) fit, with the
+        same training rows.
+        """
+        from tpusvm.kernels.platt import fit_platt
+        from tpusvm.tune.folds import stratified_kfold
+
+        X = np.asarray(X)
+        Y = np.asarray(Y)
+        scores = np.empty(len(Y), np.float64)
+        for fold in stratified_kfold(Y, folds, seed=seed):
+            sub = BinarySVC(
+                config=self.config, dtype=self.dtype, scale=self.scale,
+                accum_dtype=self.accum_dtype, solver=self.solver,
+                solver_opts=self.solver_opts,
+            )
+            sub.fit(X[fold.train_idx], Y[fold.train_idx])
+            scores[fold.val_idx] = sub.decision_function(X[fold.val_idx])
+        self.platt_ = fit_platt(scores, Y)
+        return self
+
+    def predict_proba(self, X: np.ndarray, mesh=None) -> np.ndarray:
+        """(m, 2) class probabilities [P(y=-1), P(y=+1)], Platt-scaled.
+
+        Monotone in decision_function (the fitted A is negative on any
+        informative score set). Requires calibrate() first — an
+        uncalibrated model has no probability semantics to offer.
+        """
+        if self.platt_ is None:
+            raise RuntimeError(
+                "model is not calibrated; call calibrate(X, Y) (or train "
+                "with --calibrate) before predict_proba"
+            )
+        from tpusvm.kernels.platt import platt_proba
+
+        p = platt_proba(self.decision_function(X, mesh=mesh), *self.platt_)
+        return np.stack([1.0 - p, p], axis=1)
 
     @property
     def n_support_(self) -> int:
@@ -328,6 +385,8 @@ class BinarySVC:
         if self.scale:
             state["scaler_min"] = self.scaler_.min_val
             state["scaler_max"] = self.scaler_.max_val
+        if self.platt_ is not None:
+            state["platt_a"], state["platt_b"] = self.platt_
         save_model(path, state, self.config)
 
     @classmethod
@@ -343,5 +402,8 @@ class BinarySVC:
             model.scaler_ = MinMaxScaler(
                 min_val=state["scaler_min"], max_val=state["scaler_max"]
             )
+        if "platt_a" in state:
+            model.platt_ = (float(state["platt_a"]),
+                            float(state["platt_b"]))
         model.status_ = Status.CONVERGED
         return model

@@ -1,0 +1,205 @@
+"""Epsilon-SVR estimator: the regression task over the same solvers.
+
+Sibling of BinarySVC built on the variable-doubling reduction
+(tpusvm.kernels.svr): fit stacks [X; X] with labels [+1]*n + [-1]*n and
+pseudo-targets t -/+ epsilon, runs the UNCHANGED blocked (or pairwise)
+SMO solver on it via the `targets=` operand, and collapses the 2n betas
+to signed coefficients coef_i = alpha_i - alpha*_i. Prediction is then
+the same sum the classifiers score with —
+
+    y(x) = sum_i coef_i K(x, x_i) - b
+
+— so solver/predict.decision_function, serve's bucket executables, and
+the .npz layout are shared; an SVR state differs from a classifier state
+only in carrying `sv_coef` (signed) instead of (sv_Y, sv_alpha), plus a
+`task` marker for loader dispatch. The kernel family comes from
+config.kernel like everywhere else; epsilon (the tube half-width) from
+config.epsilon.
+"""
+
+from __future__ import annotations
+
+import time
+import warnings
+from typing import Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from tpusvm.config import SVMConfig, resolve_accum_dtype
+from tpusvm.data.scaler import MinMaxScaler
+from tpusvm.kernels.svr import collapse_duals, doubled_problem
+from tpusvm.models.serialization import load_model, save_model
+from tpusvm.solver.blocked import blocked_smo_solve
+from tpusvm.solver.predict import decision_function as _decision
+from tpusvm.solver.smo import smo_solve
+from tpusvm.status import Status
+
+
+class EpsilonSVR:
+    """Epsilon-insensitive support vector regression via doubled SMO.
+
+    Attributes after fit: sv_X_, sv_coef_ (signed alpha - alpha*),
+    sv_ids_, b_, n_iter_, status_, train_time_s_, scaler_.
+    """
+
+    def __init__(
+        self,
+        config: SVMConfig = SVMConfig(),
+        dtype=jnp.float32,
+        scale: bool = True,
+        accum_dtype="auto",
+        solver: str = "blocked",
+        solver_opts: Optional[dict] = None,
+    ):
+        if solver not in ("blocked", "pair"):
+            raise ValueError(f"unknown solver {solver!r}")
+        self.config = config
+        self.dtype = dtype
+        self.scale = scale
+        self.accum_dtype = accum_dtype
+        self.solver = solver
+        self.solver_opts = dict(solver_opts or {})
+        self.scaler_: Optional[MinMaxScaler] = None
+        self.sv_X_: Optional[np.ndarray] = None
+        self.sv_coef_: Optional[np.ndarray] = None
+        self.sv_ids_: Optional[np.ndarray] = None
+        self.b_: float = 0.0
+        self.b_high_: float = float("nan")
+        self.b_low_: float = float("nan")
+        self.n_iter_: int = 0
+        self.status_: Status = Status.RUNNING
+        self.train_time_s_: float = 0.0
+        self.convergence_: Optional[dict] = None
+
+    # ------------------------------------------------------------------ fit
+    def fit(self, X: np.ndarray, t: np.ndarray) -> "EpsilonSVR":
+        """Fit on features X and CONTINUOUS targets t (not labels)."""
+        t0 = time.perf_counter()
+        cfg = self.config
+        X = np.asarray(X)
+        t = np.asarray(t, np.float64)
+        n = len(t)
+        if self.scale:
+            self.scaler_ = MinMaxScaler().fit(X)
+            Xs = self.scaler_.transform(X)
+        else:
+            Xs = X
+        Y2, z = doubled_problem(t, cfg.epsilon)
+        solve = blocked_smo_solve if self.solver == "blocked" else smo_solve
+        res = solve(
+            jnp.concatenate([jnp.asarray(Xs, self.dtype)] * 2),
+            jnp.asarray(Y2),
+            targets=jnp.asarray(z),
+            C=cfg.C,
+            gamma=cfg.gamma,
+            eps=cfg.eps,
+            tau=cfg.tau,
+            max_iter=cfg.max_iter,
+            kernel=cfg.kernel,
+            degree=cfg.degree,
+            coef0=cfg.coef0,
+            accum_dtype=resolve_accum_dtype(self.accum_dtype),
+            **self.solver_opts,
+        )
+        beta = np.asarray(res.alpha)  # device->host copy = completion barrier
+        self.train_time_s_ = time.perf_counter() - t0
+        tele = getattr(res, "telemetry", None)
+        if tele is not None:
+            from tpusvm.obs.convergence import materialize
+
+            self.convergence_ = materialize(tele)
+        coef = collapse_duals(beta)
+        sv = np.nonzero(np.abs(coef) > cfg.sv_tol)[0]
+        self.sv_X_ = Xs[sv]
+        self.sv_coef_ = coef[sv]
+        self.sv_ids_ = sv.astype(np.int32)
+        self.b_ = float(res.b)
+        self.b_high_ = float(res.b_high)
+        self.b_low_ = float(res.b_low)
+        self.n_iter_ = int(res.n_iter)
+        self.status_ = Status(int(res.status))
+        if self.status_ != Status.CONVERGED:
+            warnings.warn(
+                f"SVR SMO terminated with {self.status_.name} after "
+                f"{self.n_iter_} iterations; the model may be partially "
+                "optimised",
+                RuntimeWarning,
+                stacklevel=2,
+            )
+        return self
+
+    # -------------------------------------------------------------- predict
+    def _check_fitted(self):
+        if self.sv_X_ is None:
+            raise RuntimeError("model is not fitted")
+
+    def predict(self, X: np.ndarray) -> np.ndarray:
+        """Regressed values y(x) = sum_i coef_i K(x, x_i) - b. Shape (m,)."""
+        self._check_fitted()
+        Xs = (self.scaler_.transform(np.asarray(X)) if self.scale
+              else np.asarray(X))
+        cfg = self.config
+        scores = _decision(
+            jnp.asarray(Xs, self.dtype),
+            jnp.asarray(self.sv_X_, self.dtype),
+            jnp.asarray(self.sv_coef_, self.dtype),
+            jnp.asarray(self.b_, self.dtype),
+            gamma=cfg.gamma, kernel=cfg.kernel, degree=cfg.degree,
+            coef0=cfg.coef0,
+        )
+        return np.asarray(scores)
+
+    # decision_function aliases predict: serve/tests treat "the scored
+    # value" uniformly across tasks (for SVR the score IS the prediction)
+    decision_function = predict
+
+    def score(self, X: np.ndarray, t: np.ndarray) -> float:
+        """Coefficient of determination R^2 (1 = perfect regression)."""
+        t = np.asarray(t, np.float64)
+        resid = t - self.predict(X)
+        ss_tot = float(((t - t.mean()) ** 2).sum())
+        if ss_tot == 0.0:
+            return 1.0 if float((resid ** 2).sum()) == 0.0 else 0.0
+        return 1.0 - float((resid ** 2).sum()) / ss_tot
+
+    @property
+    def n_support_(self) -> int:
+        self._check_fitted()
+        return len(self.sv_coef_)
+
+    # ---------------------------------------------------------- persistence
+    def save(self, path: str) -> None:
+        self._check_fitted()
+        state = {
+            "task": "svr",
+            "sv_X": self.sv_X_,
+            "sv_coef": self.sv_coef_,
+            "sv_ids": self.sv_ids_,
+            "b": self.b_,
+            "scale": self.scale,
+        }
+        if self.scale:
+            state["scaler_min"] = self.scaler_.min_val
+            state["scaler_max"] = self.scaler_.max_val
+        save_model(path, state, self.config)
+
+    @classmethod
+    def load(cls, path: str, dtype=jnp.float32) -> "EpsilonSVR":
+        state, config = load_model(path)
+        if "sv_coef" not in state:
+            raise ValueError(
+                f"{path!r} is not an EpsilonSVR artifact (no sv_coef "
+                "state); load it with BinarySVC/OneVsRestSVC"
+            )
+        model = cls(config=config, dtype=dtype, scale=bool(state["scale"]))
+        model.sv_X_ = state["sv_X"]
+        model.sv_coef_ = state["sv_coef"]
+        model.sv_ids_ = state["sv_ids"]
+        model.b_ = float(state["b"])
+        if model.scale:
+            model.scaler_ = MinMaxScaler(
+                min_val=state["scaler_min"], max_val=state["scaler_max"]
+            )
+        model.status_ = Status.CONVERGED
+        return model

@@ -1,3 +1,11 @@
-from tpusvm.oracle.smo import OracleResult, get_sv_indices, predict, smo_train
+from tpusvm.oracle.smo import (
+    OracleResult,
+    get_sv_indices,
+    kernel_row,
+    predict,
+    smo_train,
+    svr_train,
+)
 
-__all__ = ["OracleResult", "smo_train", "get_sv_indices", "predict"]
+__all__ = ["OracleResult", "smo_train", "svr_train", "get_sv_indices",
+           "kernel_row", "predict"]

@@ -49,6 +49,20 @@ def rbf_row(X: np.ndarray, x: np.ndarray, gamma: float) -> np.ndarray:
     return np.exp(-gamma * np.einsum("ij,ij->i", diff, diff))
 
 
+def kernel_row(X: np.ndarray, x: np.ndarray, config: SVMConfig) -> np.ndarray:
+    """K(x, X[j]) for all j under the config's kernel family.
+
+    The oracle's single kernel touchpoint, mirroring tpusvm.kernels:
+    "rbf" keeps the reference's per-pair formulation byte-for-byte;
+    "linear"/"poly" are the dot forms in f64.
+    """
+    if config.kernel == "linear":
+        return X @ x
+    if config.kernel == "poly":
+        return (config.gamma * (X @ x) + config.coef0) ** config.degree
+    return rbf_row(X, x, config.gamma)
+
+
 def _masked_argmin(f: np.ndarray, mask: np.ndarray) -> int:
     """First index of the minimum of f over mask; -1 if mask empty.
 
@@ -74,21 +88,28 @@ def smo_train(
     config: SVMConfig = SVMConfig(),
     alpha0: Optional[np.ndarray] = None,
     warm_start: bool = False,
+    targets: Optional[np.ndarray] = None,
 ) -> OracleResult:
-    """Train a binary RBF SVM with serial SMO. Returns (alpha, b, ...).
+    """Train a binary SVM with serial SMO. Returns (alpha, b, ...).
 
     Args:
       X: (n, d) float64 scaled features.
       Y: (n,) labels in {+1, -1}.
-      config: hyperparameters (defaults = reference constants).
+      config: hyperparameters (defaults = reference constants); the kernel
+        family/params come from config.kernel/degree/coef0 (kernel_row).
       alpha0: initial dual variables; zeros if None.
       warm_start: if True, reconstruct f from alpha0 (cascade semantics,
         mpi_svm_main3.cpp:156-186); if False alpha0 must be zeros and f = -y.
+      targets: optional pseudo-target vector z replacing the labels in
+        f_i = sum_j a_j y_j K_ij - z_i (the epsilon-SVR doubling,
+        tpusvm.kernels.svr; None = z = Y, classification).
     """
     X = np.asarray(X, np.float64)
     Y = np.asarray(Y)
     n = len(Y)
-    C, gamma, eps, tau = config.C, config.gamma, config.eps, config.tau
+    C, eps, tau = config.C, config.eps, config.tau
+    z = (Y.astype(np.float64) if targets is None
+         else np.asarray(targets, np.float64))
 
     if alpha0 is None:
         alpha = np.zeros(n, np.float64)
@@ -96,7 +117,7 @@ def smo_train(
         alpha = np.array(alpha0, np.float64, copy=True)
 
     if warm_start:
-        # f_i = sum_j alpha_j y_j K(x_j, x_i) - y_i; only alpha != 0 contribute
+        # f_i = sum_j alpha_j y_j K(x_j, x_i) - z_i; only alpha != 0 contribute
         # (mpi_svm_main3.cpp:160-186 skips alpha_j == 0 as an optimisation —
         # algebraically identical to the full sum).
         f = np.empty(n, np.float64)
@@ -104,12 +125,12 @@ def smo_train(
         coef = alpha[nz] * Y[nz]
         for i in range(n):
             if len(nz):
-                k = rbf_row(X[nz], X[i], gamma)
-                f[i] = float(coef @ k) - float(Y[i])
+                k = kernel_row(X[nz], X[i], config)
+                f[i] = float(coef @ k) - float(z[i])
             else:
-                f[i] = -float(Y[i])
+                f[i] = -float(z[i])
     else:
-        f = -Y.astype(np.float64)
+        f = -z.copy()
 
     pos = Y == 1
     i_high_prev = -1
@@ -137,10 +158,10 @@ def smo_train(
 
         if i_high != i_high_prev:
             i_high_prev = i_high
-            k_high = rbf_row(X, X[i_high], gamma)
+            k_high = kernel_row(X, X[i_high], config)
         if i_low != i_low_prev:
             i_low_prev = i_low
-            k_low = rbf_row(X, X[i_low], gamma)
+            k_low = kernel_row(X, X[i_low], config)
 
         s = int(Y[i_high]) * int(Y[i_low])
         K11 = k_high[i_high]
@@ -184,6 +205,27 @@ def smo_train(
 
     b = (b_high + b_low) / 2.0
     return OracleResult(alpha, b, b_high, b_low, n_iter, status)
+
+
+def svr_train(
+    X: np.ndarray,
+    t: np.ndarray,
+    config: SVMConfig = SVMConfig(),
+) -> OracleResult:
+    """Serial epsilon-SVR oracle: the 2n-variable doubling through smo_train.
+
+    Builds the doubled problem (tpusvm.kernels.svr.doubled_problem: labels
+    [+1]*n + [-1]*n, pseudo-targets t -/+ config.epsilon) over [X; X] and
+    runs the UNCHANGED classification SMO skeleton on it. The returned
+    alpha is the raw 2n beta vector; collapse with
+    kernels.svr.collapse_duals for the signed prediction coefficients
+    alpha_i - alpha*_i.
+    """
+    from tpusvm.kernels.svr import doubled_problem
+
+    X = np.asarray(X, np.float64)
+    Y2, z = doubled_problem(t, config.epsilon)
+    return smo_train(np.concatenate([X, X]), Y2, config, targets=z)
 
 
 def get_sv_indices(alpha: np.ndarray, tol: float = 1e-8) -> np.ndarray:

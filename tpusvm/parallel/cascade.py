@@ -236,6 +236,9 @@ def _solve(train: SVBuffer, cfg: SVMConfig, accum_dtype=None,
         eps=cfg.eps,
         tau=cfg.tau,
         max_iter=cfg.max_iter,
+        kernel=cfg.kernel,
+        degree=cfg.degree,
+        coef0=cfg.coef0,
         warm_start=True,
         accum_dtype=accum_dtype,
         **(solver_opts or {}),

@@ -61,7 +61,9 @@ def bucket_for(m: int, buckets: Sequence[int]) -> int:
 #     every power-of-two bucket >= 4 is mutually identical and matches
 #     direct multiple-of-4-row calls bitwise.
 # The padding cost is one or three zero rows on an idle server — noise.
-_MIN_BUCKET = {"binary": 2, "ovr": 4}
+# svr shares the binary scorer program (same matvec shape, the score IS
+# the regressed value), so it inherits the binary floor.
+_MIN_BUCKET = {"binary": 2, "ovr": 4, "svr": 2}
 
 
 class CompileCache:
@@ -84,19 +86,26 @@ class CompileCache:
     # ------------------------------------------------------------ compile
     def _build(self, bucket: int):
         e = self.entry
+        cfg = e.config
         Xz = jnp.zeros((bucket, e.n_features), e.dtype)
-        if e.kind == "binary":
+        if e.kind in ("binary", "svr"):
             # block capped at the bucket: decision_function pads m up to a
             # block multiple internally, so block=2048 would make a 1-row
             # bucket compute 2048 rows of kernel (measured 7x throughput
             # loss); any block yields bit-identical per-row scores
-            # (tests/test_predict.py), so the cap is free
+            # (tests/test_predict.py), so the cap is free. The kernel
+            # family/params come from the model's config — one executable
+            # per (model, bucket) regardless of family
             lowered = decision_function.lower(
-                Xz, e.X_sv, e.coef, e.b, gamma=e.config.gamma,
-                block=min(self.block, bucket))
+                Xz, e.X_sv, e.coef, e.b, gamma=cfg.gamma,
+                block=min(self.block, bucket), kernel=cfg.kernel,
+                degree=cfg.degree, coef0=cfg.coef0)
         else:
-            gamma = jnp.asarray(e.config.gamma, e.dtype)
-            lowered = _ovr_scores.lower(Xz, e.X_sv, e.coef, e.b, gamma)
+            gamma = jnp.asarray(cfg.gamma, e.dtype)
+            coef0 = jnp.asarray(cfg.coef0, e.dtype)
+            lowered = _ovr_scores.lower(Xz, e.X_sv, e.coef, e.b, gamma,
+                                        coef0, kernel=cfg.kernel,
+                                        degree=cfg.degree)
         return lowered.compile()
 
     def _get(self, bucket: int):
@@ -142,9 +151,10 @@ class CompileCache:
         Xp = np.zeros((bucket, X.shape[1]), np.dtype(jnp.dtype(e.dtype)))
         Xp[:m] = X
         fn = self._get(bucket)
-        if e.kind == "binary":
+        if e.kind in ("binary", "svr"):
             out = fn(jnp.asarray(Xp), e.X_sv, e.coef, e.b)
         else:
             gamma = jnp.asarray(e.config.gamma, e.dtype)
-            out = fn(jnp.asarray(Xp), e.X_sv, e.coef, e.b, gamma)
+            coef0 = jnp.asarray(e.config.coef0, e.dtype)
+            out = fn(jnp.asarray(Xp), e.X_sv, e.coef, e.b, gamma, coef0)
         return np.asarray(out)[:m], bucket

@@ -14,6 +14,12 @@ Routes:
   POST /v1/models/<name>:predict  {"instances": [[...], ...]}
                                   -> {"predictions": [...], "scores": [...],
                                       "statuses": [...]}
+                                  Calibrated binary models add
+                                  "proba": [P(y=+1), ...] (Platt-scaled
+                                  host-side from the served scores — the
+                                  exact predict_proba arithmetic); SVR
+                                  models serve the regressed value as the
+                                  prediction.
 """
 
 from __future__ import annotations
@@ -91,18 +97,31 @@ class _Handler(BaseHTTPRequestHandler):
             return
         statuses = [ServeStatus(r.status).name for r in results]
         ok = all(r.ok for r in results)
+        body = {
+            "predictions": [
+                None if r.label is None else np.asarray(r.label).item()
+                for r in results
+            ],
+            "scores": [
+                None if r.scores is None else np.asarray(r.scores).tolist()
+                for r in results
+            ],
+            "statuses": statuses,
+        }
+        entry = self._srv.registry.get(name)
+        if entry.platt is not None and entry.kind == "binary":
+            # calibrated model: Platt-scale the served scores host-side —
+            # the exact predict_proba arithmetic (kernels.platt), so the
+            # field is bit-identical to the offline estimator's P(y=+1)
+            from tpusvm.kernels.platt import platt_proba
+
+            body["proba"] = [
+                None if r.scores is None
+                else float(platt_proba(np.asarray(r.scores), *entry.platt))
+                for r in results
+            ]
         self._send_json(
-            {
-                "predictions": [
-                    None if r.label is None else np.asarray(r.label).item()
-                    for r in results
-                ],
-                "scores": [
-                    None if r.scores is None else np.asarray(r.scores).tolist()
-                    for r in results
-                ],
-                "statuses": statuses,
-            },
+            body,
             # load-induced rejections map to 503 (retryable), per-request
             # detail stays in `statuses`
             code=200 if ok else 503,

@@ -24,7 +24,6 @@ import numpy as np
 
 from tpusvm.config import SVMConfig
 from tpusvm.data.scaler import MinMaxScaler
-from tpusvm.models.serialization import is_multiclass_model
 
 
 @dataclasses.dataclass
@@ -32,15 +31,20 @@ class ModelEntry:
     """One servable model: pinned device arrays + host-side scaler."""
 
     name: str
-    kind: str                      # "binary" | "ovr"
+    kind: str                      # "binary" | "ovr" | "svr"
     config: SVMConfig
     n_features: int
     X_sv: jax.Array                # (n_sv, d), device-resident
-    coef: jax.Array                # binary: (n_sv,) alpha*y; ovr: (K, n_sv)
-    b: jax.Array                   # binary: scalar; ovr: (K,)
+    coef: jax.Array                # binary: (n_sv,) alpha*y; ovr: (K, n_sv);
+    #                                svr: (n_sv,) signed alpha - alpha*
+    b: jax.Array                   # binary/svr: scalar; ovr: (K,)
     scaler: Optional[MinMaxScaler]
     classes: Optional[np.ndarray]  # ovr only
     dtype: object = jnp.float32
+    # Platt sigmoid (A, B) of a calibrated binary classifier; the HTTP
+    # frontend then adds a `proba` field computed host-side from the
+    # served scores — the exact predict_proba arithmetic
+    platt: Optional[tuple] = None
 
     @property
     def n_sv(self) -> int:
@@ -51,8 +55,17 @@ class ModelEntry:
 
     @classmethod
     def from_estimator(cls, name: str, model) -> "ModelEntry":
-        """Pin an already-fitted BinarySVC / OneVsRestSVC."""
-        # OneVsRestSVC carries classes_/X_sv_/coef_; BinarySVC sv_X_/sv_alpha_
+        """Pin an already-fitted BinarySVC / OneVsRestSVC / EpsilonSVR.
+
+        The kernel family and its parameters travel in model.config — the
+        bucket compile cache builds its executables from exactly that
+        config, so every family serves through the same machinery. SVR
+        models pin their signed sv_coef_ directly (the score IS the
+        regressed value); calibrated classifiers carry their Platt
+        coefficients for the frontend's proba field.
+        """
+        # OneVsRestSVC carries classes_/X_sv_/coef_; EpsilonSVR sv_coef_;
+        # BinarySVC sv_X_/sv_alpha_
         if getattr(model, "classes_", None) is not None:
             if model.X_sv_ is None:
                 raise RuntimeError("model is not fitted")
@@ -68,6 +81,17 @@ class ModelEntry:
             )
         if model.sv_X_ is None:
             raise RuntimeError("model is not fitted")
+        if getattr(model, "sv_coef_", None) is not None:
+            return cls(
+                name=name, kind="svr", config=model.config,
+                n_features=int(model.sv_X_.shape[1]),
+                X_sv=jnp.asarray(model.sv_X_, model.dtype),
+                coef=jnp.asarray(model.sv_coef_, model.dtype),
+                b=jnp.asarray(model.b_, model.dtype),
+                scaler=model.scaler_ if model.scale else None,
+                classes=None,
+                dtype=model.dtype,
+            )
         coef = np.asarray(model.sv_alpha_) * np.asarray(model.sv_Y_)
         return cls(
             name=name, kind="binary", config=model.config,
@@ -78,18 +102,15 @@ class ModelEntry:
             scaler=model.scaler_ if model.scale else None,
             classes=None,
             dtype=model.dtype,
+            platt=getattr(model, "platt_", None),
         )
 
     @classmethod
     def from_path(cls, name: str, path: str, dtype=jnp.float32) -> "ModelEntry":
-        """Load a serialized model (binary/OVR auto-detected) and pin it."""
-        from tpusvm.models import BinarySVC, OneVsRestSVC
+        """Load a serialized model (binary/OVR/SVR auto-detected), pin it."""
+        from tpusvm.models import load_any
 
-        if is_multiclass_model(path):
-            model = OneVsRestSVC.load(path, dtype=dtype)
-        else:
-            model = BinarySVC.load(path, dtype=dtype)
-        return cls.from_estimator(name, model)
+        return cls.from_estimator(name, load_any(path, dtype=dtype))
 
     def validate_rows(self, X: np.ndarray) -> np.ndarray:
         # float64 on the host regardless of the model dtype: the scaler
@@ -115,10 +136,17 @@ class ModelEntry:
             "kind": self.kind,
             "n_sv": self.n_sv,
             "n_features": self.n_features,
+            "kernel": self.config.kernel,
             "gamma": self.config.gamma,
             "C": self.config.C,
             "scaled": self.scaler is not None,
+            "calibrated": self.platt is not None,
         }
+        if self.config.kernel == "poly":
+            d["degree"] = self.config.degree
+            d["coef0"] = self.config.coef0
+        if self.kind == "svr":
+            d["epsilon"] = self.config.epsilon
         if self.classes is not None:
             d["classes"] = [int(c) for c in self.classes]
         return d

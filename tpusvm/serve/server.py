@@ -81,8 +81,10 @@ class _ModelWorker:
         path — the batcher caps at max_batch) are chunked through it."""
         e = self.entry
         if X.shape[0] == 0:
-            shape = (0,) if e.kind == "binary" else (0, len(e.classes))
-            return np.zeros(shape), np.zeros(0, np.int32), []
+            shape = (0, len(e.classes)) if e.kind == "ovr" else (0,)
+            empty_labels = (np.zeros(0) if e.kind == "svr"
+                            else np.zeros(0, np.int32))
+            return np.zeros(shape), empty_labels, []
         Xs = e.scale(X)
         top = self.cache.buckets[-1]
         parts, chunks = [], []
@@ -94,6 +96,9 @@ class _ModelWorker:
         scores = np.concatenate(parts) if len(parts) > 1 else parts[0]
         if e.kind == "binary":
             labels = np.where(scores > 0, 1, -1).astype(np.int32)
+        elif e.kind == "svr":
+            # regression: the score IS the prediction — serve the value
+            labels = scores
         else:
             labels = e.classes[np.argmax(scores, axis=1)]
         return scores, labels, chunks

@@ -43,9 +43,10 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
+from tpusvm import kernels
 from tpusvm.config import pallas_flag_errors
 from tpusvm.obs.convergence import ConvergenceTelemetry
-from tpusvm.ops.rbf import rbf_cross, rbf_cross_matvec, rbf_matvec, sq_norms
+from tpusvm.ops.rbf import sq_norms
 from tpusvm.ops.selection import i_high_mask, i_low_mask
 from tpusvm.solver.analytic import pair_update
 from tpusvm.solver.smo import SMOResult
@@ -326,7 +327,8 @@ def _inner_smo(K_BB, y_B, a_B, f_B, active_B, C, eps, tau, max_inner,
                      "accum_dtype", "inner", "refine", "max_refines", "wss",
                      "matmul_precision", "selection", "fused_fupdate",
                      "pallas_layout", "pallas_eta_exclude",
-                     "pallas_multipair", "telemetry"),
+                     "pallas_multipair", "telemetry", "kernel", "degree",
+                     "kernel_fast"),
 )
 def blocked_smo_solve(
     X: jax.Array,
@@ -356,6 +358,11 @@ def blocked_smo_solve(
     pallas_eta_exclude: bool = False,
     pallas_multipair: int = 1,
     telemetry: int = 0,
+    kernel: str = "rbf",
+    degree: int = 3,
+    coef0: float = 0.0,
+    kernel_fast: bool = True,
+    targets: Optional[jax.Array] = None,
 ) -> SMOResult:
     """Train to the reference's stopping criterion with blocked working sets.
 
@@ -491,6 +498,28 @@ def blocked_smo_solve(
     the rebuild is skipped and the claim is accepted on the drifted f —
     in fast mode size the cap generously above the expected SV count.
 
+    kernel/degree/coef0 (kernel and degree static): kernel family and its
+    parameters (tpusvm.kernels). "rbf" (the default) runs the pre-refactor
+    code path byte-for-byte — K_BB, the f-update contraction, warm starts
+    and refine reconstructions all route through the same ops/rbf.py calls
+    with the same arguments. "linear"/"poly" swap in their dot-form
+    computations; sn is then ignored (no row norms exist for them) and
+    fused_fupdate='auto' resolves to False (the fused kernel implements
+    the RBF distance pipeline only; explicit True raises).
+
+    kernel_fast (static, kernel="linear" only): True (default) routes the
+    O(n*d*q) error-vector contraction and refine reconstructions through
+    the primal form X @ (X_B^T coef) — a (d,) weight delta instead of a
+    (block, q) kernel slab, the linear family's dedicated fast path.
+    False keeps the generic blocked K-row path (the benchmark control
+    arm, benchmarks/kernel_matrix.py). Ignored by rbf/poly.
+
+    targets: optional (n,) pseudo-target vector z replacing the labels in
+    the error vector f_i = sum_j a_j y_j K_ij - z_i (None = z = Y, the
+    classification problem); the epsilon-SVR doubling
+    (tpusvm.kernels.svr) is the intended caller. Selection, the stopping
+    rule, and the analytic update are unchanged.
+
     telemetry (static): 0 (default) = off. T > 0 = carry a T-slot
     convergence ring through the outer loop: every outer-loop body
     execution writes its Keerthi gap (b_low - b_high; NaN when no
@@ -550,13 +579,26 @@ def blocked_smo_solve(
     })
     if flag_errors:
         raise ValueError("; ".join(flag_errors))
-    # fused=True + bf16 matmuls is rejected INSIDE resolve_fused_fupdate
-    # (single source of truth; the fused contraction runs at the full-f32
-    # trust-anchor tier and cannot honour matmul_precision='default')
-    fused_fupdate = resolve_fused_fupdate(
-        n, X.shape[1], q=q, fused=fused_fupdate,
-        matmul_precision=matmul_precision,
-    )
+    kernels.validate_family(kernel)
+    if kernel != "rbf":
+        # the fused Pallas contraction implements the RBF distance+exp
+        # pipeline only; an explicit request for it with another family is
+        # a config lie, 'auto' just resolves to the generic path
+        if fused_fupdate is True:
+            raise ValueError(
+                f"fused_fupdate=True implements the RBF pipeline only; "
+                f"kernel={kernel!r} uses its own contraction "
+                "(use fused_fupdate='auto')"
+            )
+        fused_fupdate = False
+    else:
+        # fused=True + bf16 matmuls is rejected INSIDE resolve_fused_fupdate
+        # (single source of truth; the fused contraction runs at the full-f32
+        # trust-anchor tier and cannot honour matmul_precision='default')
+        fused_fupdate = resolve_fused_fupdate(
+            n, X.shape[1], q=q, fused=fused_fupdate,
+            matmul_precision=matmul_precision,
+        )
     if matmul_precision == "default" and (refine <= 0 or max_refines < 1):
         raise ValueError(
             "matmul_precision='default' (raw bf16 MXU passes) accumulates "
@@ -578,16 +620,25 @@ def blocked_smo_solve(
     alpha0 = jnp.where(valid, alpha0, 0.0).astype(adt)
 
     yf = Y.astype(adt)
+    z = yf if targets is None else jnp.asarray(targets).astype(adt)
     if warm_start:
-        f0 = rbf_matvec(X, (alpha0 * yf).astype(dtype), gamma).astype(adt) - yf
+        f0 = kernels.matvec(
+            kernel, X, (alpha0 * yf).astype(dtype), gamma=gamma,
+            coef0=coef0, degree=degree,
+        ).astype(adt) - z
     else:
-        f0 = -yf
+        f0 = -z
     f0 = jnp.where(valid, f0, 0.0)
 
     # hoisted out of the outer loop: one X stream per solve, not per round
-    # (or zero, when the caller supplied its fold-level cache)
-    if sn is None:
-        sn = sq_norms(X)
+    # (or zero, when the caller supplied its fold-level cache). Only the
+    # RBF family has row norms; others carry sn=None (a cache passed by a
+    # kernel-agnostic caller like tune is simply unused).
+    if kernels.needs_norms(kernel):
+        if sn is None:
+            sn = sq_norms(X)
+    else:
+        sn = None
 
     refine_cap = min(refine, n) if refine > 0 else 0
 
@@ -656,7 +707,8 @@ def blocked_smo_solve(
             active_B = valid[B] & is_first & (i_high_mask(a_B, y_B, C, eps)
                                               | i_low_mask(a_B, y_B, C, eps))
 
-            K_BB = rbf_cross(X_B, X_B, gamma)
+            K_BB = kernels.cross(kernel, X_B, X_B, gamma=gamma,
+                                 coef0=coef0, degree=degree)
             if inner == "pallas":
                 from tpusvm.ops.pallas.inner_smo import inner_smo_pallas
 
@@ -718,8 +770,11 @@ def blocked_smo_solve(
                     interpret=jax.default_backend() != "tpu",
                 ).astype(adt)
             else:
-                df = rbf_cross_matvec(X, X_B, dcoef, gamma, sn,
-                                      precision=matmul_precision).astype(adt)
+                df = kernels.cross_matvec(
+                    kernel, X, X_B, dcoef, gamma=gamma, coef0=coef0,
+                    degree=degree, sn=sn, precision=matmul_precision,
+                    fast=kernel_fast,
+                ).astype(adt)
             # .add, not .set: inactive duplicate rows carry a zero delta, so
             # double-indexed scatter stays correct
             return (alpha.at[B].add(da_B), f + df, upd, progress,
@@ -736,9 +791,10 @@ def blocked_smo_solve(
             # largest-|coef| rows cover all nonzeros (needs_refine already
             # checked the live count fits refine_cap)
             _, idx = lax.top_k(jnp.abs(coef).astype(jnp.float32), refine_cap)
-            f_new = rbf_cross_matvec(
-                X, X[idx], coef[idx].astype(dtype), gamma, sn
-            ).astype(adt) - yf
+            f_new = kernels.cross_matvec(
+                kernel, X, X[idx], coef[idx].astype(dtype), gamma=gamma,
+                coef0=coef0, degree=degree, sn=sn, fast=kernel_fast,
+            ).astype(adt) - z
             return (alpha, jnp.where(valid, f_new, 0.0), jnp.int32(0),
                     jnp.array(False), jnp.int32(Status.RUNNING))
 

@@ -19,10 +19,14 @@ import functools
 import jax
 import jax.numpy as jnp
 
-from tpusvm.ops.rbf import rbf_cross, sq_norms
+from tpusvm import kernels
+from tpusvm.ops.rbf import sq_norms
 
 
-@functools.partial(jax.jit, static_argnames=("gamma", "block"))
+@functools.partial(
+    jax.jit,
+    static_argnames=("gamma", "block", "kernel", "degree", "coef0"),
+)
 def decision_function(
     X_test: jax.Array,
     X_train: jax.Array,
@@ -31,23 +35,36 @@ def decision_function(
     *,
     gamma: float,
     block: int = 2048,
+    kernel: str = "rbf",
+    degree: int = 3,
+    coef0: float = 0.0,
 ) -> jax.Array:
-    """f(x) = sum_j coef_j K(x, x_j) - b for each test row. Shape (m,)."""
+    """f(x) = sum_j coef_j K(x, x_j) - b for each test row. Shape (m,).
+
+    Serves every (kernel, task) cell: classification scores AND epsilon-SVR
+    regressed values are the same sum (tpusvm.kernels.svr), so serve's
+    bucket executables and the streamed scorer need no second code path.
+    All kernel parameters are static here (they come from a fitted model's
+    config — one executable per model, the serving contract).
+    """
     m, d = X_test.shape
     nb = -(-m // block)
     pad = nb * block - m
     Xp = jnp.pad(X_test, ((0, pad), (0, 0)))
-    sn_train = sq_norms(X_train)
+    sn_train = (sq_norms(X_train) if kernels.needs_norms(kernel) else None)
 
     def step(_, Xb):
-        K = rbf_cross(Xb, X_train, gamma, snB=sn_train)
+        K = kernels.cross(kernel, Xb, X_train, gamma=gamma, coef0=coef0,
+                          degree=degree, snB=sn_train)
         return None, K @ coef
 
     _, scores = jax.lax.scan(step, None, Xp.reshape(nb, block, d))
     return scores.reshape(-1)[:m] - b
 
 
-@functools.partial(jax.jit, static_argnames=("gamma",))
+@functools.partial(
+    jax.jit, static_argnames=("gamma", "kernel", "degree", "coef0")
+)
 def decision_function_flat(
     X_test: jax.Array,
     X_train: jax.Array,
@@ -55,6 +72,9 @@ def decision_function_flat(
     b,
     *,
     gamma: float,
+    kernel: str = "rbf",
+    degree: int = 3,
+    coef0: float = 0.0,
 ) -> jax.Array:
     """Unblocked variant of decision_function: one flat matmul.
 
@@ -68,7 +88,9 @@ def decision_function_flat(
     is for. Single-device callers should prefer the blocked variant,
     which bounds the slab at (block, n_train).
     """
-    K = rbf_cross(X_test, X_train, gamma, snB=sq_norms(X_train))
+    snB = sq_norms(X_train) if kernels.needs_norms(kernel) else None
+    K = kernels.cross(kernel, X_test, X_train, gamma=gamma, coef0=coef0,
+                      degree=degree, snB=snB)
     return K @ coef - b
 
 
@@ -82,6 +104,9 @@ def predict(
     gamma: float,
     sv_tol: float = 1e-8,
     block: int = 2048,
+    kernel: str = "rbf",
+    degree: int = 3,
+    coef0: float = 0.0,
 ) -> jax.Array:
     """Labels in {+1,-1}; strict >0 -> +1 (main3.cpp:399).
 
@@ -91,5 +116,7 @@ def predict(
     """
     a = jnp.where(alpha > sv_tol, alpha, 0.0)
     coef = a * Y_train.astype(X_train.dtype)
-    scores = decision_function(X_test, X_train, coef, b, gamma=gamma, block=block)
+    scores = decision_function(X_test, X_train, coef, b, gamma=gamma,
+                               block=block, kernel=kernel, degree=degree,
+                               coef0=coef0)
     return jnp.where(scores > 0, 1, -1).astype(jnp.int32)

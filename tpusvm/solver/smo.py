@@ -35,8 +35,9 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from tpusvm import kernels
 from tpusvm.config import SVMConfig
-from tpusvm.ops.rbf import rbf_matvec, rbf_rows_at, sq_norms
+from tpusvm.ops.rbf import sq_norms
 from tpusvm.solver.analytic import pair_update
 from tpusvm.ops.selection import (
     i_high_mask,
@@ -80,7 +81,8 @@ class SMOResult(NamedTuple):
     telemetry: Optional[Any] = None
 
 
-def _body(state: SMOState, X, Y, valid, sn, C, gamma, eps, tau, max_iter):
+def _body(state: SMOState, X, Y, valid, sn, C, gamma, eps, tau, max_iter,
+          kernel, degree, coef0):
     alpha, f = state.alpha, state.f
     n = Y.shape[0]
 
@@ -105,7 +107,9 @@ def _body(state: SMOState, X, Y, valid, sn, C, gamma, eps, tau, max_iter):
         # One fused pass computes both rows; lax.cond skips it entirely when
         # neither index changed (both-cached iterations are common: the pair
         # often repeats while alpha walks along the box boundary).
-        rows = rbf_rows_at(X, jnp.stack([i_high, i_low]), gamma, sn)
+        rows = kernels.rows_at(kernel, X, jnp.stack([i_high, i_low]),
+                               gamma=gamma, coef0=coef0, degree=degree,
+                               sn=sn)
         kh = jnp.where(need_h, rows[0], state.k_high)
         kl = jnp.where(need_l, rows[1], state.k_low)
         return kh, kl
@@ -182,10 +186,13 @@ def _body(state: SMOState, X, Y, valid, sn, C, gamma, eps, tau, max_iter):
     )
 
 
-# Only max_iter/warm_start/accum_dtype are static: the float hyperparameters
-# are traced scalars so a C/gamma grid search reuses one compiled solver.
+# Only max_iter/warm_start/accum_dtype/kernel/degree are static: the float
+# hyperparameters are traced scalars so a C/gamma (or coef0) grid search
+# reuses one compiled solver per (kernel, degree) family.
 @functools.partial(
-    jax.jit, static_argnames=("max_iter", "warm_start", "accum_dtype")
+    jax.jit,
+    static_argnames=("max_iter", "warm_start", "accum_dtype", "kernel",
+                     "degree"),
 )
 def smo_solve(
     X: jax.Array,
@@ -200,6 +207,10 @@ def smo_solve(
     max_iter: int = 100000,
     warm_start: bool = False,
     accum_dtype=None,
+    kernel: str = "rbf",
+    degree: int = 3,
+    coef0: float = 0.0,
+    targets: Optional[jax.Array] = None,
 ) -> SMOResult:
     """Run SMO to termination entirely on device.
 
@@ -213,9 +224,18 @@ def smo_solve(
         jnp.float64 with float32 X for the mixed-precision mode: kernel rows
         stay f32 (full HBM-bandwidth win) while the O(n) accumulators match
         the f64 reference's ability to resolve tiny near-convergence updates.
+      kernel/degree/coef0: kernel family and its parameters
+        (tpusvm.kernels); family and degree are static, gamma/coef0 traced.
+        "rbf" (the default) runs the pre-refactor code path byte-for-byte.
+      targets: optional (n,) pseudo-target vector z replacing the labels in
+        the error vector f_i = sum_j a_j y_j K_ij - z_i (None = z = Y, the
+        classification problem). The epsilon-SVR doubling
+        (tpusvm.kernels.svr) is the intended caller; everything else —
+        selection, stopping rule, analytic update — is unchanged.
 
     Returns SMOResult; `alpha` of padded rows is guaranteed 0.
     """
+    kernels.validate_family(kernel)
     n = Y.shape[0]
     dtype = X.dtype
     adt = dtype if accum_dtype is None else accum_dtype
@@ -226,10 +246,14 @@ def smo_solve(
     alpha0 = jnp.where(valid, alpha0, 0.0).astype(adt)
 
     yf = Y.astype(adt)
+    z = yf if targets is None else jnp.asarray(targets).astype(adt)
     if warm_start:
-        f0 = rbf_matvec(X, (alpha0 * yf).astype(dtype), gamma).astype(adt) - yf
+        f0 = kernels.matvec(
+            kernel, X, (alpha0 * yf).astype(dtype), gamma=gamma,
+            coef0=coef0, degree=degree,
+        ).astype(adt) - z
     else:
-        f0 = -yf
+        f0 = -z
     # Padded rows never enter the index sets; park their f at 0 for tidiness.
     f0 = jnp.where(valid, f0, 0.0)
 
@@ -247,11 +271,13 @@ def smo_solve(
     )
 
     # Row squared-norms hoisted out of the loop: the dot-form kernel-row
-    # refresh then streams X from HBM exactly once per iteration.
-    sn = sq_norms(X)
+    # refresh then streams X from HBM exactly once per iteration. Only the
+    # RBF family consumes them; linear/poly skip the O(n*d) pass.
+    sn = sq_norms(X) if kernels.needs_norms(kernel) else None
     body = functools.partial(
         _body, X=X, Y=Y, valid=valid, sn=sn, C=C, gamma=gamma, eps=eps,
-        tau=tau, max_iter=max_iter,
+        tau=tau, max_iter=max_iter, kernel=kernel, degree=degree,
+        coef0=coef0,
     )
     final = lax.while_loop(
         lambda st: st.status == Status.RUNNING, lambda st: body(st), init

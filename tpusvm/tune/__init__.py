@@ -32,9 +32,10 @@ from tpusvm.tune.results import (
     load_tune_result,
     save_tune_result,
 )
-from tpusvm.tune.search import TuneConfig, tune
+from tpusvm.tune.search import TuneConfig, normalize_kernel_specs, tune
 
 __all__ = [
+    "normalize_kernel_specs",
     "Fold",
     "stratified_kfold",
     "GridSpec",

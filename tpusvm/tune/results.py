@@ -14,7 +14,10 @@ import dataclasses
 import json
 from typing import Any, Dict, List
 
-_FORMAT_VERSION = 1
+# v2: the kernel-family search axis — results gain a `kernels` list, rows
+# and the winner carry kernel/degree/coef0 (tpusvm.kernels). Old v1 files
+# fail the version gate with the standard "different tpusvm" message.
+_FORMAT_VERSION = 2
 _KIND = "tpusvm-tune-result"
 
 
@@ -41,6 +44,7 @@ class TuneResult:
     n: int
     d: int
     warm_start: bool
+    kernels: List[Dict[str, Any]]
     points: List[Dict[str, Any]]
     winner: Dict[str, Any]
     total_updates: int
@@ -100,18 +104,21 @@ def format_table(result: TuneResult) -> str:
     looked like.
     """
     g = result.grid
+    families = "+".join(k["kernel"] for k in result.kernels)
     lines = [
         f"tune: schedule={result.schedule} grid="
         f"{len(g['C_values'])}x{len(g['gamma_values'])} "
+        f"kernels={families} "
         f"folds={result.folds} seed={result.seed} "
         f"n={result.n} d={result.d} "
         f"warm_start={'on' if result.warm_start else 'off'}",
-        f"winner: C={result.winner['C']:g} "
+        f"winner: kernel={result.winner.get('kernel', 'rbf')} "
+        f"C={result.winner['C']:g} "
         f"gamma={result.winner['gamma']:g} "
         f"cv_accuracy={result.winner['cv_accuracy']:.6f}",
         f"total SMO updates: {result.total_updates}   "
         f"wall: {result.wall_s:.2f}s",
-        f"{'C':>10} {'gamma':>12} {'status':>10} {'rung':>4} "
+        f"{'kernel':>7} {'C':>10} {'gamma':>12} {'status':>10} {'rung':>4} "
         f"{'cv_acc':>8} {'sv':>7} {'updates':>8} {'warm':>4} "
         f"{'wall_s':>7}",
     ]
@@ -119,6 +126,7 @@ def format_table(result: TuneResult) -> str:
         acc = "-" if r["cv_accuracy"] is None else f"{r['cv_accuracy']:.4f}"
         sv = "-" if r["sv_count"] is None else f"{r['sv_count']:.1f}"
         lines.append(
+            f"{r.get('kernel', 'rbf'):>7} "
             f"{r['C']:>10g} {r['gamma']:>12g} {r['status']:>10} "
             f"{r['rung']:>4} {acc:>8} {sv:>7} {r['n_updates']:>8} "
             f"{r['warm_seeded']:>4} {r['wall_s']:>7.2f}"

@@ -39,9 +39,10 @@ from typing import Any, Callable, Dict, List, Optional
 import jax.numpy as jnp
 import numpy as np
 
+from tpusvm import kernels as _kernels
 from tpusvm.config import SVMConfig, resolve_accum_dtype
 from tpusvm.data.scaler import MinMaxScaler
-from tpusvm.ops.rbf import rbf_cross, sq_norms
+from tpusvm.ops.rbf import sq_norms
 from tpusvm.solver.blocked import blocked_smo_solve
 from tpusvm.status import Status, TuneStatus
 from tpusvm.tune.folds import Fold, stratified_kfold
@@ -141,13 +142,42 @@ def _rung_sizes(n_full: int, min_rung: int, eta: int) -> List[int]:
     return sizes
 
 
-def _point_row(C: float, gamma: float) -> Dict[str, Any]:
+def _point_row(C: float, gamma: float, spec: Dict[str, Any]
+               ) -> Dict[str, Any]:
     return {
-        "C": C, "gamma": gamma, "status": TuneStatus.SKIPPED.name,
+        "C": C, "gamma": gamma, "kernel": spec["kernel"],
+        "degree": spec["degree"], "coef0": spec["coef0"],
+        "status": TuneStatus.SKIPPED.name,
         "rung": -1, "n_subset": 0, "cv_accuracy": None,
         "fold_accuracy": [], "sv_count": None, "n_updates": 0,
         "wall_s": 0.0, "warm_seeded": 0,
     }
+
+
+def normalize_kernel_specs(kernel_specs, base: SVMConfig) -> List[Dict[str, Any]]:
+    """Kernel-family search axis -> full {kernel, degree, coef0} dicts.
+
+    Accepts None (search only the base config's family), bare family
+    names, or partial dicts; degree/coef0 default from the base config.
+    Duplicate fully-resolved specs are rejected (they would silently
+    double the search cost and make the winner tie-break order-dependent).
+    """
+    if kernel_specs is None:
+        kernel_specs = [base.kernel]
+    out = []
+    for spec in kernel_specs:
+        if isinstance(spec, str):
+            spec = {"kernel": spec}
+        family = _kernels.validate_family(spec.get("kernel", base.kernel))
+        resolved = {
+            "kernel": family,
+            "degree": int(spec.get("degree", base.degree)),
+            "coef0": float(spec.get("coef0", base.coef0)),
+        }
+        if resolved in out:
+            raise ValueError(f"duplicate kernel spec {resolved}")
+        out.append(resolved)
+    return out
 
 
 def tune(
@@ -164,13 +194,24 @@ def tune(
     log_fn: Optional[Callable[[str], None]] = None,
     dataset=None,
     tracer=None,
+    kernels=None,
 ) -> TuneResult:
-    """Cross-validated search over `grid`; returns the TuneResult table.
+    """Cross-validated search over `grid` (x kernel families); returns the
+    TuneResult table.
 
     base: numerical-tolerance donor (tau/eps/sv_tol/max_iter); its C and
     gamma are ignored — the grid supplies those per point. Fits use the
     blocked solver with the fold's cached row norms; extra static knobs
     (q, max_inner, ...) pass through solver_opts.
+
+    kernels: optional kernel-family search axis — a list of family names
+    or {kernel, degree, coef0} dicts (normalize_kernel_specs; None =
+    search only base.kernel). Each family runs the full (C, gamma)
+    schedule over the SAME fold caches (scaled X / norms / labels are
+    kernel-independent, so the per-fold setup is paid once for the whole
+    matrix) with its OWN warm-start store — duals do not transfer across
+    kernel geometries — and the winner is the global cv_accuracy argmax,
+    carrying its kernel/degree/coef0 alongside C and gamma.
 
     dataset: a stream.ShardedDataset used INSTEAD of (X, Y) — pass None
     for both. Folds are computed from a labels-only manifest pass
@@ -211,117 +252,144 @@ def tune(
     n_full = min(c.n_train for c in caches)  # uniform rung cap: one
     # compiled solver shape per rung instead of one per ±1-row fold size
     points = grid.points()
-    rows = [_point_row(C, g) for C, g in points]
-    store = WarmStore()
+    specs = normalize_kernel_specs(kernels, base)
+    all_rows: List[Dict[str, Any]] = []
 
-    def fit_point(pi: int, m: int, rung: int) -> Dict[str, Any]:
-        """All k fold fits of one point at rung size m: seeds first, then
-        every solve dispatched, then one materialisation pass."""
-        C, gamma = points[pi]
-        row = rows[pi]
-        t0 = time.perf_counter()
-        seeds = []
-        if config.warm_start:
-            for fi, c in enumerate(caches):
-                seeds.append(store.seed(fi, points[pi], m,
-                                        c.Ytr_host[:m], C))
+    def run_family(spec: Dict[str, Any]) -> List[Dict[str, Any]]:
+        """One kernel family's full (C, gamma) schedule over the shared
+        fold caches, with its own warm store."""
+        rows = [_point_row(C, g, spec) for C, g in points]
+        store = WarmStore()
+        rbf = spec["kernel"] == "rbf"
+        kern = dict(kernel=spec["kernel"], degree=spec["degree"],
+                    coef0=spec["coef0"])
+
+        def fit_point(pi: int, m: int, rung: int) -> Dict[str, Any]:
+            """All k fold fits of one point at rung size m: seeds first,
+            then every solve dispatched, then one materialisation pass."""
+            C, gamma = points[pi]
+            row = rows[pi]
+            t0 = time.perf_counter()
+            seeds = []
+            if config.warm_start:
+                for fi, c in enumerate(caches):
+                    seeds.append(store.seed(fi, points[pi], m,
+                                            c.Ytr_host[:m], C))
+            else:
+                seeds = [None] * len(caches)
+            results = []
+            for c, seed in zip(caches, seeds):
+                alpha0 = None if seed is None else jnp.asarray(seed, accum)
+                results.append(blocked_smo_solve(
+                    c.Xtr[:m], c.Ytr[:m], alpha0=alpha0,
+                    warm_start=seed is not None,
+                    # the norms cache only exists for the RBF family
+                    sn=c.sn[:m] if rbf else None,
+                    C=C, gamma=gamma, eps=base.eps, tau=base.tau,
+                    max_iter=base.max_iter, accum_dtype=accum, **kern,
+                    **opts,
+                ))
+            accs, svs, updates = [], [], 0
+            for fi, (c, res) in enumerate(zip(caches, results)):
+                alpha = np.asarray(res.alpha)  # completion barrier
+                store.record(fi, points[pi], alpha)
+                coef = jnp.asarray(alpha * c.Ytr_host[:m], dtype)
+                K_val = _kernels.cross(
+                    spec["kernel"], c.Xval, c.Xtr[:m], gamma=gamma,
+                    coef0=spec["coef0"], degree=spec["degree"],
+                    snA=c.sn_val if rbf else None,
+                    snB=c.sn[:m] if rbf else None,
+                )
+                scores = np.asarray(
+                    K_val @ coef - jnp.asarray(res.b, dtype)
+                )
+                pred = np.where(scores > 0, 1, -1)
+                accs.append(float((pred == c.Yval).mean()))
+                svs.append(int((alpha > base.sv_tol).sum()))
+                updates += int(res.n_iter) - 1
+                status = Status(int(res.status))
+                if status not in (Status.CONVERGED, Status.NO_WORKING_SET):
+                    say(f"tune: point (C={C:g}, gamma={gamma:g}, "
+                        f"kernel={spec['kernel']}) fold {fi} "
+                        f"ended {status.name}")
+            row.update(
+                rung=rung, n_subset=m,
+                cv_accuracy=float(np.mean(accs)), fold_accuracy=accs,
+                sv_count=float(np.mean(svs)),
+                n_updates=row["n_updates"] + updates,
+                wall_s=row["wall_s"] + (time.perf_counter() - t0),
+                warm_seeded=row["warm_seeded"]
+                + sum(s is not None for s in seeds),
+            )
+            if tracer is not None:
+                tracer.event(
+                    "tune.point", C=C, gamma=gamma, rung=rung, n_subset=m,
+                    kernel=spec["kernel"],
+                    cv_accuracy=row["cv_accuracy"], n_updates=updates,
+                    warm_seeded=sum(s is not None for s in seeds),
+                    wall_s=time.perf_counter() - t0,
+                )
+            return row
+
+        if config.schedule == "grid":
+            best = -np.inf
+            since_improve = 0
+            for pi in range(len(points)):
+                row = fit_point(pi, n_full, rung=0)
+                row["status"] = TuneStatus.EVALUATED.name
+                say(f"tune: [{spec['kernel']}] C={row['C']:g} "
+                    f"gamma={row['gamma']:g} "
+                    f"cv={row['cv_accuracy']:.4f} "
+                    f"updates={row['n_updates']} "
+                    f"warm={row['warm_seeded']}/{config.folds}")
+                if row["cv_accuracy"] > best + config.plateau_tol:
+                    best = row["cv_accuracy"]
+                    since_improve = 0
+                else:
+                    since_improve += 1
+                if config.patience and since_improve >= config.patience:
+                    say(f"tune: plateau after {pi + 1}/{len(points)} "
+                        f"points (no improvement in {since_improve})")
+                    break
         else:
-            seeds = [None] * len(caches)
-        results = []
-        for c, seed in zip(caches, seeds):
-            alpha0 = None if seed is None else jnp.asarray(seed, accum)
-            results.append(blocked_smo_solve(
-                c.Xtr[:m], c.Ytr[:m], alpha0=alpha0,
-                warm_start=seed is not None, sn=c.sn[:m],
-                C=C, gamma=gamma, eps=base.eps, tau=base.tau,
-                max_iter=base.max_iter, accum_dtype=accum, **opts,
-            ))
-        accs, svs, updates = [], [], 0
-        for fi, (c, res) in enumerate(zip(caches, results)):
-            alpha = np.asarray(res.alpha)  # completion barrier
-            store.record(fi, points[pi], alpha)
-            coef = jnp.asarray(alpha * c.Ytr_host[:m], dtype)
-            scores = np.asarray(
-                rbf_cross(c.Xval, c.Xtr[:m], gamma,
-                          snA=c.sn_val, snB=c.sn[:m]) @ coef
-                - jnp.asarray(res.b, dtype)
-            )
-            pred = np.where(scores > 0, 1, -1)
-            accs.append(float((pred == c.Yval).mean()))
-            svs.append(int((alpha > base.sv_tol).sum()))
-            updates += int(res.n_iter) - 1
-            status = Status(int(res.status))
-            if status not in (Status.CONVERGED, Status.NO_WORKING_SET):
-                say(f"tune: point (C={C:g}, gamma={gamma:g}) fold {fi} "
-                    f"ended {status.name}")
-        row.update(
-            rung=rung, n_subset=m,
-            cv_accuracy=float(np.mean(accs)), fold_accuracy=accs,
-            sv_count=float(np.mean(svs)),
-            n_updates=row["n_updates"] + updates,
-            wall_s=row["wall_s"] + (time.perf_counter() - t0),
-            warm_seeded=row["warm_seeded"]
-            + sum(s is not None for s in seeds),
-        )
-        if tracer is not None:
-            tracer.event(
-                "tune.point", C=C, gamma=gamma, rung=rung, n_subset=m,
-                cv_accuracy=row["cv_accuracy"], n_updates=updates,
-                warm_seeded=sum(s is not None for s in seeds),
-                wall_s=time.perf_counter() - t0,
-            )
-        return row
-
-    if config.schedule == "grid":
-        best = -np.inf
-        since_improve = 0
-        for pi in range(len(points)):
-            row = fit_point(pi, n_full, rung=0)
-            row["status"] = TuneStatus.EVALUATED.name
-            say(f"tune: C={row['C']:g} gamma={row['gamma']:g} "
-                f"cv={row['cv_accuracy']:.4f} updates={row['n_updates']} "
-                f"warm={row['warm_seeded']}/{config.folds}")
-            if row["cv_accuracy"] > best + config.plateau_tol:
-                best = row["cv_accuracy"]
-                since_improve = 0
-            else:
-                since_improve += 1
-            if config.patience and since_improve >= config.patience:
-                say(f"tune: plateau after {pi + 1}/{len(points)} points "
-                    f"(no improvement in {since_improve})")
-                break
-    else:
-        survivors = list(range(len(points)))
-        sizes = _rung_sizes(n_full, config.min_rung, config.eta)
-        for rung, m in enumerate(sizes):
-            last = rung == len(sizes) - 1
-            for pi in survivors:
-                fit_point(pi, m, rung=rung)
-            say(f"tune: rung {rung} (m={m}) scored {len(survivors)} points")
-            # rank: best CV accuracy first, solve order breaks ties
-            # deterministically
-            ranked = sorted(
-                survivors,
-                key=lambda pi: (-rows[pi]["cv_accuracy"], pi),
-            )
-            if last:
+            survivors = list(range(len(points)))
+            sizes = _rung_sizes(n_full, config.min_rung, config.eta)
+            for rung, m in enumerate(sizes):
+                last = rung == len(sizes) - 1
                 for pi in survivors:
-                    rows[pi]["status"] = TuneStatus.EVALUATED.name
-            else:
-                keep = max(1, -(-len(survivors) // config.eta))
-                for pi in ranked[keep:]:
-                    rows[pi]["status"] = TuneStatus.PRUNED.name
-                survivors = sorted(ranked[:keep])
+                    fit_point(pi, m, rung=rung)
+                say(f"tune: [{spec['kernel']}] rung {rung} (m={m}) "
+                    f"scored {len(survivors)} points")
+                # rank: best CV accuracy first, solve order breaks ties
+                # deterministically
+                ranked = sorted(
+                    survivors,
+                    key=lambda pi: (-rows[pi]["cv_accuracy"], pi),
+                )
+                if last:
+                    for pi in survivors:
+                        rows[pi]["status"] = TuneStatus.EVALUATED.name
+                else:
+                    keep = max(1, -(-len(survivors) // config.eta))
+                    for pi in ranked[keep:]:
+                        rows[pi]["status"] = TuneStatus.PRUNED.name
+                    survivors = sorted(ranked[:keep])
+        return rows
 
-    evaluated = [r for r in rows
+    for spec in specs:
+        all_rows.extend(run_family(spec))
+
+    evaluated = [r for r in all_rows
                  if r["status"] == TuneStatus.EVALUATED.name]
     if not evaluated:  # unreachable: both schedules evaluate >= 1 point
         raise RuntimeError("tune evaluated no grid points")
     win = max(evaluated, key=lambda r: r["cv_accuracy"])  # first max wins
     winner = {"C": win["C"], "gamma": win["gamma"],
+              "kernel": win["kernel"], "degree": win["degree"],
+              "coef0": win["coef0"],
               "cv_accuracy": win["cv_accuracy"]}
-    say(f"tune: winner C={win['C']:g} gamma={win['gamma']:g} "
-        f"cv={win['cv_accuracy']:.4f}")
+    say(f"tune: winner kernel={win['kernel']} C={win['C']:g} "
+        f"gamma={win['gamma']:g} cv={win['cv_accuracy']:.4f}")
     if tracer is not None:
         tracer.event("tune.winner", **winner)
     return TuneResult(
@@ -333,8 +401,9 @@ def tune(
         n=int(n_rows),
         d=int(n_feat),
         warm_start=config.warm_start,
-        points=rows,
+        kernels=specs,
+        points=all_rows,
         winner=winner,
-        total_updates=int(sum(r["n_updates"] for r in rows)),
+        total_updates=int(sum(r["n_updates"] for r in all_rows)),
         wall_s=time.perf_counter() - t_run,
     )
