@@ -1,0 +1,782 @@
+"""tpusvm.faults tests: deterministic injection, retry/backoff, circuit
+breaker, crash-safe training, journaled ingest, degraded-mode serving.
+
+The acceptance contract (ISSUE 7): for every registered injection point,
+a seeded plan (a) retries transient faults to success, (b) reproduces an
+uninterrupted solve bit-for-bit after kill-at-checkpoint + resume, and
+(c) sheds load / trips the breaker under injected scoring failures
+without deadlocking — with the whole fault lifecycle visible in obs
+counters and trace events.
+"""
+
+import json
+import os
+import threading
+import time
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpusvm import faults
+from tpusvm.config import SVMConfig
+from tpusvm.data import MinMaxScaler, rings
+from tpusvm.models import BinarySVC
+from tpusvm.obs.registry import MetricsRegistry
+from tpusvm.status import ServeStatus, Status, StreamStatus
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_plan():
+    """Every test leaves the process with no active plan or sink."""
+    yield
+    faults.deactivate()
+    faults.set_event_sink(None)
+
+
+def _rule(**kw):
+    return faults.FaultRule(**kw)
+
+
+# ------------------------------------------------------------------ plan
+def test_plan_rejects_unknown_points_kinds_and_versions(tmp_path):
+    with pytest.raises(ValueError, match="unknown injection point"):
+        faults.FaultPlan([_rule(point="nope.nope", kind="transient")])
+    with pytest.raises(ValueError, match="unknown kind"):
+        faults.FaultPlan([_rule(point="serve.score", kind="explode")])
+    p = tmp_path / "plan.json"
+    p.write_text("{}")
+    with pytest.raises(ValueError, match="format_version"):
+        faults.load_plan(str(p))
+    p.write_text(json.dumps({"format_version": 99, "rules": []}))
+    with pytest.raises(ValueError, match="unsupported fault plan"):
+        faults.load_plan(str(p))
+    p.write_text(json.dumps({
+        "format_version": 1,
+        "rules": [{"point": "serve.score", "kind": "latency",
+                   "surprise": 1}],
+    }))
+    with pytest.raises(ValueError, match="unknown keys"):
+        faults.load_plan(str(p))
+    p.write_text("not json")
+    with pytest.raises(ValueError, match="not valid JSON"):
+        faults.load_plan(str(p))
+
+
+def test_plan_fires_deterministically():
+    """Same seed -> the same hits fire, on every run."""
+    def fire_pattern(seed):
+        plan = faults.FaultPlan(
+            [_rule(point="serve.score", kind="transient", p=0.5)],
+            seed=seed)
+        pattern = []
+        with faults.active(plan):
+            for _ in range(32):
+                try:
+                    faults.point("serve.score")
+                    pattern.append(0)
+                except faults.TransientIOError:
+                    pattern.append(1)
+        return pattern
+
+    a, b = fire_pattern(7), fire_pattern(7)
+    assert a == b
+    assert 0 < sum(a) < 32  # p=0.5 actually mixes outcomes
+    assert fire_pattern(8) != a  # and the seed matters
+
+
+def test_point_is_noop_without_plan_and_rejects_typos():
+    assert faults.point("serve.score") is None
+    assert faults.point("ingest.write_shard", payload=b"x") == b"x"
+    plan = faults.FaultPlan([])
+    with faults.active(plan):
+        with pytest.raises(ValueError, match="unregistered"):
+            faults.point("serve.scoore")
+
+
+def test_at_hit_and_max_hits_semantics():
+    plan = faults.FaultPlan([
+        _rule(point="cascade.round", kind="transient", at_hit=3),
+        _rule(point="stream.read_shard", kind="transient", max_hits=2),
+    ])
+    with faults.active(plan):
+        outcomes = []
+        for _ in range(5):
+            try:
+                faults.point("cascade.round")
+                outcomes.append("ok")
+            except faults.TransientIOError:
+                outcomes.append("fault")
+        assert outcomes == ["ok", "ok", "fault", "ok", "ok"]
+        reads = []
+        for _ in range(4):
+            try:
+                faults.point("stream.read_shard")
+                reads.append("ok")
+            except faults.TransientIOError:
+                reads.append("fault")
+        assert reads == ["fault", "fault", "ok", "ok"]
+
+
+# ----------------------------------------------------------------- retry
+def test_retry_backoff_schedule_is_deterministic_and_bounded():
+    sleeps = []
+    pol = faults.RetryPolicy(max_attempts=5, base_delay_s=0.01,
+                             max_delay_s=0.03, multiplier=2.0, jitter=0.5,
+                             seed=3)
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 5:
+            raise faults.TransientIOError("flaky")
+        return "done"
+
+    r = faults.Retry(pol, op="t", metrics=MetricsRegistry(),
+                     sleep=sleeps.append)
+    assert r(flaky) == "done"
+    assert len(sleeps) == 4
+    # deterministic: a second instance reproduces the exact schedule
+    sleeps2 = []
+    calls["n"] = 0
+    faults.Retry(pol, op="t", metrics=MetricsRegistry(),
+                 sleep=sleeps2.append)(flaky)
+    assert sleeps == sleeps2
+    # bounded by max_delay * (1 + jitter), growing from base * (1 - jitter)
+    assert all(0.005 <= s <= 0.045 for s in sleeps)
+
+
+def test_retry_exhaustion_and_classification():
+    reg = MetricsRegistry()
+    r = faults.Retry(faults.RetryPolicy(max_attempts=3, base_delay_s=0.0,
+                                        jitter=0.0),
+                     op="x", metrics=reg, sleep=lambda s: None)
+
+    def always():
+        raise faults.TransientIOError("nope")
+
+    with pytest.raises(faults.RetryExhaustedError) as ei:
+        r(always)
+    assert ei.value.attempts == 3
+    assert isinstance(ei.value.last, faults.TransientIOError)
+    assert reg.counter("retry.exhausted", op="x").value == 1
+
+    # non-retryable errors propagate immediately, attempt 1
+    def broken():
+        raise KeyError("real bug")
+
+    with pytest.raises(KeyError):
+        r(broken)
+
+    # SimulatedKill is BaseException: never retried, never wrapped
+    def killed():
+        raise faults.SimulatedKill("die")
+
+    with pytest.raises(faults.SimulatedKill):
+        r(killed)
+
+
+# --------------------------------------------------------------- breaker
+def test_breaker_trip_halfopen_recover_and_reopen():
+    clock = {"t": 0.0}
+    events = []
+    br = faults.CircuitBreaker(threshold=3, cooldown_s=10.0, name="m",
+                               clock=lambda: clock["t"],
+                               listener=events.append)
+    assert br.state == "closed" and br.allow()
+    for _ in range(2):
+        br.record_failure()
+    assert br.state == "closed"  # 2 < threshold
+    br.record_success()
+    br.record_failure()
+    br.record_failure()
+    assert br.state == "closed"  # success reset the consecutive count
+    br.record_failure()
+    assert br.state == "open" and events == ["tripped"]
+    assert not br.allow()  # open: fail fast
+
+    clock["t"] = 10.0  # cooldown elapsed -> half-open admits ONE probe
+    assert br.state == "half_open"
+    assert br.allow()
+    assert not br.allow()  # only one probe outstanding
+    br.record_failure()  # probe failed -> reopen, fresh cooldown
+    assert br.state == "open" and not br.allow()
+
+    clock["t"] = 20.0
+    assert br.allow()
+    br.record_success()  # probe succeeded -> closed
+    assert br.state == "closed" and br.allow()
+    assert br.trips == 1 and br.recoveries == 1
+    assert "recovered" in events and "reopened" in events
+    d = br.describe()
+    assert d["state"] == "closed" and d["trips"] == 1
+
+
+# ----------------------------------------------------- stream under chaos
+def _mk_dataset(tmp_path, n=301, rows_per_shard=64):
+    from tpusvm.stream import ingest_arrays, open_dataset
+
+    X, Y = rings(n=n, seed=11)
+    out = str(tmp_path / "ds")
+    ingest_arrays(out, X, Y, rows_per_shard=rows_per_shard)
+    return X, Y, open_dataset(out)
+
+
+def test_reader_retries_transient_faults_to_parity(tmp_path):
+    from tpusvm.stream import ShardReader
+
+    X, Y, ds = _mk_dataset(tmp_path)
+    reg = MetricsRegistry()
+    plan = faults.FaultPlan(
+        [_rule(point="stream.read_shard", kind="transient", max_hits=3)],
+        seed=5)
+    with faults.active(plan):
+        blocks = list(ShardReader(ds, metrics=reg))
+    assert np.array_equal(np.concatenate([b[0] for b in blocks]), X)
+    assert reg.counter("retry.recovered", op="stream.read_shard").value >= 1
+    assert reg.counter("retry.exhausted", op="stream.read_shard").value == 0
+
+
+def test_reader_exhausted_retries_name_the_shard(tmp_path):
+    from tpusvm.stream import ShardError, ShardReader
+
+    _, _, ds = _mk_dataset(tmp_path)
+    # more consecutive faults than the default 4-attempt budget
+    plan = faults.FaultPlan(
+        [_rule(point="stream.read_shard", kind="transient", max_hits=50)],
+        seed=5)
+    with faults.active(plan):
+        with pytest.raises(ShardError, match="READ_FAILED") as ei:
+            list(ShardReader(ds, metrics=MetricsRegistry()))
+    assert ei.value.status == StreamStatus.READ_FAILED
+    assert ei.value.filename.startswith("shard-")
+
+
+def test_corrupted_shard_is_named_not_a_zlib_traceback(tmp_path):
+    """Satellite: a bit-flipped shard surfaces as ShardError naming the
+    shard (with the StreamStatus), from load_shard and from the prefetch
+    thread alike; validate() classifies it CHECKSUM_MISMATCH."""
+    from tpusvm.stream import ShardError, ShardReader, open_dataset
+
+    _, _, ds = _mk_dataset(tmp_path)
+    # truncate one shard mid-file: np.load dies inside zlib/zipfile
+    victim = ds.shard_path(2)
+    raw = open(victim, "rb").read()
+    with open(victim, "wb") as f:
+        f.write(raw[: len(raw) // 2])
+    ds2 = open_dataset(str(tmp_path / "ds"))
+    statuses = ds2.validate()
+    assert statuses[2] == StreamStatus.CHECKSUM_MISMATCH
+    with pytest.raises(ShardError, match="shard-00002") as ei:
+        ds2.load_shard(2)
+    assert ei.value.status == StreamStatus.CHECKSUM_MISMATCH
+    with pytest.raises(ShardError, match="shard-00002"):
+        list(ShardReader(ds2, metrics=MetricsRegistry()))
+
+
+def test_info_cli_reports_corrupt_shard_instead_of_tracebacking(
+        tmp_path, capsys):
+    from tpusvm.cli import main
+
+    _, _, ds = _mk_dataset(tmp_path)
+    victim = ds.shard_path(1)
+    raw = open(victim, "rb").read()
+    with open(victim, "wb") as f:
+        f.write(raw[: len(raw) // 2])
+    rc = main(["info", str(tmp_path / "ds")])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "shard-00001.npz: CHECKSUM_MISMATCH" in out
+
+
+def test_ingest_kill_then_journal_resume_is_identical(tmp_path):
+    """Satellite + tentpole: a killed ingest leaves a journal (and NO
+    manifest, NO torn shard file); resume completes to a dataset
+    bit-identical to an uninterrupted ingest."""
+    from tpusvm.stream import ingest_blocks, open_dataset
+
+    X, Y = rings(n=301, seed=11)
+
+    def blocks():
+        for s in range(0, len(X), 50):
+            yield X[s: s + 50], Y[s: s + 50]
+
+    ref = ingest_blocks(str(tmp_path / "ref"), blocks(), rows_per_shard=64)
+    out = str(tmp_path / "crashy")
+    plan = faults.FaultPlan(
+        [_rule(point="ingest.write_shard", kind="kill", at_hit=3)])
+    with pytest.raises(faults.SimulatedKill):
+        with faults.active(plan):
+            ingest_blocks(out, blocks(), rows_per_shard=64)
+    assert os.path.exists(os.path.join(out, "ingest.journal.json"))
+    assert not os.path.exists(os.path.join(out, "manifest.json"))
+    assert not any(f.endswith(".tmp") for f in os.listdir(out))
+
+    m = ingest_blocks(out, blocks(), rows_per_shard=64, resume=True)
+    assert [s.sha256 for s in m.shards] == [s.sha256 for s in ref.shards]
+    ds = open_dataset(out)
+    assert all(s == StreamStatus.OK for s in ds.validate())
+    assert not os.path.exists(os.path.join(out, "ingest.journal.json"))
+
+
+def test_ingest_write_transients_are_retried_to_success(tmp_path):
+    from tpusvm.obs.registry import default_registry, reset_default_registry
+    from tpusvm.stream import ingest_arrays, open_dataset
+
+    reset_default_registry()
+    try:
+        X, Y = rings(n=200, seed=1)
+        out = str(tmp_path / "t")
+        plan = faults.FaultPlan(
+            [_rule(point="ingest.write_shard", kind="transient",
+                   max_hits=2)])
+        with faults.active(plan):
+            ingest_arrays(out, X, Y, rows_per_shard=64)
+        assert all(s == StreamStatus.OK
+                   for s in open_dataset(out).validate())
+        reg = default_registry()
+        assert reg.counter("retry.recovered",
+                           op="ingest.write_shard").value >= 1
+    finally:
+        reset_default_registry()
+
+
+def test_ingest_corrupt_write_is_caught_by_validation(tmp_path):
+    from tpusvm.stream import ingest_arrays, open_dataset
+
+    X, Y = rings(n=301, seed=11)
+    out = str(tmp_path / "c")
+    plan = faults.FaultPlan(
+        [_rule(point="ingest.write_shard", kind="corrupt", at_hit=2)],
+        seed=9)
+    with faults.active(plan):
+        ingest_arrays(out, X, Y, rows_per_shard=64)
+    statuses = open_dataset(out).validate()
+    assert statuses[1] == StreamStatus.CHECKSUM_MISMATCH
+    assert all(s == StreamStatus.OK
+               for i, s in enumerate(statuses) if i != 1)
+
+
+def test_ingest_resume_refuses_changed_settings(tmp_path):
+    from tpusvm.stream import ingest_blocks
+
+    X, Y = rings(n=200, seed=1)
+    out = str(tmp_path / "j")
+    plan = faults.FaultPlan(
+        [_rule(point="ingest.write_shard", kind="kill", at_hit=2)])
+    with pytest.raises(faults.SimulatedKill):
+        with faults.active(plan):
+            ingest_blocks(out, [(X, Y)], rows_per_shard=64)
+    with pytest.raises(ValueError, match="rows_per_shard"):
+        ingest_blocks(out, [(X, Y)], rows_per_shard=32, resume=True)
+
+
+# ------------------------------------------------- crash-safe training
+def _solve_args(n=400, q=16):
+    X, Y = rings(n=n, seed=11)
+    Xs = jnp.asarray(MinMaxScaler().fit_transform(X), jnp.float32)
+    return Xs, jnp.asarray(Y), dict(C=10.0, gamma=10.0, q=q,
+                                    accum_dtype=jnp.float64)
+
+
+def test_checkpointed_solve_bit_identical_to_plain(tmp_path):
+    from tpusvm.solver.blocked import blocked_smo_solve
+    from tpusvm.solver.checkpoint import checkpointed_blocked_solve
+
+    Xs, Y, kw = _solve_args()
+    plain = blocked_smo_solve(Xs, Y, **kw)
+    assert Status(int(plain.status)) == Status.CONVERGED
+    ck = str(tmp_path / "ck.npz")
+    res = checkpointed_blocked_solve(Xs, Y, checkpoint_path=ck,
+                                     checkpoint_every=4, **kw)
+    assert np.asarray(res.alpha).tobytes() == np.asarray(plain.alpha).tobytes()
+    assert float(res.b) == float(plain.b)
+    assert int(res.n_outer) == int(plain.n_outer)
+    assert not os.path.exists(ck)  # completed solve cleans up
+
+
+def test_kill_at_every_checkpoint_resume_bit_identical(tmp_path):
+    """The tentpole gate: for EVERY checkpoint k, a run killed at k and
+    resumed reproduces the uninterrupted model bit-for-bit (alpha bytes,
+    SV ids, b)."""
+    from tpusvm.oracle.smo import get_sv_indices
+    from tpusvm.solver.blocked import blocked_smo_solve
+    from tpusvm.solver.checkpoint import checkpointed_blocked_solve
+
+    Xs, Y, kw = _solve_args()
+    plain = blocked_smo_solve(Xs, Y, **kw)
+    ref_alpha = np.asarray(plain.alpha)
+    ref_sv = get_sv_indices(ref_alpha, 1e-8)
+    n_ckpts = int(plain.n_outer) // 4
+    assert n_ckpts >= 2, "problem too easy to exercise checkpoints"
+
+    for k in range(1, n_ckpts + 1):
+        ck = str(tmp_path / f"ck{k}.npz")
+        plan = faults.FaultPlan(
+            [_rule(point="solver.outer_checkpoint", kind="kill",
+                   at_hit=k)])
+        with pytest.raises(faults.SimulatedKill):
+            with faults.active(plan):
+                checkpointed_blocked_solve(Xs, Y, checkpoint_path=ck,
+                                           checkpoint_every=4, **kw)
+        res = checkpointed_blocked_solve(Xs, Y, checkpoint_path=ck,
+                                         checkpoint_every=4, resume=True,
+                                         **kw)
+        a = np.asarray(res.alpha)
+        assert a.tobytes() == ref_alpha.tobytes(), f"kill at ckpt {k}"
+        assert np.array_equal(get_sv_indices(a, 1e-8), ref_sv)
+        assert float(res.b) == float(plain.b)
+
+
+def test_checkpoint_write_transients_are_retried(tmp_path):
+    from tpusvm.solver.checkpoint import checkpointed_blocked_solve
+
+    Xs, Y, kw = _solve_args()
+    plan = faults.FaultPlan(
+        [_rule(point="solver.outer_checkpoint", kind="transient",
+               max_hits=2)])
+    with faults.active(plan):
+        res = checkpointed_blocked_solve(
+            Xs, Y, checkpoint_path=str(tmp_path / "ck.npz"),
+            checkpoint_every=4, **kw)
+    assert Status(int(res.status)) == Status.CONVERGED
+
+
+def test_solver_checkpoint_fingerprint_refuses_other_solves(tmp_path):
+    from tpusvm.solver.checkpoint import checkpointed_blocked_solve
+
+    Xs, Y, kw = _solve_args()
+    ck = str(tmp_path / "ck.npz")
+    plan = faults.FaultPlan(
+        [_rule(point="solver.outer_checkpoint", kind="kill", at_hit=1)])
+    with pytest.raises(faults.SimulatedKill):
+        with faults.active(plan):
+            checkpointed_blocked_solve(Xs, Y, checkpoint_path=ck,
+                                       checkpoint_every=2, **kw)
+    assert os.path.exists(ck) or True  # kill may precede the first write
+    # ensure at least one durable checkpoint to resume against
+    if not os.path.exists(ck):
+        plan = faults.FaultPlan(
+            [_rule(point="solver.outer_checkpoint", kind="kill",
+                   at_hit=2)])
+        with pytest.raises(faults.SimulatedKill):
+            with faults.active(plan):
+                checkpointed_blocked_solve(Xs, Y, checkpoint_path=ck,
+                                           checkpoint_every=2, **kw)
+    assert os.path.exists(ck)
+    # a different gamma is a different solve: refused, naming the field
+    bad = dict(kw, gamma=20.0)
+    with pytest.raises(ValueError, match="gamma"):
+        checkpointed_blocked_solve(Xs, Y, checkpoint_path=ck,
+                                   checkpoint_every=2, resume=True, **bad)
+    # different training bytes: refused too
+    with pytest.raises(ValueError, match="crc32"):
+        checkpointed_blocked_solve(
+            jnp.asarray(np.asarray(Xs) + 1e-3), Y, checkpoint_path=ck,
+            checkpoint_every=2, resume=True, **kw)
+    # a non-checkpoint npz is refused with a clear error
+    np.savez(str(tmp_path / "junk"), a=np.zeros(3))
+    with pytest.raises(ValueError, match="not a tpusvm solver checkpoint"):
+        checkpointed_blocked_solve(
+            Xs, Y, checkpoint_path=str(tmp_path / "junk.npz"),
+            checkpoint_every=2, resume=True, **kw)
+
+
+def test_cli_single_mode_checkpoint_resume(tmp_path, capsys):
+    """train --checkpoint/--resume now works beyond cascade mode: a
+    killed single-mode run resumes to the same smoke-passing model."""
+    from tpusvm.cli import main
+
+    ck = str(tmp_path / "ck.npz")
+    plan_path = str(tmp_path / "kill.json")
+    with open(plan_path, "w") as f:
+        json.dump({"format_version": 1, "rules": [
+            {"point": "solver.outer_checkpoint", "kind": "kill",
+             "at_hit": 1}]}, f)
+    with pytest.raises(faults.SimulatedKill):
+        main(["train", "--smoke", "-q", "--checkpoint", ck,
+              "--checkpoint-every", "1", "--faults", plan_path])
+    faults.deactivate()
+    rc = main(["train", "--smoke", "-q", "--checkpoint", ck,
+               "--checkpoint-every", "1", "--resume"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "train smoke ok" in out
+
+
+def test_cli_checkpoint_guards(tmp_path):
+    from tpusvm.cli import main
+
+    with pytest.raises(SystemExit, match="blocked solver"):
+        main(["train", "--smoke", "-q", "--solver", "pair",
+              "--checkpoint", str(tmp_path / "c.npz")])
+    with pytest.raises(SystemExit, match="oracle"):
+        main(["train", "--synthetic", "rings", "--n", "64", "--mode",
+              "oracle", "--checkpoint", str(tmp_path / "c.npz")])
+
+
+# --------------------------------------------------- degraded-mode serve
+@pytest.fixture(scope="module")
+def serve_model():
+    X, Y = rings(n=240, seed=2)
+    return BinarySVC(SVMConfig(C=10.0, gamma=10.0),
+                     dtype=jnp.float64).fit(X, Y)
+
+
+def _server(model, **cfg_kw):
+    from tpusvm.serve import ServeConfig, Server
+
+    srv = Server(ServeConfig(max_batch=4, max_delay_ms=0.5, **cfg_kw),
+                 dtype=jnp.float64)
+    srv.add_model("m", model)
+    srv.warmup()
+    return srv
+
+
+def test_serve_transient_scoring_faults_are_retried(serve_model):
+    Xq, _ = rings(n=8, seed=3)
+    plan = faults.FaultPlan(
+        [_rule(point="serve.score", kind="transient", max_hits=2)])
+    with _server(serve_model, score_retries=3) as srv:
+        ref = srv.predict_direct("m", Xq)[0]
+        with faults.active(plan):
+            res = srv.submit_many("m", Xq)
+        assert all(r.ok for r in res)
+        np.testing.assert_array_equal(np.array([r.scores for r in res]),
+                                      ref)
+        snap = srv.metrics("m")
+        assert snap["retries"] >= 1 and snap["errors"] == 0
+        assert snap["breaker_trips"] == 0
+
+
+def test_serve_breaker_trips_sheds_fast_and_recovers(serve_model, tmp_path):
+    """Acceptance (c): persistent scoring failures trip the per-model
+    breaker; further requests fail fast with UNAVAILABLE (no deadlock, no
+    kernel time); after the cooldown a half-open probe recovers; and the
+    whole lifecycle is visible in an obs trace + counters."""
+    from tpusvm.obs import Tracer, read_trace
+
+    trace_path = str(tmp_path / "chaos.jsonl")
+    tracer = Tracer(trace_path)
+    faults.set_event_sink(tracer.event)
+    Xq, _ = rings(n=4, seed=3)
+    # enough fault budget to exhaust per-request retries (1 attempt each,
+    # score_retries=0) and trip the threshold=2 breaker
+    plan = faults.FaultPlan(
+        [_rule(point="serve.score", kind="transient", max_hits=2)])
+    with _server(serve_model, score_retries=0, breaker_threshold=2,
+                 breaker_cooldown_s=0.3) as srv:
+        with faults.active(plan):
+            r1 = srv.submit("m", Xq[0])
+            r2 = srv.submit("m", Xq[1])
+            assert r1.status == ServeStatus.ERROR
+            assert r2.status == ServeStatus.ERROR
+            assert srv.health()["status"] == "degraded"
+            assert srv.health()["models"]["m"] == "open"
+            # breaker open: fast UNAVAILABLE, and far quicker than a
+            # scoring attempt + timeout would be
+            t0 = time.monotonic()
+            r3 = srv.submit("m", Xq[2])
+            assert r3.status == ServeStatus.UNAVAILABLE
+            assert time.monotonic() - t0 < 0.5
+            snap = srv.metrics("m")
+            assert snap["breaker_trips"] == 1
+            assert snap["unavailable"] >= 1
+            # cooldown elapses; the fault budget (max_hits=2) is spent,
+            # so the half-open probe scores cleanly and the breaker closes
+            time.sleep(0.35)
+            r4 = srv.submit("m", Xq[3])
+            assert r4.ok
+            assert srv.health()["models"]["m"] == "closed"
+            assert srv.metrics("m")["breaker_recoveries"] == 1
+    tracer.close()
+    names = {r["name"] for r in read_trace(trace_path)
+             if r["kind"] == "event"}
+    assert "fault.injected" in names
+    assert "breaker.tripped" in names
+    assert "breaker.recovered" in names
+
+
+def test_microbatcher_sheds_overloaded_beyond_threshold():
+    from tpusvm.serve import Metrics, MicroBatcher
+
+    metrics = Metrics(buckets=(1,))
+    release = threading.Event()
+
+    def slow(X):
+        release.wait(2.0)
+        return np.zeros(X.shape[0]), np.ones(X.shape[0], np.int32)
+
+    b = MicroBatcher(slow, max_batch=1, max_delay_s=0.0, queue_size=8,
+                     timeout_s=5.0, metrics=metrics, shed_at=2)
+    try:
+        results, threads = [], []
+        lock = threading.Lock()
+
+        def fire():
+            r = b.submit(np.zeros(2))
+            with lock:
+                results.append(r.status)
+
+        t = threading.Thread(target=fire)
+        t.start()
+        threads.append(t)
+        time.sleep(0.05)  # worker is now blocked inside slow()
+        # fill to the shed threshold, then beyond it
+        for _ in range(6):
+            th = threading.Thread(target=fire)
+            th.start()
+            threads.append(th)
+            time.sleep(0.01)
+        release.set()
+        for th in threads:
+            th.join(3.0)
+        assert ServeStatus.OVERLOADED in results
+        assert metrics.snapshot()["overloaded"] >= 1
+        # shed requests never entered the queue; the accepted ones scored
+        assert results.count(ServeStatus.OK) >= 1
+    finally:
+        release.set()
+        b.close()
+
+
+def test_server_drain_completes_inflight_then_refuses(serve_model):
+    Xq, _ = rings(n=8, seed=4)
+    with _server(serve_model) as srv:
+        inflight = []
+        t = threading.Thread(
+            target=lambda: inflight.extend(srv.submit_many("m", Xq)))
+        t.start()
+        assert srv.drain(timeout_s=5.0)
+        t.join(5.0)
+        # everything accepted before/through the drain resolved cleanly
+        assert all(r.status in (ServeStatus.OK, ServeStatus.DRAINING)
+                   for r in inflight)
+        assert any(r.ok for r in inflight)
+        r = srv.submit("m", Xq[0])
+        assert r.status == ServeStatus.DRAINING
+        assert srv.health()["status"] == "draining"
+        assert srv.status()["draining"] is True
+
+
+def test_http_healthz_drain_and_degraded_codes(serve_model):
+    import urllib.error
+    import urllib.request
+
+    from tpusvm.serve.http import make_http_server, start_http_thread
+
+    Xq, _ = rings(n=4, seed=5)
+    with _server(serve_model) as srv:
+        httpd = make_http_server(srv, port=0)
+        start_http_thread(httpd)
+        try:
+            port = httpd.server_address[1]
+            base = f"http://127.0.0.1:{port}"
+            health = json.loads(
+                urllib.request.urlopen(f"{base}/healthz").read())
+            assert health["status"] == "ok"
+            assert health["models"] == {"m": "closed"}
+
+            # drain over HTTP; healthz then reports 503 + draining, and
+            # predict requests come back DRAINING with a 503
+            resp = json.loads(urllib.request.urlopen(
+                urllib.request.Request(f"{base}/admin/drain", data=b"",
+                                       method="POST")).read())
+            assert resp == {"drained": True}
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(f"{base}/healthz")
+            assert ei.value.code == 503
+            assert json.loads(ei.value.read())["status"] == "draining"
+            body = json.dumps({"instances": Xq.tolist()}).encode()
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(urllib.request.Request(
+                    f"{base}/v1/models/m:predict", data=body,
+                    headers={"Content-Type": "application/json"}))
+            assert ei.value.code == 503
+            assert (json.loads(ei.value.read())["statuses"]
+                    == ["DRAINING"] * 4)
+        finally:
+            httpd.shutdown()
+
+
+# ---------------------------------------------- cascade resume satellites
+def test_cascade_resume_refuses_other_partition_or_topology(tmp_path):
+    """Satellite: a checkpoint from a different cascade config is
+    rejected with a specific config error BEFORE any compile — not a
+    shape crash mid-run. (Runs even where jax lacks shard_map: the check
+    fires before the round function is built.)"""
+    from tpusvm.config import CascadeConfig
+    from tpusvm.parallel.cascade import cascade_fit, save_round_state
+    from tpusvm.parallel.svbuffer import empty
+
+    X, Y = rings(n=128, seed=3)
+    Xs = MinMaxScaler().fit_transform(X)
+    ck = str(tmp_path / "cascade.npz")
+    buf = empty(64, Xs.shape[1])
+    save_round_state(ck, buf, {1, 2}, rnd=2, b=0.5, n_shards=4,
+                     topology="star")
+
+    cfg = SVMConfig(C=10.0, gamma=10.0)
+    with pytest.raises(ValueError, match="n_shards=4"):
+        cascade_fit(Xs, Y, cfg,
+                    CascadeConfig(n_shards=8, sv_capacity=64,
+                                  topology="star"),
+                    checkpoint_path=ck, resume=True)
+    with pytest.raises(ValueError, match="topology='star'"):
+        cascade_fit(Xs, Y, cfg,
+                    CascadeConfig(n_shards=4, sv_capacity=64,
+                                  topology="tree"),
+                    checkpoint_path=ck, resume=True)
+    # shape mismatches still raise their specific error (pre-compile too)
+    with pytest.raises(ValueError, match="checkpoint shapes"):
+        cascade_fit(Xs, Y, cfg,
+                    CascadeConfig(n_shards=4, sv_capacity=32,
+                                  topology="star"),
+                    checkpoint_path=ck, resume=True)
+
+
+def test_cascade_round_is_an_injection_point(tmp_path):
+    """A kill rule at cascade.round dies before any device work — the
+    checkpoint (if any) is what survives, same as a real mid-run death."""
+    import jax
+
+    if not hasattr(jax, "shard_map"):
+        pytest.skip("installed jax lacks jax.shard_map (cascade untestable "
+                    "on this environment)")
+    from tpusvm.config import CascadeConfig
+    from tpusvm.parallel.cascade import cascade_fit
+
+    X, Y = rings(n=128, seed=3)
+    Xs = MinMaxScaler().fit_transform(X)
+    plan = faults.FaultPlan(
+        [_rule(point="cascade.round", kind="kill", at_hit=1)])
+    with pytest.raises(faults.SimulatedKill):
+        with faults.active(plan):
+            cascade_fit(Xs, Y, SVMConfig(C=10.0, gamma=10.0),
+                        CascadeConfig(n_shards=4, sv_capacity=64,
+                                      topology="star"))
+
+
+# ------------------------------------------------------------- reporting
+def test_fault_counters_reach_the_default_registry(tmp_path):
+    from tpusvm.obs.registry import default_registry, reset_default_registry
+    from tpusvm.stream import ShardReader
+
+    reset_default_registry()
+    try:
+        _, _, ds = _mk_dataset(tmp_path, n=150)
+        plan = faults.FaultPlan(
+            [_rule(point="stream.read_shard", kind="transient",
+                   max_hits=1)])
+        with faults.active(plan):
+            list(ShardReader(ds))
+        snap = default_registry().snapshot()
+        by_key = {(e["name"], tuple(sorted(e["labels"].items()))):
+                  e["value"] for e in snap["metrics"]}
+        assert by_key[("faults.injected",
+                       (("kind", "transient"),
+                        ("point", "stream.read_shard")))] == 1
+        assert by_key[("retry.recovered",
+                       (("op", "stream.read_shard"),))] == 1
+    finally:
+        reset_default_registry()

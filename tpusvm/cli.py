@@ -74,6 +74,14 @@ def _build_parser() -> argparse.ArgumentParser:
         parser.add_argument("--process-id", type=int, default=d,
                             help="with --distributed off-TPU: this "
                             "process's rank")
+        parser.add_argument(
+            "--faults", metavar="PLAN.json", default=d,
+            help="activate a seeded fault-injection plan (tpusvm.faults) "
+            "for this run: named injection points on the I/O and scoring "
+            "paths raise transients / inject latency / corrupt bytes / "
+            "simulate kills per the plan — deterministic chaos testing; "
+            "also honoured from the TPUSVM_FAULTS env var",
+        )
 
     common = argparse.ArgumentParser(add_help=False)
     add_shared(common, suppress=True)
@@ -164,10 +172,20 @@ def _build_parser() -> argparse.ArgumentParser:
     mode.add_argument("--sv-capacity", type=int, default=4096,
                       help="padded SV buffer capacity per shard")
     mode.add_argument("--checkpoint", metavar="NPZ",
-                      help="cascade: write per-round state here; with "
-                      "--resume, restart from it")
+                      help="crash-safe training: cascade mode writes "
+                      "per-round state here; single mode (blocked "
+                      "solver) writes the solver's outer-loop carry "
+                      "every --checkpoint-every rounds (atomic, "
+                      "format-versioned; resumed runs are bit-identical "
+                      "to uninterrupted ones); with --resume, restart "
+                      "from it")
     mode.add_argument("--resume", action="store_true",
-                      help="cascade: resume from --checkpoint if it exists")
+                      help="resume from --checkpoint if it exists "
+                      "(missing file = fresh run)")
+    mode.add_argument("--checkpoint-every", type=int, default=64,
+                      metavar="K",
+                      help="single-mode checkpoint cadence in outer "
+                      "rounds (default 64)")
     mode.add_argument("--multiclass", action="store_true",
                       help="one-vs-rest over all labels instead of the "
                       "reference's binary '1 vs rest' mapping")
@@ -260,6 +278,12 @@ def _build_parser() -> argparse.ArgumentParser:
                      "--smoke)")
     ing.add_argument("--rows-per-shard", type=int, default=65536,
                      help="rows per .npz shard (default 65536)")
+    ing.add_argument("--resume", action="store_true",
+                     help="continue a killed ingest of the SAME source "
+                     "from its journal (ingest.journal.json): verified "
+                     "durable shards are kept, remaining rows are "
+                     "re-streamed — the finished dataset is identical "
+                     "to an uninterrupted ingest")
     ing.add_argument("--block-rows", type=int, default=8192,
                      help="CSV streaming block size (peak ingest memory)")
     ing.add_argument("--smoke", action="store_true",
@@ -317,6 +341,19 @@ def _build_parser() -> argparse.ArgumentParser:
                     help="backpressure bound; full queue fast-fails")
     sv.add_argument("--timeout-ms", type=float, default=1000.0,
                     help="default per-request deadline")
+    sv.add_argument("--shed-threshold", type=float, default=None,
+                    metavar="FRAC",
+                    help="degraded mode: shed requests with OVERLOADED "
+                    "once the queue holds FRAC of its capacity "
+                    "(0 < FRAC <= 1; default: off — only the hard "
+                    "QUEUE_FULL bound applies)")
+    sv.add_argument("--breaker-threshold", type=int, default=5,
+                    help="consecutive scoring failures that trip a "
+                    "model's circuit breaker (requests then fail fast "
+                    "with UNAVAILABLE; default 5)")
+    sv.add_argument("--breaker-cooldown-s", type=float, default=30.0,
+                    help="open-breaker cooldown before a half-open "
+                    "probe is admitted (default 30)")
     sv.add_argument("--dtype", choices=["float32", "float64"],
                     default="float32", help="serving compute dtype")
     sv.add_argument("--no-warmup", action="store_true",
@@ -626,7 +663,9 @@ def _cmd_train(args) -> int:
         # --solver-opt material (passing them twice would TypeError in fit)
         flagged = {"C", "gamma", "eps", "tau", "max_iter", "accum_dtype",
                    "kernel", "degree", "coef0"}
-        reserved = {"X", "Y", "valid", "alpha0", "sn", "targets"} | flagged
+        reserved = {"X", "Y", "valid", "alpha0", "sn", "targets",
+                    # the checkpoint driver's internal resume surface
+                    "resume_state", "pause_at", "return_state"} | flagged
         known = set(inspect.signature(fn).parameters) - reserved
         bad = sorted(set(solver_opts) - known)
         if bad:
@@ -666,9 +705,25 @@ def _cmd_train(args) -> int:
                          "shards the one-vs-rest class axis)")
     if args.resume and not args.checkpoint:
         raise SystemExit("--resume requires --checkpoint")
-    if args.checkpoint and args.mode != "cascade":
-        raise SystemExit("--checkpoint/--resume only apply to --mode cascade "
-                         "(per-round cascade state is what gets persisted)")
+    if args.checkpoint:
+        if args.mode == "oracle":
+            raise SystemExit("--checkpoint applies to --mode single "
+                             "(solver-state checkpoints) or cascade "
+                             "(per-round state); the NumPy oracle has no "
+                             "checkpointable structure")
+        if args.mode == "single":
+            solver_name = args.solver or ("pair" if args.multiclass
+                                          else "blocked")
+            if args.multiclass or args.task == "svr" \
+                    or solver_name != "blocked":
+                raise SystemExit(
+                    "--checkpoint with --mode single needs the binary "
+                    "blocked solver (the outer-loop carry is what gets "
+                    "persisted); multiclass/svr checkpointing is a "
+                    "future PR"
+                )
+        if args.checkpoint_every < 1:
+            raise SystemExit("--checkpoint-every must be >= 1")
     if args.stratify and args.mode != "cascade":
         raise SystemExit("--stratify only applies to --mode cascade (it "
                          "changes how shards are dealt over the mesh)")
@@ -694,6 +749,10 @@ def _cmd_train(args) -> int:
         from tpusvm.obs import Tracer
 
         tracer = Tracer(args.trace, argv=["train"])
+        # fault/retry/breaker lifecycle events land in the same trace
+        from tpusvm import faults as _faults
+
+        _faults.set_event_sink(tracer.event)
     log = RunLogger(jsonl_path=args.jsonl,
                     primary=(jax.process_index() == 0) and not args.quiet)
     timer = PhaseTimer(tracer=tracer)
@@ -790,9 +849,15 @@ def _cmd_train(args) -> int:
                          model.cascade_rounds_,
                          model.status_.name == "CONVERGED")
             elif dataset is not None:
-                model.fit_stream(dataset)
+                model.fit_stream(dataset,
+                                 checkpoint_path=args.checkpoint,
+                                 checkpoint_every=args.checkpoint_every,
+                                 resume=args.resume)
             else:
-                model.fit(X, Y)
+                model.fit(X, Y,
+                          checkpoint_path=args.checkpoint,
+                          checkpoint_every=args.checkpoint_every,
+                          resume=args.resume)
 
     if not args.multiclass:
         log.info("iterations = %d", model.n_iter_)
@@ -938,6 +1003,10 @@ def _cmd_ingest(args) -> int:
         from tpusvm.obs import Tracer
 
         tracer = Tracer(args.trace, argv=["ingest"])
+        # fault/retry/breaker lifecycle events land in the same trace
+        from tpusvm import faults as _faults
+
+        _faults.set_event_sink(tracer.event)
     timer = PhaseTimer(tracer=tracer)
 
     with timer.phase("ingest"):
@@ -947,6 +1016,7 @@ def _cmd_ingest(args) -> int:
                 n_limit=args.n_limit, binary=not args.multiclass,
                 positive_label=args.positive_label,
                 block_rows=args.block_rows,
+                resume=args.resume,
             )
         else:
             # synthetic generators are in-memory anyway; shard their output
@@ -957,6 +1027,7 @@ def _cmd_ingest(args) -> int:
                 binary=not args.multiclass,
                 positive_label=(None if args.multiclass
                                 else args.positive_label),
+                resume=args.resume,
             )
 
     with timer.phase("validate"):
@@ -1138,12 +1209,19 @@ def _cmd_serve(args) -> int:
         max_delay_ms=args.max_delay_ms,
         queue_size=args.queue_size,
         timeout_ms=args.timeout_ms,
+        shed_threshold=args.shed_threshold,
+        breaker_threshold=args.breaker_threshold,
+        breaker_cooldown_s=args.breaker_cooldown_s,
     )
     tracer = None
     if args.trace:
         from tpusvm.obs import Tracer
 
         tracer = Tracer(args.trace, argv=["serve"])
+        # fault/retry/breaker lifecycle events land in the same trace
+        from tpusvm import faults as _faults
+
+        _faults.set_event_sink(tracer.event)
 
     def _trace_final_metrics():
         if tracer is None:
@@ -1319,6 +1397,10 @@ def _cmd_tune(args) -> int:
         from tpusvm.obs import Tracer
 
         tracer = Tracer(args.trace, argv=["tune"])
+        # fault/retry/breaker lifecycle events land in the same trace
+        from tpusvm import faults as _faults
+
+        _faults.set_event_sink(tracer.event)
     timer = PhaseTimer(tracer=tracer)
     dataset = None
     if args.data:
@@ -1574,8 +1656,23 @@ def _cmd_info(args) -> int:
 
 
 def main(argv=None) -> int:
+    import os
+
     parser = _build_parser()
     args = parser.parse_args(argv)
+    plan_path = args.faults or os.environ.get("TPUSVM_FAULTS")
+    if plan_path:
+        # chaos mode: activate the seeded fault plan before any subsystem
+        # touches its injection points, so hit counting starts at 0
+        from tpusvm import faults
+
+        try:
+            plan = faults.load_plan(plan_path)
+        except (OSError, ValueError) as e:
+            parser.error(f"--faults: {e}")
+        faults.activate(plan)
+        print(f"fault plan active: {plan_path} "
+              f"(seed {plan.seed}, {len(plan.rules)} rules)")
     if not args.distributed and (
         args.coordinator_address
         or args.num_processes is not None

@@ -1,0 +1,74 @@
+"""tpusvm.faults — deterministic fault injection + the hardening it forces.
+
+Four pieces (ISSUE 7):
+
+  injection.py  named injection points at real call sites + a seeded,
+                JSON-configured FaultPlan (``--faults plan.json`` /
+                ``TPUSVM_FAULTS``) that raises transients, injects
+                latency, corrupts bytes, or simulates kills — every
+                chaos run reproducible.
+  retry.py      the one Retry(policy) primitive (exponential backoff,
+                seeded jitter, per-class retryability) adopted by shard
+                reads, ingest writes, checkpoint writes and serve's
+                scoring path.
+  breaker.py    the per-model circuit breaker behind degraded-mode
+                serving (trip on consecutive failures, half-open probe
+                recovery).
+  (solver/checkpoint.py holds the crash-safe-training side: periodic
+  bit-exact solver checkpoints this package's kills are aimed at.)
+
+``python -m tpusvm.faults kill-resume-smoke`` is the CI chaos gate for
+crash-safe training: kill at a checkpoint, resume, assert the model is
+bit-identical to an uninterrupted run.
+"""
+
+from tpusvm.faults.breaker import BreakerOpenError, CircuitBreaker
+from tpusvm.faults.injection import (
+    KINDS,
+    PLAN_FORMAT_VERSION,
+    POINTS,
+    FaultError,
+    FaultPlan,
+    FaultRule,
+    SimulatedKill,
+    TransientIOError,
+    activate,
+    active,
+    active_plan,
+    deactivate,
+    emit,
+    load_plan,
+    point,
+    set_event_sink,
+)
+from tpusvm.faults.retry import (
+    DEFAULT_IO_POLICY,
+    Retry,
+    RetryExhaustedError,
+    RetryPolicy,
+)
+
+__all__ = [
+    "BreakerOpenError",
+    "CircuitBreaker",
+    "DEFAULT_IO_POLICY",
+    "FaultError",
+    "FaultPlan",
+    "FaultRule",
+    "KINDS",
+    "PLAN_FORMAT_VERSION",
+    "POINTS",
+    "Retry",
+    "RetryExhaustedError",
+    "RetryPolicy",
+    "SimulatedKill",
+    "TransientIOError",
+    "activate",
+    "active",
+    "active_plan",
+    "deactivate",
+    "emit",
+    "load_plan",
+    "point",
+    "set_event_sink",
+]

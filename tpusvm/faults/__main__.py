@@ -1,0 +1,153 @@
+"""Chaos CLI: ``python -m tpusvm.faults <command>``.
+
+Commands:
+
+  kill-resume-smoke   The crash-safe-training CI gate. Trains a tiny
+                      deterministic problem three ways — uninterrupted
+                      plain solve, checkpointed solve, and checkpointed
+                      solves KILLED at every checkpoint in turn and then
+                      resumed — and asserts every variant produces
+                      bit-identical model state (alpha bytes, SV ids, b).
+                      Also proves transient checkpoint-write faults are
+                      retried to success. Non-zero exit on any failure.
+  validate PLAN.json  Parse + validate a fault plan (rule points/kinds
+                      checked against the registry); prints the rules.
+"""
+
+from __future__ import annotations
+
+import sys
+import tempfile
+
+
+def _kill_resume_smoke() -> int:
+    import os
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_enable_x64", True)
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tpusvm import faults
+    from tpusvm.data import MinMaxScaler, rings
+    from tpusvm.oracle.smo import get_sv_indices
+    from tpusvm.solver.blocked import blocked_smo_solve
+    from tpusvm.solver.checkpoint import checkpointed_blocked_solve
+    from tpusvm.status import Status
+
+    EVERY = 4
+    X, Y = rings(n=400, seed=11)
+    Xs = jnp.asarray(MinMaxScaler().fit_transform(X), jnp.float32)
+    Yd = jnp.asarray(Y)
+    kw = dict(C=10.0, gamma=10.0, q=16, accum_dtype=jnp.float64)
+
+    plain = blocked_smo_solve(Xs, Yd, **kw)
+    if Status(int(plain.status)) != Status.CONVERGED:
+        print(f"KILL-RESUME SMOKE FAILED: reference solve ended "
+              f"{Status(int(plain.status)).name}")
+        return 1
+    ref_alpha = np.asarray(plain.alpha)
+    ref_sv = get_sv_indices(ref_alpha, 1e-8)
+    n_ckpts = max(1, int(plain.n_outer) // EVERY)
+    failures = []
+
+    def run(ck, resume=False):
+        return checkpointed_blocked_solve(
+            Xs, Yd, checkpoint_path=ck, checkpoint_every=EVERY,
+            resume=resume, **kw,
+        )
+
+    def identical(res):
+        a = np.asarray(res.alpha)
+        return (a.tobytes() == ref_alpha.tobytes()
+                and np.array_equal(get_sv_indices(a, 1e-8), ref_sv)
+                and float(res.b) == float(plain.b))
+
+    with tempfile.TemporaryDirectory() as td:
+        # 1. checkpointed-but-never-killed == plain, bit for bit
+        ck = os.path.join(td, "ck.npz")
+        if not identical(run(ck)):
+            failures.append("uninterrupted checkpointed solve diverged "
+                            "from the plain solve")
+
+        # 2. kill at EVERY checkpoint, resume, still bit-identical
+        for k in range(1, n_ckpts + 1):
+            ckk = os.path.join(td, f"ck{k}.npz")
+            plan = faults.FaultPlan(
+                [faults.FaultRule(point="solver.outer_checkpoint",
+                                  kind="kill", at_hit=k)], seed=0)
+            died = False
+            try:
+                with faults.active(plan):
+                    run(ckk)
+            except faults.SimulatedKill:
+                died = True
+            if not died:
+                failures.append(f"kill rule at checkpoint {k} never fired")
+                continue
+            if not identical(run(ckk, resume=True)):
+                failures.append(
+                    f"resume after kill at checkpoint {k} is not "
+                    "bit-identical")
+
+        # 3. transient write faults are retried to success
+        ckt = os.path.join(td, "ckt.npz")
+        plan = faults.FaultPlan(
+            [faults.FaultRule(point="solver.outer_checkpoint",
+                              kind="transient", max_hits=2)], seed=0)
+        with faults.active(plan):
+            if not identical(run(ckt)):
+                failures.append("solve under transient checkpoint-write "
+                                "faults diverged")
+
+    if failures:
+        for f in failures:
+            print(f"KILL-RESUME SMOKE FAILED: {f}")
+        return 1
+    print(f"kill-resume smoke ok: {n_ckpts} kill points, "
+          f"{int(plain.n_outer)} outer rounds, {len(ref_sv)} SVs — every "
+          "resumed solve bit-identical to the uninterrupted run")
+    return 0
+
+
+def _validate(path: str) -> int:
+    from tpusvm import faults
+
+    plan = faults.load_plan(path)
+    print(f"fault plan ok: {path} (seed {plan.seed}, "
+          f"{len(plan.rules)} rules)")
+    for r in plan.rules:
+        extra = ""
+        if r.at_hit is not None:
+            extra = f" at_hit={r.at_hit}"
+        elif r.max_hits is not None:
+            extra = f" p={r.p:g} max_hits={r.max_hits}"
+        else:
+            extra = f" p={r.p:g}"
+        if r.kind == "latency":
+            extra += f" delay_ms={r.delay_ms:g}"
+        print(f"  {r.point}: {r.kind}{extra}")
+    return 0
+
+
+def main(argv=None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    if not argv or argv[0] in ("-h", "--help"):
+        print(__doc__)
+        return 0 if argv else 2
+    cmd, rest = argv[0], argv[1:]
+    if cmd == "kill-resume-smoke":
+        return _kill_resume_smoke()
+    if cmd == "validate":
+        if len(rest) != 1:
+            print("usage: python -m tpusvm.faults validate PLAN.json")
+            return 2
+        return _validate(rest[0])
+    print(f"unknown command {cmd!r}; see --help")
+    return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

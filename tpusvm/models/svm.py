@@ -101,13 +101,27 @@ class BinarySVC:
             return self.scaler_.transform(X)
         return X
 
-    def fit(self, X: np.ndarray, Y: np.ndarray) -> "BinarySVC":
-        """Single-chip on-device SMO training (gpu_svm_main3.cu capability)."""
+    def fit(self, X: np.ndarray, Y: np.ndarray,
+            checkpoint_path: Optional[str] = None,
+            checkpoint_every: int = 64,
+            resume: bool = False) -> "BinarySVC":
+        """Single-chip on-device SMO training (gpu_svm_main3.cu capability).
+
+        checkpoint_path: crash-safe training (blocked solver only) — the
+        solver's outer-loop carry is snapshotted atomically every
+        `checkpoint_every` outer rounds, and resume=True restarts from
+        the file, BIT-IDENTICAL to an uninterrupted fit
+        (solver/checkpoint.py; a missing file means a fresh start)."""
         t0 = time.perf_counter()
         Xs = self._scale_fit(np.asarray(X))
-        return self._fit_scaled(Xs, Y, t0)
+        return self._fit_scaled(Xs, Y, t0, checkpoint_path=checkpoint_path,
+                                checkpoint_every=checkpoint_every,
+                                resume=resume)
 
-    def fit_stream(self, dataset) -> "BinarySVC":
+    def fit_stream(self, dataset,
+                   checkpoint_path: Optional[str] = None,
+                   checkpoint_every: int = 64,
+                   resume: bool = False) -> "BinarySVC":
         """Single-chip fit from a sharded dataset (tpusvm.stream).
 
         The scaler is fitted from MANIFEST statistics (bit-identical to a
@@ -115,6 +129,7 @@ class BinarySVC:
         stream in, so the raw array is never materialised. The SCALED
         matrix is — single-chip SMO needs every row on device; use
         fit_cascade_stream when per-leaf loading is the point.
+        checkpoint_path/resume: see fit().
         """
         from tpusvm.stream.reader import ShardReader
 
@@ -125,16 +140,18 @@ class BinarySVC:
         parts = [X for X, _ in ShardReader(dataset, scaler=scaler)]
         Xs = np.concatenate(parts)
         del parts
-        return self._fit_scaled(Xs, dataset.load_labels(), t0)
+        return self._fit_scaled(Xs, dataset.load_labels(), t0,
+                                checkpoint_path=checkpoint_path,
+                                checkpoint_every=checkpoint_every,
+                                resume=resume)
 
-    def _fit_scaled(self, Xs: np.ndarray, Y: np.ndarray,
-                    t0: float) -> "BinarySVC":
+    def _fit_scaled(self, Xs: np.ndarray, Y: np.ndarray, t0: float,
+                    checkpoint_path: Optional[str] = None,
+                    checkpoint_every: int = 64,
+                    resume: bool = False) -> "BinarySVC":
         """Shared solve + SV extraction on an already-scaled matrix."""
         cfg = self.config
-        solve = blocked_smo_solve if self.solver == "blocked" else smo_solve
-        res = solve(
-            jnp.asarray(Xs, self.dtype),
-            jnp.asarray(Y),
+        kw = dict(
             C=cfg.C,
             gamma=cfg.gamma,
             eps=cfg.eps,
@@ -146,6 +163,24 @@ class BinarySVC:
             accum_dtype=resolve_accum_dtype(self.accum_dtype),
             **self.solver_opts,
         )
+        if checkpoint_path is not None:
+            if self.solver != "blocked":
+                raise ValueError(
+                    "checkpoint_path requires the blocked solver (the "
+                    "outer-loop carry is what gets persisted); the pair "
+                    "solver has no checkpointable round structure"
+                )
+            from tpusvm.solver.checkpoint import checkpointed_blocked_solve
+
+            res = checkpointed_blocked_solve(
+                jnp.asarray(Xs, self.dtype), jnp.asarray(Y),
+                checkpoint_path=checkpoint_path,
+                checkpoint_every=checkpoint_every, resume=resume, **kw,
+            )
+        else:
+            solve = (blocked_smo_solve if self.solver == "blocked"
+                     else smo_solve)
+            res = solve(jnp.asarray(Xs, self.dtype), jnp.asarray(Y), **kw)
         alpha = np.asarray(res.alpha)  # device->host copy = completion barrier
         self.train_time_s_ = time.perf_counter() - t0
         tele = getattr(res, "telemetry", None)

@@ -50,6 +50,7 @@ import numpy as np
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
+from tpusvm import faults
 from tpusvm.config import CascadeConfig, SVMConfig, resolve_accum_dtype
 from tpusvm.data.partition import partition as make_partition
 from tpusvm.parallel.mesh import CASCADE_AXIS, make_mesh
@@ -76,13 +77,24 @@ _CKPT_VERSION = 1
 
 
 def save_round_state(path: str, global_sv: SVBuffer, prev_ids, rnd: int,
-                     b: float) -> None:
+                     b: float, n_shards: Optional[int] = None,
+                     topology: Optional[str] = None) -> None:
     """Persist the cascade's inter-round state (SURVEY.md §5.4: the
     broadcast global-SV set IS the reference's in-memory checkpoint; this
     writes it out). Atomic via temp-file rename so a crash mid-write never
-    corrupts the previous checkpoint."""
+    corrupts the previous checkpoint.
+
+    n_shards/topology, when given, are stored so a resume under a
+    DIFFERENT partition or merge topology is refused with a config error
+    instead of silently walking a different cascade (the SV-buffer shapes
+    alone cannot tell 4 shards from 8)."""
     import os
 
+    extra = {}
+    if n_shards is not None:
+        extra["n_shards"] = n_shards
+    if topology is not None:
+        extra["topology"] = topology
     tmp = path + ".tmp"
     np.savez_compressed(
         tmp,
@@ -95,9 +107,33 @@ def save_round_state(path: str, global_sv: SVBuffer, prev_ids, rnd: int,
         sv_alpha=np.asarray(global_sv.alpha),
         sv_ids=np.asarray(global_sv.ids),
         sv_valid=np.asarray(global_sv.valid),
+        **extra,
     )
     # np.savez appends .npz to the temp name
     os.replace(tmp + ".npz", path)
+
+
+def check_round_state_config(path: str, n_shards: int,
+                             topology: str) -> None:
+    """Refuse a checkpoint written under a different cascade config.
+
+    Older checkpoints (no stored config) pass — the shape checks still
+    apply; checkpoints that DO carry config must match exactly."""
+    with np.load(path, allow_pickle=False) as z:
+        if "n_shards" in z.files and int(z["n_shards"]) != n_shards:
+            raise ValueError(
+                f"cascade checkpoint config mismatch: it was written for "
+                f"n_shards={int(z['n_shards'])}, this run partitions into "
+                f"{n_shards}; resume with the original shard count or "
+                "start fresh without --resume"
+            )
+        if "topology" in z.files and str(z["topology"]) != topology:
+            raise ValueError(
+                f"cascade checkpoint config mismatch: it was written for "
+                f"topology={str(z['topology'])!r}, this run uses "
+                f"{topology!r}; resume with the original topology or "
+                "start fresh without --resume"
+            )
 
 
 def load_round_state(path: str, dtype=jnp.float32):
@@ -492,11 +528,6 @@ def cascade_fit(
     )
     global_sv = empty(sv_cap, d, dtype)
 
-    round_fn = _build_round_fn(
-        mesh, cc.topology, n_shards, train_cap, merged_cap, sv_cap,
-        svm_config, accum_dtype, solver, dict(solver_opts or {}),
-    )
-
     prev_ids: set = set()  # reference: global_ID_sv starts empty
     history: List[Dict[str, Any]] = []
     converged = False
@@ -504,6 +535,9 @@ def cascade_fit(
     b = 0.0
     start_round = 1
 
+    # resume BEFORE building/compiling the round function: a refused
+    # checkpoint (wrong shapes, wrong partition/topology) fails in
+    # milliseconds instead of after the shard_map compile
     if resume and checkpoint_path is not None:
         import os
 
@@ -514,6 +548,8 @@ def cascade_fit(
             # below: peers would block in process_allgather forever —
             # fold it into the fingerprint (status=2) and raise after
             try:
+                check_round_state_config(checkpoint_path, n_shards,
+                                         cc.topology)
                 global_sv, prev_ids, start_round, b = load_round_state(
                     checkpoint_path, dtype
                 )
@@ -547,12 +583,23 @@ def cascade_fit(
                     stacklevel=2,
                 )
 
+    round_fn = _build_round_fn(
+        mesh, cc.topology, n_shards, train_cap, merged_cap, sv_cap,
+        svm_config, accum_dtype, solver, dict(solver_opts or {}),
+    )
+
     # fallback result if the loop body never runs (resumed past max_rounds)
     new_global = jax.tree.map(np.asarray, global_sv)
 
     full_merged_cap = n_shards * sv_cap  # star layer-2 concatenation bound
 
+    round_retry = faults.Retry(faults.DEFAULT_IO_POLICY, op="cascade.round")
     for rnd in range(start_round, svm_config.max_rounds + 1):
+        # chaos hook: transient rules here are retried with backoff (the
+        # round has not started — nothing to roll back); a kill rule
+        # simulates dying between rounds, and resume must then reproduce
+        # the uninterrupted trajectory from the checkpoint
+        round_retry(faults.point, "cascade.round", round=rnd)
         t0 = time.perf_counter()
         round_span = (tracer.span("cascade.round", round=rnd)
                       if tracer else contextlib.nullcontext())
@@ -683,7 +730,8 @@ def cascade_fit(
             # only process 0 persists it — the reference's rank-0-only IO
             # pattern (SURVEY.md §5.5), and it avoids a same-file rename
             # race on a shared filesystem
-            save_round_state(checkpoint_path, new_global, prev_ids, rnd, b)
+            save_round_state(checkpoint_path, new_global, prev_ids, rnd, b,
+                             n_shards=n_shards, topology=cc.topology)
 
         if converged:
             break

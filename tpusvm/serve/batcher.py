@@ -20,6 +20,14 @@ latency — the Clipper/SLO-serving discipline. Per-request timeouts bound
 the other tail: a client stops waiting after its deadline, and the worker
 drops requests that are already dead on arrival rather than paying kernel
 time for an answer nobody reads.
+
+Degraded mode (tpusvm.faults round): an optional shed_at threshold answers
+OVERLOADED before the hard bound is reached (deliberate load shedding a
+dashboard can tell apart from a mis-sized queue); a BreakerOpenError from
+the scoring callback fails the batch with UNAVAILABLE (the model's circuit
+breaker is open — no kernel time spent); drain() stops admission
+(DRAINING) and waits, via an in-queue barrier, for everything already
+accepted to resolve — the zero-downtime-restart primitive.
 """
 
 from __future__ import annotations
@@ -32,6 +40,7 @@ from typing import Callable, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from tpusvm.faults.breaker import BreakerOpenError
 from tpusvm.status import ServeStatus
 
 # run_batch: (m, d) scaled-or-raw rows -> (scores, labels) with leading dim m
@@ -67,26 +76,63 @@ class _Request:
 _SENTINEL = object()
 
 
+class _DrainBarrier:
+    """Queue marker for drain(): its event fires once every request that
+    was enqueued before it has been scored (or failed)."""
+
+    __slots__ = ("event",)
+
+    def __init__(self):
+        self.event = threading.Event()
+
+
 class MicroBatcher:
-    """Bounded request queue + one scoring worker for a single model."""
+    """Bounded request queue + one scoring worker for a single model.
+
+    shed_at: load-shedding threshold (requests observed while the queue
+    already holds >= shed_at entries come back OVERLOADED immediately —
+    deliberate degraded-mode shedding, distinct from the hard QUEUE_FULL
+    bound). None (default) disables shedding.
+    """
 
     def __init__(self, run_batch: RunBatch, *, max_batch: int = 64,
                  max_delay_s: float = 0.002, queue_size: int = 1024,
-                 timeout_s: float = 1.0, metrics=None):
+                 timeout_s: float = 1.0, metrics=None,
+                 shed_at: Optional[int] = None):
         if max_batch < 1:
             raise ValueError(f"max_batch must be >= 1, got {max_batch}")
+        if shed_at is not None and shed_at < 1:
+            raise ValueError(f"shed_at must be >= 1, got {shed_at}")
         self.run_batch = run_batch
         self.max_batch = max_batch
         self.max_delay_s = max_delay_s
         self.timeout_s = timeout_s
         self.metrics = metrics
+        self.shed_at = shed_at
         self._q: "queue.Queue" = queue.Queue(maxsize=queue_size)
         self._closed = False
+        self._draining = False
+        self._barriers: List[_DrainBarrier] = []  # worker-thread only
         self._worker = threading.Thread(target=self._run, daemon=True,
                                         name="tpusvm-serve-batcher")
         self._worker.start()
 
     # ------------------------------------------------------------- client
+    def _reject(self, t0: float) -> Optional[ServeResult]:
+        """Admission control shared by submit paths: draining beats
+        shedding beats the hard queue bound (checked at put time)."""
+        if self._draining:
+            if self.metrics:
+                self.metrics.inc("draining")
+            return ServeResult(ServeStatus.DRAINING,
+                               latency_s=time.monotonic() - t0)
+        if self.shed_at is not None and self._q.qsize() >= self.shed_at:
+            if self.metrics:
+                self.metrics.inc("overloaded")
+            return ServeResult(ServeStatus.OVERLOADED,
+                               latency_s=time.monotonic() - t0)
+        return None
+
     def submit(self, x: np.ndarray,
                timeout_s: Optional[float] = None) -> ServeResult:
         """Score one row; blocks until a result or the deadline."""
@@ -94,6 +140,9 @@ class MicroBatcher:
             return ServeResult(ServeStatus.SHUTDOWN)
         timeout = self.timeout_s if timeout_s is None else timeout_s
         t0 = time.monotonic()
+        rejected = self._reject(t0)
+        if rejected is not None:
+            return rejected
         req = _Request(x, t0, t0 + timeout if timeout is not None else None)
         if self.metrics:
             self.metrics.inc("requests")
@@ -132,6 +181,11 @@ class MicroBatcher:
         reqs: List[Optional[_Request]] = []
         results: List[Optional[ServeResult]] = []
         for x in rows:
+            rejected = self._reject(t0)
+            if rejected is not None:
+                reqs.append(None)
+                results.append(rejected)
+                continue
             req = _Request(x, t0, deadline)
             if self.metrics:
                 self.metrics.inc("requests")
@@ -169,12 +223,42 @@ class MicroBatcher:
     def depth(self) -> int:
         return self._q.qsize()
 
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
+    def drain(self, timeout_s: float = 10.0) -> bool:
+        """Stop admitting requests (they come back DRAINING) and wait for
+        everything already queued to complete. True if quiesced in time.
+        Idempotent; safe to close() afterwards."""
+        if self._closed:
+            return True
+        self._draining = True
+        bar = _DrainBarrier()
+        try:
+            self._q.put(bar, timeout=timeout_s)
+        except queue.Full:
+            return False
+        return bar.event.wait(timeout_s)
+
     def close(self, timeout_s: float = 5.0) -> None:
         if self._closed:
             return
         self._closed = True
         self._q.put(_SENTINEL)
         self._worker.join(timeout=timeout_s)
+        # final sweep: requests that raced past the _closed check while
+        # the worker was exiting must still resolve (no dropped futures)
+        while True:
+            try:
+                req = self._q.get_nowait()
+            except queue.Empty:
+                break
+            if isinstance(req, _DrainBarrier):
+                req.event.set()
+            elif req is not _SENTINEL:
+                req.result = ServeResult(ServeStatus.SHUTDOWN)
+                req.event.set()
 
     # ------------------------------------------------------------- worker
     def _collect(self) -> Optional[List[_Request]]:
@@ -195,6 +279,12 @@ class MicroBatcher:
             first = self._q.get()
             if first is _SENTINEL:
                 return None
+            if isinstance(first, _DrainBarrier):
+                # everything enqueued before the barrier is already
+                # scored (the previous batch completed before this
+                # _collect): the drain is complete at this point
+                first.event.set()
+                continue
             batch = [first]
             while len(batch) < self.max_batch:
                 try:
@@ -203,6 +293,10 @@ class MicroBatcher:
                     break
                 if req is _SENTINEL:
                     self._q.put(_SENTINEL)
+                    return batch
+                if isinstance(req, _DrainBarrier):
+                    # fire only after THIS batch (its predecessors) runs
+                    self._barriers.append(req)
                     return batch
                 batch.append(req)
             flush_at = first.enq_t + self.max_delay_s
@@ -219,8 +313,16 @@ class MicroBatcher:
                     # re-queued sentinel and exits
                     self._q.put(_SENTINEL)
                     break
+                if isinstance(req, _DrainBarrier):
+                    self._barriers.append(req)
+                    break
                 batch.append(req)
             return batch
+
+    def _fire_barriers(self) -> None:
+        for bar in self._barriers:
+            bar.event.set()
+        self._barriers.clear()
 
     def _run(self) -> None:
         while True:
@@ -238,10 +340,21 @@ class MicroBatcher:
                 else:
                     live.append(req)
             if not live:
+                self._fire_barriers()
                 continue
             X = np.stack([r.x for r in live])
             try:
                 scores, labels = self.run_batch(X)
+            except BreakerOpenError:
+                # the model's circuit breaker refused the batch before
+                # any kernel time was spent: degraded mode, not an error
+                if self.metrics:
+                    self.metrics.inc("unavailable", len(live))
+                for req in live:
+                    req.result = ServeResult(ServeStatus.UNAVAILABLE)
+                    req.event.set()
+                self._fire_barriers()
+                continue
             except Exception:  # noqa: BLE001 — a scoring failure must fail
                 # the batch's requests, never kill the worker
                 if self.metrics:
@@ -249,6 +362,7 @@ class MicroBatcher:
                 for req in live:
                     req.result = ServeResult(ServeStatus.ERROR)
                     req.event.set()
+                self._fire_barriers()
                 continue
             if self.metrics:
                 self.metrics.inc("ok", len(live))
@@ -256,6 +370,7 @@ class MicroBatcher:
                 req.result = ServeResult(ServeStatus.OK, scores=scores[i],
                                          label=labels[i])
                 req.event.set()
+            self._fire_barriers()
         # drain anything still queued so no client waits out its full
         # timeout against a dead worker
         while True:
@@ -263,6 +378,8 @@ class MicroBatcher:
                 req = self._q.get_nowait()
             except queue.Empty:
                 break
-            if req is not _SENTINEL:
+            if isinstance(req, _DrainBarrier):
+                req.event.set()
+            elif req is not _SENTINEL:
                 req.result = ServeResult(ServeStatus.SHUTDOWN)
                 req.event.set()

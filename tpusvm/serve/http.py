@@ -7,7 +7,14 @@ HTTP layer adds no batching logic of its own. No third-party dependencies
 (the container bans installs; stdlib is the point).
 
 Routes:
-  GET  /healthz                   {"status": "ok"}
+  GET  /healthz                   {"status": "ok"|"degraded"|"draining",
+                                   "models": {name: breaker state}};
+                                  HTTP 200 while serving (degraded
+                                  included — other models still work),
+                                  503 once draining
+  POST /admin/drain               stop admitting requests, wait for
+                                  in-flight work ({"drained": bool});
+                                  the zero-downtime-restart hook
   GET  /v1/models                 hosted-model summaries (Server.status())
   GET  /v1/models/<name>/metrics  one model's metrics JSON
   GET  /metrics                   plaintext metrics for every model
@@ -20,6 +27,13 @@ Routes:
                                   exact predict_proba arithmetic); SVR
                                   models serve the regressed value as the
                                   prediction.
+
+Degraded-mode response codes (per-request detail always in `statuses`):
+  200  every row scored
+  429  load shed (OVERLOADED) or backpressure (QUEUE_FULL) — retryable
+       after backoff; Retry-After: 1 is set
+  503  the model's breaker is open (UNAVAILABLE), the server is
+       draining (DRAINING), or a scoring error/timeout
 """
 
 from __future__ import annotations
@@ -52,12 +66,24 @@ class _Handler(BaseHTTPRequestHandler):
         self.end_headers()
         self.wfile.write(body)
 
-    def _send_json(self, obj, code: int = 200) -> None:
-        self._send(code, json.dumps(obj).encode(), "application/json")
+    def _send_json(self, obj, code: int = 200,
+                   retry_after: bool = False) -> None:
+        body = json.dumps(obj).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        if retry_after:
+            self.send_header("Retry-After", "1")
+        self.end_headers()
+        self.wfile.write(body)
 
     def do_GET(self):  # noqa: N802 — BaseHTTPRequestHandler API
         if self.path == "/healthz":
-            self._send_json({"status": "ok"})
+            health = self._srv.health()
+            self._send_json(
+                health,
+                code=503 if health["status"] == "draining" else 200,
+            )
         elif self.path == "/metrics":
             self._send(200, self._srv.metrics_text().encode(),
                        "text/plain; version=0.0.4")
@@ -73,6 +99,10 @@ class _Handler(BaseHTTPRequestHandler):
             self._send_json({"error": f"no route {self.path}"}, code=404)
 
     def do_POST(self):  # noqa: N802 — BaseHTTPRequestHandler API
+        if self.path == "/admin/drain":
+            ok = self._srv.drain()
+            self._send_json({"drained": ok})
+            return
         if not (self.path.startswith("/v1/models/")
                 and self.path.endswith(":predict")):
             self._send_json({"error": f"no route {self.path}"}, code=404)
@@ -97,6 +127,15 @@ class _Handler(BaseHTTPRequestHandler):
             return
         statuses = [ServeStatus(r.status).name for r in results]
         ok = all(r.ok for r in results)
+        st_set = {ServeStatus(r.status) for r in results}
+        if ok:
+            code = 200
+        elif st_set & {ServeStatus.UNAVAILABLE, ServeStatus.DRAINING,
+                       ServeStatus.ERROR, ServeStatus.TIMEOUT,
+                       ServeStatus.SHUTDOWN}:
+            code = 503  # not retryable-by-backoff alone
+        else:
+            code = 429  # OVERLOADED / QUEUE_FULL: back off and retry
         body = {
             "predictions": [
                 None if r.label is None else np.asarray(r.label).item()
@@ -120,12 +159,7 @@ class _Handler(BaseHTTPRequestHandler):
                 else float(platt_proba(np.asarray(r.scores), *entry.platt))
                 for r in results
             ]
-        self._send_json(
-            body,
-            # load-induced rejections map to 503 (retryable), per-request
-            # detail stays in `statuses`
-            code=200 if ok else 503,
-        )
+        self._send_json(body, code=code, retry_after=code in (429, 503))
 
 
 def make_http_server(server, host: str = "127.0.0.1", port: int = 8471,

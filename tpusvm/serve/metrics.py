@@ -36,6 +36,13 @@ _COUNTERS = (
     "queue_full",    # rows fast-failed by backpressure (never enqueued)
     "batches",       # flushes executed by the micro-batcher
     "recompiles",    # bucket compiles AFTER warm-up (steady state target: 0)
+    # degraded-mode serving (tpusvm.faults round):
+    "overloaded",    # rows shed by the load-shedding threshold
+    "unavailable",   # rows refused because the circuit breaker is open
+    "draining",      # rows refused because the server is draining
+    "retries",       # scoring attempts re-run by the retry policy
+    "breaker_trips",       # closed -> open transitions
+    "breaker_recoveries",  # half-open probe succeeded, breaker closed
 )
 
 

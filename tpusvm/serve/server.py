@@ -25,6 +25,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 import jax.numpy as jnp
 import numpy as np
 
+from tpusvm import faults
 from tpusvm.serve.batcher import MicroBatcher, ServeResult
 from tpusvm.serve.buckets import CompileCache, default_buckets
 from tpusvm.serve.metrics import Metrics
@@ -41,6 +42,18 @@ class ServeConfig:
     timeout_ms: float = 1000.0   # default per-request deadline
     buckets: Optional[Tuple[int, ...]] = None  # default: powers of two
     block: int = 2048            # binary scorer's scan block
+    # degraded-mode knobs (tpusvm.faults):
+    # load shedding: requests arriving while the queue holds >= this
+    # fraction of queue_size come back OVERLOADED instead of queueing;
+    # None = off (the hard QUEUE_FULL bound alone, the pre-faults shape)
+    shed_threshold: Optional[float] = None
+    # transient-scoring-fault retry budget (TransientIOError class only;
+    # a real scoring exception is not retried — it feeds the breaker)
+    score_retries: int = 3
+    # circuit breaker: consecutive failed BATCHES that trip it, and the
+    # open-state cooldown before a half-open probe is admitted
+    breaker_threshold: int = 5
+    breaker_cooldown_s: float = 30.0
 
     def resolved_buckets(self) -> Tuple[int, ...]:
         if self.buckets is not None:
@@ -52,15 +65,39 @@ class ServeConfig:
             return b
         return default_buckets(self.max_batch)
 
+    def resolved_shed_at(self) -> Optional[int]:
+        if self.shed_threshold is None:
+            return None
+        if not (0.0 < self.shed_threshold <= 1.0):
+            raise ValueError(
+                f"shed_threshold must be in (0, 1], got "
+                f"{self.shed_threshold}"
+            )
+        return max(1, int(self.shed_threshold * self.queue_size))
+
 
 class _ModelWorker:
-    """Entry + cache + metrics + batcher for one hosted model."""
+    """Entry + cache + metrics + batcher + breaker for one hosted model."""
 
-    def __init__(self, entry: ModelEntry, config: ServeConfig):
+    def __init__(self, entry: ModelEntry, config: ServeConfig,
+                 clock=None):
         buckets = config.resolved_buckets()
         self.entry = entry
         self.cache = CompileCache(entry, buckets, block=config.block)
         self.metrics = Metrics(buckets)
+        self.breaker = faults.CircuitBreaker(
+            threshold=config.breaker_threshold,
+            cooldown_s=config.breaker_cooldown_s,
+            name=entry.name,
+            listener=self._on_breaker,
+            **({"clock": clock} if clock is not None else {}),
+        )
+        self._retry = faults.Retry(
+            faults.RetryPolicy(max_attempts=config.score_retries + 1,
+                               retryable=(faults.TransientIOError,)),
+            op="serve.score",
+            on_retry=lambda: self.metrics.inc("retries"),
+        )
         # serializes predict_direct against the batcher thread: compiled
         # executables tolerate concurrent callers, but one at a time keeps
         # the latency accounting honest and the device queue short
@@ -72,7 +109,14 @@ class _ModelWorker:
             queue_size=config.queue_size,
             timeout_s=config.timeout_ms / 1e3,
             metrics=self.metrics,
+            shed_at=config.resolved_shed_at(),
         )
+
+    def _on_breaker(self, event: str) -> None:
+        if event == "tripped":
+            self.metrics.inc("breaker_trips")
+        elif event == "recovered":
+            self.metrics.inc("breaker_recoveries")
 
     def _score(self, X: np.ndarray):
         """(scores, labels, [(bucket, rows), ...]) for validated f64 rows.
@@ -103,11 +147,33 @@ class _ModelWorker:
             labels = e.classes[np.argmax(scores, axis=1)]
         return scores, labels, chunks
 
+    def _score_injected(self, X: np.ndarray):
+        faults.point("serve.score", model=self.entry.name)
+        return self._score(X)
+
     def _run_batch(self, X: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
-        scores, labels, chunks = self._score(X)
+        """The batcher's scoring callback, hardened: breaker gate first
+        (an open breaker fails the batch in microseconds, no kernel
+        time), then the scoring attempt with transient-fault retries;
+        outcomes feed the breaker so persistent failure trips it and a
+        half-open probe recovers it."""
+        if not self.breaker.allow():
+            raise faults.BreakerOpenError(self.entry.name)
+        try:
+            scores, labels, chunks = self._retry(self._score_injected, X)
+        except Exception:
+            # exhausted retries or a non-retryable scoring failure: one
+            # consecutive-failure tick (SimulatedKill, a BaseException,
+            # bypasses this — a killed process counts nothing)
+            self.breaker.record_failure()
+            raise
+        self.breaker.record_success()
         for bucket, rows in chunks:
             self.metrics.observe_batch(bucket, rows)
         return scores, labels
+
+    def drain(self, timeout_s: float = 10.0) -> bool:
+        return self.batcher.drain(timeout_s)
 
     def close(self) -> None:
         self.batcher.close()
@@ -124,6 +190,7 @@ class Server:
         self._workers: Dict[str, _ModelWorker] = {}
         self._lock = threading.Lock()
         self._closed = False
+        self._draining = False
 
     # ----------------------------------------------------------- hosting
     def _install(self, entry: ModelEntry) -> ModelEntry:
@@ -214,11 +281,45 @@ class Server:
                 "recompiles": w.cache.recompiles,
                 "warmed": w.cache.warmed,
                 "queue_depth": w.batcher.depth,
+                "breaker": w.breaker.describe(),
             }
         return {
             "models": models,
+            "draining": self._draining,
             "config": dataclasses.asdict(self.config),
         }
+
+    def health(self) -> dict:
+        """The /healthz payload: overall status + per-model breaker state.
+
+        "ok" only when the server is accepting work; "draining" after
+        drain(); a model with an open breaker degrades the report to
+        "degraded" without failing the whole health check (the other
+        models still serve)."""
+        with self._lock:
+            workers = dict(self._workers)
+        breakers = {n: w.breaker.state for n, w in workers.items()}
+        if self._draining or self._closed:
+            status = "draining"
+        elif any(s != "closed" for s in breakers.values()):
+            status = "degraded"
+        else:
+            status = "ok"
+        return {"status": status, "models": breakers}
+
+    def drain(self, timeout_s: float = 10.0) -> bool:
+        """Stop admitting new requests (they come back DRAINING) and wait
+        for in-flight work to finish. True when every model quiesced
+        within the timeout. The zero-downtime-restart primitive: drain,
+        then close, and no accepted request is ever dropped."""
+        self._draining = True
+        with self._lock:
+            workers = list(self._workers.values())
+        ok = True
+        for w in workers:
+            ok = w.drain(timeout_s) and ok
+        faults.emit("serve.drained", complete=ok)
+        return ok
 
     def close(self) -> None:
         with self._lock:

@@ -328,7 +328,7 @@ def _inner_smo(K_BB, y_B, a_B, f_B, active_B, C, eps, tau, max_inner,
                      "matmul_precision", "selection", "fused_fupdate",
                      "pallas_layout", "pallas_eta_exclude",
                      "pallas_multipair", "telemetry", "kernel", "degree",
-                     "kernel_fast"),
+                     "kernel_fast", "return_state"),
 )
 def blocked_smo_solve(
     X: jax.Array,
@@ -363,6 +363,9 @@ def blocked_smo_solve(
     coef0: float = 0.0,
     kernel_fast: bool = True,
     targets: Optional[jax.Array] = None,
+    resume_state: Optional["_OuterState"] = None,
+    pause_at: Optional[jax.Array] = None,
+    return_state: bool = False,
 ) -> SMOResult:
     """Train to the reference's stopping criterion with blocked working sets.
 
@@ -535,6 +538,18 @@ def blocked_smo_solve(
     (tests/test_obs.py asserts this; benchmarks/telemetry_overhead.py
     bounds the time cost at <= 3%). When the solve runs more than T
     outer rounds the ring holds the LAST T (count says how many ran).
+
+    resume_state / pause_at / return_state: the crash-safe-training
+    surface (tpusvm.solver.checkpoint). The outer loop's carry
+    (_OuterState) is the COMPLETE solve state — the body reads nothing
+    else that varies — so running the loop in segments is bit-identical
+    to one uninterrupted loop: `pause_at=k` stops the loop once n_outer
+    reaches k (or the solve terminates), `return_state=True` returns
+    (SMOResult, _OuterState) so the caller can persist the carry, and
+    `resume_state=state` re-enters the loop from a persisted carry
+    (alpha0/warm_start/f0 construction is then dead code; the carry IS
+    the state). The checkpoint driver owns the host-side snapshotting,
+    atomic writes and fingerprint validation.
     """
     n = Y.shape[0]
     dtype = X.dtype
@@ -886,8 +901,28 @@ def blocked_smo_solve(
         tele_status=jnp.zeros((telemetry,), jnp.int32),
         tele_i=jnp.int32(0),
     )
-    final = lax.while_loop(lambda s: s.status == Status.RUNNING, body, init)
-    return SMOResult(
+    if resume_state is not None:
+        if resume_state.tele_gap.shape[0] != telemetry:
+            raise ValueError(
+                f"resume_state carries a {resume_state.tele_gap.shape[0]}-"
+                f"slot telemetry ring but this solve was configured with "
+                f"telemetry={telemetry}; resume with the checkpoint's "
+                "telemetry setting"
+            )
+        if resume_state.alpha.shape[0] != n:
+            raise ValueError(
+                f"resume_state is for n={resume_state.alpha.shape[0]} "
+                f"rows, this solve has n={n}"
+            )
+        init = _OuterState(*resume_state)
+    if pause_at is None:
+        cond = lambda s: s.status == Status.RUNNING  # noqa: E731
+    else:
+        stop = jnp.asarray(pause_at, jnp.int32)
+        cond = lambda s: (s.status == Status.RUNNING) \
+            & (s.n_outer < stop)  # noqa: E731
+    final = lax.while_loop(cond, body, init)
+    result = SMOResult(
         alpha=final.alpha,
         b=(final.b_high + final.b_low) / 2.0,
         b_high=final.b_high,
@@ -901,3 +936,6 @@ def blocked_smo_solve(
             status=final.tele_status, count=final.tele_i,
         ) if telemetry else None),
     )
+    if return_state:
+        return result, final
+    return result

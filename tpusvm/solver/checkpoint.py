@@ -1,0 +1,190 @@
+"""Crash-safe single-chip training: periodic, bit-exact solver checkpoints.
+
+Only the cascade's inter-round state survived a crash before this module
+(parallel/cascade.py:save_round_state); a 10M-row single-chip solve that
+died at outer round 4000 restarted from zero. This driver runs
+blocked_smo_solve's outer loop in segments of `every` rounds, snapshots
+the COMPLETE loop carry (_OuterState: alpha, the accumulated error
+vector f, b_high/b_low, counters, refine flags, the telemetry ring)
+host-side between segments, and writes it with the house atomic
+discipline (temp file + os.replace, format-versioned).
+
+The bit-identity argument: the outer-loop body is a pure function of
+the carry plus invariants (X, Y, the static config), so a resumed run
+replays exactly the rounds an uninterrupted run would have executed with
+exactly the same carry values — numpy round-trips float arrays bit-exact
+— and the final alpha bytes, SV ids and b are identical. The chaos test
+(tests/test_faults.py) kills at EVERY checkpoint in turn and asserts
+this; `python -m tpusvm.faults kill-resume-smoke` is the CI gate.
+
+A checkpoint from a different run is refused by fingerprint, not by a
+shape crash: the file carries the solve's static config and a CRC of the
+training bytes, and any mismatch names the differing fields.
+
+The checkpoint write is an injection point ("solver.outer_checkpoint")
+wrapped in the shared Retry policy: transient write faults are retried,
+a SimulatedKill escapes — exactly like a real death at that moment.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import zlib
+from typing import Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from tpusvm import faults
+from tpusvm.solver.blocked import _OuterState, blocked_smo_solve
+from tpusvm.solver.smo import SMOResult
+from tpusvm.status import Status
+
+SOLVER_CKPT_VERSION = 1
+
+#: static config the fingerprint pins (a resumed solve with any of these
+#: changed would silently walk a different trajectory)
+_FP_KEYS = ("C", "gamma", "eps", "tau", "max_iter", "q", "max_outer",
+            "max_inner", "wss", "inner", "refine", "max_refines",
+            "selection", "matmul_precision", "kernel", "degree", "coef0",
+            "kernel_fast", "telemetry")
+
+_STATE_FIELDS = _OuterState._fields
+
+
+def solve_fingerprint(X: np.ndarray, Y: np.ndarray, accum_dtype,
+                      solver_kwargs: dict) -> dict:
+    """JSON-able identity of a solve: shapes, dtypes, data CRC, config."""
+    X = np.asarray(X)
+    Y = np.asarray(Y)
+    fp = {
+        "n": int(X.shape[0]),
+        "d": int(X.shape[1]),
+        "x_dtype": str(X.dtype),
+        "accum_dtype": str(np.dtype(accum_dtype)) if accum_dtype else None,
+        "x_crc32": zlib.crc32(np.ascontiguousarray(X).tobytes()),
+        "y_crc32": zlib.crc32(np.ascontiguousarray(Y).tobytes()),
+    }
+    for k in _FP_KEYS:
+        if k in solver_kwargs:
+            fp[k] = solver_kwargs[k]
+    return fp
+
+
+def save_solver_state(path: str, state: _OuterState, fingerprint: dict,
+                      retry: Optional[faults.Retry] = None) -> None:
+    """Atomically persist an outer-loop carry + its fingerprint.
+
+    The injection point fires inside the retried write, so a transient
+    rule fails the write and the retry re-runs it, while a kill rule
+    dies exactly where a real crash would — before the rename, leaving
+    the PREVIOUS checkpoint intact."""
+    def _write():
+        faults.point("solver.outer_checkpoint", path=path,
+                     round=int(state.n_outer))
+        tmp = path + ".tmp"
+        arrays = {f: np.asarray(getattr(state, f)) for f in _STATE_FIELDS}
+        np.savez(tmp, ckpt_version=SOLVER_CKPT_VERSION,
+                 fingerprint=json.dumps(fingerprint, sort_keys=True),
+                 **arrays)
+        os.replace(tmp + ".npz", path)  # np.savez appends .npz
+
+    if retry is None:
+        retry = faults.Retry(faults.DEFAULT_IO_POLICY,
+                             op="solver.outer_checkpoint")
+    retry(_write)
+
+
+def load_solver_state(path: str, fingerprint: dict) -> _OuterState:
+    """Load a carry; refuse (with the differing fields named) any
+    checkpoint whose fingerprint does not match this solve."""
+    with np.load(path, allow_pickle=False) as z:
+        if "ckpt_version" not in z.files:
+            raise ValueError(
+                f"{path!r} is not a tpusvm solver checkpoint "
+                "(no ckpt_version)"
+            )
+        v = int(z["ckpt_version"])
+        if v != SOLVER_CKPT_VERSION:
+            raise ValueError(
+                f"unsupported solver checkpoint version {v} (this build "
+                f"reads version {SOLVER_CKPT_VERSION})"
+            )
+        saved = json.loads(str(z["fingerprint"]))
+        want = json.loads(json.dumps(fingerprint, sort_keys=True))
+        if saved != want:
+            diff = sorted(
+                k for k in set(saved) | set(want)
+                if saved.get(k) != want.get(k)
+            )
+            raise ValueError(
+                "solver checkpoint does not belong to this solve "
+                f"(differing fields: {diff}); it was written for "
+                f"{ {k: saved.get(k) for k in diff} }, this run has "
+                f"{ {k: want.get(k) for k in diff} }"
+            )
+        return _OuterState(*(np.asarray(z[f]) for f in _STATE_FIELDS))
+
+
+def checkpointed_blocked_solve(
+    X,
+    Y,
+    *,
+    checkpoint_path: str,
+    checkpoint_every: int = 64,
+    resume: bool = False,
+    keep_checkpoint: bool = False,
+    accum_dtype=None,
+    **solver_kwargs,
+) -> SMOResult:
+    """blocked_smo_solve with periodic crash-safe checkpoints.
+
+    Runs the solve in `checkpoint_every`-outer-round segments; after each
+    segment the loop carry is pulled host-side and written atomically to
+    `checkpoint_path`. resume=True restarts from that file when it exists
+    (missing file = fresh start, like the cascade's documented resume
+    semantics); a checkpoint from a different solve (other data, other
+    config) is refused with the differing fields named. On successful
+    termination the checkpoint is deleted unless keep_checkpoint=True —
+    a completed solve's artifact is the model, not the carry.
+
+    The resumed trajectory is BIT-IDENTICAL to an uninterrupted one
+    (same alpha bytes / SV set / b): the carry is the complete loop
+    state and numpy round-trips it exactly. Asserted against plain
+    blocked_smo_solve and under kill-at-every-checkpoint chaos in
+    tests/test_faults.py.
+
+    Accepts every blocked_smo_solve kwarg EXCEPT warm-start-shaping args
+    that the carry supersedes on resume (alpha0/valid/targets are still
+    honoured on the FRESH segments). max_iter/max_outer semantics are
+    unchanged — they live inside the loop body.
+    """
+    if checkpoint_every < 1:
+        raise ValueError(
+            f"checkpoint_every must be >= 1, got {checkpoint_every}"
+        )
+    fp = solve_fingerprint(X, Y, accum_dtype, solver_kwargs)
+    state = None
+    if resume and os.path.exists(checkpoint_path):
+        state = load_solver_state(checkpoint_path, fp)
+
+    Xd = jnp.asarray(X)
+    Yd = jnp.asarray(Y)
+    retry = faults.Retry(faults.DEFAULT_IO_POLICY,
+                         op="solver.outer_checkpoint")
+    while True:
+        start = int(state.n_outer) if state is not None else 0
+        res, st = blocked_smo_solve(
+            Xd, Yd, accum_dtype=accum_dtype, resume_state=state,
+            pause_at=np.int32(start + checkpoint_every),
+            return_state=True, **solver_kwargs,
+        )
+        # one host sync materialises the whole carry (the checkpoint
+        # payload); segments make this a per-K-rounds cost, not per-round
+        state = _OuterState(*(np.asarray(x) for x in st))
+        if Status(int(state.status)) != Status.RUNNING:
+            if not keep_checkpoint and os.path.exists(checkpoint_path):
+                os.remove(checkpoint_path)
+            return res
+        save_solver_state(checkpoint_path, state, fp, retry=retry)

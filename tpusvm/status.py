@@ -46,6 +46,17 @@ class ServeStatus(enum.IntEnum):
       ERROR       the scoring path raised (bad input caught pre-queue
                   raises ValueError instead — that is a caller bug)
       SHUTDOWN    the server closed while the request was in flight
+      OVERLOADED  shed by the load-shedding threshold (the queue passed
+                  ServeConfig.shed_threshold of its capacity) — the
+                  degraded-mode "come back later" answer, distinct from
+                  the hard QUEUE_FULL bound so dashboards can tell
+                  deliberate shedding from a mis-sized queue
+      UNAVAILABLE the model's circuit breaker is OPEN (consecutive
+                  scoring failures tripped it); requests fail fast
+                  without paying kernel time until a half-open probe
+                  recovers the model (tpusvm.faults.breaker)
+      DRAINING    the server is draining (Server.drain()): in-flight
+                  requests complete, new ones are refused
     """
 
     OK = 0
@@ -53,6 +64,9 @@ class ServeStatus(enum.IntEnum):
     QUEUE_FULL = 2
     ERROR = 3
     SHUTDOWN = 4
+    OVERLOADED = 5
+    UNAVAILABLE = 6
+    DRAINING = 7
 
 
 class StreamStatus(enum.IntEnum):
@@ -77,6 +91,11 @@ class StreamStatus(enum.IntEnum):
                           from the rows — the manifest-fitted scaler and
                           the stratified assignment would silently diverge
                           from a full-array fit
+      READ_FAILED         the shard could not be read even after the
+                          reader's retry/backoff budget was exhausted
+                          (tpusvm.faults.retry) — transient I/O that
+                          never became readable, as opposed to bytes
+                          that read fine but fail their checksum
     """
 
     OK = 0
@@ -84,6 +103,7 @@ class StreamStatus(enum.IntEnum):
     CHECKSUM_MISMATCH = 2
     ROW_COUNT_MISMATCH = 3
     STATS_MISMATCH = 4
+    READ_FAILED = 5
 
 
 class TuneStatus(enum.IntEnum):

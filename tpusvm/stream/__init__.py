@@ -37,6 +37,7 @@ from tpusvm.stream.assign import (
 from tpusvm.stream.format import (
     FORMAT_VERSION,
     Manifest,
+    ShardError,
     ShardInfo,
     ShardWriter,
     ShardedDataset,
@@ -60,6 +61,7 @@ __all__ = [
     "FORMAT_VERSION",
     "Manifest",
     "RowAssignment",
+    "ShardError",
     "ShardInfo",
     "ShardReader",
     "ShardStats",

@@ -31,12 +31,16 @@ from __future__ import annotations
 
 import dataclasses
 import hashlib
+import io
 import json
 import os
+import zipfile
+import zlib
 from typing import Iterable, Iterator, List, Optional, Tuple
 
 import numpy as np
 
+from tpusvm import faults
 from tpusvm.data.csv_reader import read_csv_blocks
 from tpusvm.status import StreamStatus
 from tpusvm.stream.stats import (
@@ -48,7 +52,34 @@ from tpusvm.stream.stats import (
 
 FORMAT_VERSION = 1
 MANIFEST_NAME = "manifest.json"
+JOURNAL_NAME = "ingest.journal.json"
+JOURNAL_VERSION = 1
 DEFAULT_ROWS_PER_SHARD = 65536
+
+# np.load failure modes on damaged bytes: BadZipFile/zlib.error escape the
+# (OSError, ValueError, KeyError) net — a truncated or bit-flipped npz used
+# to surface as a raw traceback from the prefetch thread (ISSUE 7 satellite)
+_UNREADABLE = (OSError, ValueError, KeyError, EOFError,
+               zipfile.BadZipFile, zlib.error)
+
+
+class ShardError(ValueError):
+    """A shard failed to load or verify; names the shard and carries the
+    StreamStatus so callers branch on codes, not string matching.
+
+    ValueError subclass: the pre-existing load_shard(verify=True)
+    contract raised ValueError, and every caller of that contract keeps
+    working while gaining .filename/.status."""
+
+    def __init__(self, filename: str, status: StreamStatus,
+                 detail: str = ""):
+        self.filename = filename
+        self.status = StreamStatus(status)
+        msg = f"shard {filename}: {self.status.name}"
+        if detail:
+            msg += f" ({detail})"
+        msg += " — re-ingest or restore the file"
+        super().__init__(msg)
 
 
 def shard_checksum(X: np.ndarray, Y: np.ndarray) -> str:
@@ -171,13 +202,20 @@ class ShardWriter:
 
     The manifest is written (atomically, temp-file + rename) on close; a
     crash mid-ingest leaves no manifest, so the directory is never
-    mistaken for a complete dataset.
+    mistaken for a complete dataset. Every SHARD write is atomic too
+    (bytes staged to a temp file, os.replace), retried under the shared
+    I/O retry policy (tpusvm.faults.retry), and journaled: after each
+    durable shard the journal (ingest.journal.json) records the shard
+    table so far, so a killed ingest resumes from the last durable shard
+    (resume=True) instead of leaving an unrecoverable directory. The
+    journal is deleted when close() commits the manifest.
     """
 
     def __init__(self, out_dir: str,
                  rows_per_shard: int = DEFAULT_ROWS_PER_SHARD,
                  binary: bool = True,
-                 positive_label: Optional[int] = None):
+                 positive_label: Optional[int] = None,
+                 resume: bool = False):
         if rows_per_shard < 1:
             raise ValueError(
                 f"rows_per_shard must be >= 1, got {rows_per_shard}"
@@ -193,7 +231,85 @@ class ShardWriter:
         self._row_start = 0
         self._n_features: Optional[int] = None
         self._closed = False
+        self._retry = faults.Retry(faults.DEFAULT_IO_POLICY,
+                                   op="ingest.write_shard")
         os.makedirs(out_dir, exist_ok=True)
+        if resume:
+            self._load_journal()
+
+    # ------------------------------------------------------- crash safety
+    @property
+    def rows_durable(self) -> int:
+        """Rows already safely on disk (resume=True): the caller skips
+        this many input rows before appending."""
+        return self._row_start
+
+    def _journal_path(self) -> str:
+        return os.path.join(self.out_dir, JOURNAL_NAME)
+
+    def _write_journal(self) -> None:
+        """Atomically record the durable shard table (one rewrite per
+        shard — O(shards^2) JSON total, noise next to the shard bytes)."""
+        obj = {
+            "journal_version": JOURNAL_VERSION,
+            "rows_per_shard": self.rows_per_shard,
+            "binary": self.binary,
+            "positive_label": self.positive_label,
+            "n_features": self._n_features,
+            "shards": [s.to_json() for s in self._shards],
+        }
+        tmp = self._journal_path() + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(obj, f, indent=1)
+            f.write("\n")
+        os.replace(tmp, self._journal_path())
+
+    def _load_journal(self) -> None:
+        """Adopt a crashed ingest's durable prefix (resume=True).
+
+        Every journaled shard is re-verified against its checksum before
+        being trusted — a shard the journal lists but the disk lost (or
+        corrupted) makes resume an error, not a silent hole. No journal
+        = nothing to resume, start fresh (mirrors cascade --resume)."""
+        jp = self._journal_path()
+        if not os.path.exists(jp):
+            return
+        with open(jp) as f:
+            obj = json.load(f)
+        if obj.get("journal_version") != JOURNAL_VERSION:
+            raise ValueError(
+                f"unsupported ingest journal version "
+                f"{obj.get('journal_version')!r} in {jp!r}"
+            )
+        for key, have in (("rows_per_shard", self.rows_per_shard),
+                          ("binary", self.binary),
+                          ("positive_label", self.positive_label)):
+            if obj[key] != have:
+                raise ValueError(
+                    f"ingest journal was written with {key}={obj[key]!r}, "
+                    f"this resume passes {have!r}; re-run with the "
+                    "original settings or delete the directory"
+                )
+        shards = [ShardInfo.from_json(s) for s in obj["shards"]]
+        for info in shards:
+            path = os.path.join(self.out_dir, info.filename)
+            try:
+                with np.load(path, allow_pickle=False) as z:
+                    X, Y = z["X"], z["Y"]
+            except _UNREADABLE as e:
+                raise ShardError(
+                    info.filename, StreamStatus.CHECKSUM_MISMATCH,
+                    f"journaled shard unreadable on resume: {e}"
+                ) from e
+            if shard_checksum(X, Y) != info.sha256:
+                raise ShardError(info.filename,
+                                 StreamStatus.CHECKSUM_MISMATCH,
+                                 "journaled shard fails its checksum "
+                                 "on resume")
+        self._shards = shards
+        self._row_start = sum(s.n_rows for s in shards)
+        self._n_features = (None if obj["n_features"] is None
+                            else int(obj["n_features"]))
 
     def __enter__(self) -> "ShardWriter":
         return self
@@ -244,11 +360,29 @@ class ShardWriter:
             return xs[0], ys[0]
         return np.concatenate(xs), np.concatenate(ys)
 
+    def _write_shard_atomic(self, filename: str, X: np.ndarray,
+                            Y: np.ndarray) -> None:
+        """Stage the npz bytes, then temp-file + os.replace — the same
+        discipline as the manifest, so a crash never leaves a truncated
+        shard-*.npz behind a committed manifest. The injection point
+        sits inside the retried body: transient write faults re-run the
+        whole write, corrupt rules mangle the staged bytes (caught later
+        by the checksum), kills die pre-rename leaving no partial file."""
+        buf = io.BytesIO()
+        np.savez(buf, X=X, Y=Y)
+        payload = faults.point("ingest.write_shard", payload=buf.getvalue(),
+                               shard=filename)
+        path = os.path.join(self.out_dir, filename)
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(payload)
+        os.replace(tmp, path)
+
     def _flush_shard(self, n: int) -> None:
         X, Y = self._take(n)
         idx = len(self._shards)
         filename = f"shard-{idx:05d}.npz"
-        np.savez(os.path.join(self.out_dir, filename), X=X, Y=Y)
+        self._retry(self._write_shard_atomic, filename, X, Y)
         self._shards.append(ShardInfo(
             filename=filename,
             row_start=self._row_start,
@@ -256,6 +390,7 @@ class ShardWriter:
             sha256=shard_checksum(X, Y),
         ))
         self._row_start += n
+        self._write_journal()
 
     def close(self) -> Manifest:
         if self._closed:
@@ -280,6 +415,11 @@ class ShardWriter:
             json.dump(self.manifest.to_json(), f, indent=1)
             f.write("\n")
         os.replace(tmp, os.path.join(self.out_dir, MANIFEST_NAME))
+        # the manifest supersedes the journal: a committed dataset is no
+        # longer a resumable crash site
+        jp = self._journal_path()
+        if os.path.exists(jp):
+            os.remove(jp)
         return self.manifest
 
 
@@ -287,11 +427,25 @@ def ingest_blocks(out_dir: str,
                   blocks: Iterable[Tuple[np.ndarray, np.ndarray]],
                   rows_per_shard: int = DEFAULT_ROWS_PER_SHARD,
                   binary: bool = True,
-                  positive_label: Optional[int] = None) -> Manifest:
-    """Shard any (X, Y)-block iterator (the generic ingest core)."""
+                  positive_label: Optional[int] = None,
+                  resume: bool = False) -> Manifest:
+    """Shard any (X, Y)-block iterator (the generic ingest core).
+
+    resume=True adopts a crashed ingest's journal: rows already durable
+    in verified shards are skipped off the front of the block stream
+    (the SOURCE must be replayed identically — same CSV, same order),
+    so the finished dataset is bit-identical to an uninterrupted ingest.
+    """
     with ShardWriter(out_dir, rows_per_shard, binary=binary,
-                     positive_label=positive_label) as w:
+                     positive_label=positive_label, resume=resume) as w:
+        skip = w.rows_durable
         for X, Y in blocks:
+            if skip:
+                if len(X) <= skip:
+                    skip -= len(X)
+                    continue
+                X, Y = X[skip:], Y[skip:]
+                skip = 0
             w.append(X, Y)
     return w.manifest
 
@@ -299,7 +453,8 @@ def ingest_blocks(out_dir: str,
 def ingest_arrays(out_dir: str, X: np.ndarray, Y: np.ndarray,
                   rows_per_shard: int = DEFAULT_ROWS_PER_SHARD,
                   binary: Optional[bool] = None,
-                  positive_label: Optional[int] = None) -> Manifest:
+                  positive_label: Optional[int] = None,
+                  resume: bool = False) -> Manifest:
     """Shard an in-memory array pair (synthetic generators, tests).
 
     binary defaults to whether Y only carries {+1, -1}."""
@@ -307,7 +462,8 @@ def ingest_arrays(out_dir: str, X: np.ndarray, Y: np.ndarray,
     if binary is None:
         binary = bool(set(np.unique(Y).tolist()) <= {1, -1})
     return ingest_blocks(out_dir, [(np.asarray(X), Y)], rows_per_shard,
-                         binary=binary, positive_label=positive_label)
+                         binary=binary, positive_label=positive_label,
+                         resume=resume)
 
 
 def ingest_csv(out_dir: str, csv_path: str,
@@ -315,11 +471,14 @@ def ingest_csv(out_dir: str, csv_path: str,
                n_limit: Optional[int] = None,
                binary: bool = True,
                positive_label: int = 1,
-               block_rows: int = 8192) -> Manifest:
+               block_rows: int = 8192,
+               resume: bool = False) -> Manifest:
     """Stream a labelled CSV into shards with reference reader semantics
     (header skipped, short rows dropped, n_limit cap, one-vs-rest label
     mapping with a parameterised positive class). Peak memory is
     max(block_rows, rows_per_shard) rows — the CSV is never whole in RAM.
+    resume=True continues a killed ingest of the SAME CSV from its
+    journal (ingest_blocks).
     """
     return ingest_blocks(
         out_dir,
@@ -329,6 +488,7 @@ def ingest_csv(out_dir: str, csv_path: str,
         rows_per_shard,
         binary=binary,
         positive_label=positive_label if binary else None,
+        resume=resume,
     )
 
 
@@ -362,16 +522,30 @@ class ShardedDataset:
 
     def load_shard(self, i: int, verify: bool = False
                    ) -> Tuple[np.ndarray, np.ndarray]:
-        """One shard's (X, Y); verify=True re-checksums the content."""
-        with np.load(self.shard_path(i), allow_pickle=False) as z:
-            X, Y = z["X"], z["Y"]
+        """One shard's (X, Y); verify=True re-checksums the content.
+
+        Every failure mode is a ShardError NAMING the shard and carrying
+        a StreamStatus — a bit-flipped npz no longer surfaces as a raw
+        zlib/zipfile traceback from the prefetch thread: MISSING_FILE
+        for an absent file, CHECKSUM_MISMATCH for unreadable bytes, and
+        (verify=True) whichever integrity code the manifest check finds.
+        """
+        info = self.manifest.shards[i]
+        faults.point("stream.read_shard", shard=info.filename)
+        try:
+            with np.load(self.shard_path(i), allow_pickle=False) as z:
+                X, Y = z["X"], z["Y"]
+        except FileNotFoundError as e:
+            raise ShardError(info.filename, StreamStatus.MISSING_FILE,
+                             str(e)) from e
+        except _UNREADABLE as e:
+            raise ShardError(info.filename, StreamStatus.CHECKSUM_MISMATCH,
+                             f"unreadable shard bytes: "
+                             f"{type(e).__name__}: {e}") from e
         if verify:
             status = self._check_shard(i, X, Y)
             if status != StreamStatus.OK:
-                raise ValueError(
-                    f"shard {self.manifest.shards[i].filename}: "
-                    f"{status.name} (re-ingest or restore the file)"
-                )
+                raise ShardError(info.filename, status)
         return X, Y
 
     def load_labels(self) -> np.ndarray:
@@ -433,7 +607,9 @@ class ShardedDataset:
             try:
                 with np.load(self.shard_path(i), allow_pickle=False) as z:
                     X, Y = z["X"], z["Y"]
-            except (OSError, ValueError, KeyError):
+            except _UNREADABLE:
+                # includes BadZipFile/zlib.error: damaged container bytes
+                # are an integrity failure, not a crash
                 out.append(StreamStatus.CHECKSUM_MISMATCH)
                 continue
             out.append(self._check_shard(i, X, Y))

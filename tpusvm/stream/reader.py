@@ -33,7 +33,9 @@ from typing import Iterator, Optional, Tuple
 
 import numpy as np
 
-from tpusvm.stream.format import ShardedDataset
+from tpusvm import faults
+from tpusvm.stream.format import ShardedDataset, ShardError
+from tpusvm.status import StreamStatus
 
 _SENTINEL = object()
 
@@ -70,7 +72,8 @@ class ShardReader:
 
     def __init__(self, dataset: ShardedDataset, prefetch_depth: int = 2,
                  seed: Optional[int] = None, scaler=None, dtype=None,
-                 verify: bool = False, metrics=None):
+                 verify: bool = False, metrics=None,
+                 retry_policy: Optional[faults.RetryPolicy] = None):
         if prefetch_depth < 1:
             raise ValueError(
                 f"prefetch_depth must be >= 1, got {prefetch_depth}"
@@ -92,6 +95,14 @@ class ShardReader:
         self._producer_stalls = metrics.counter("stream.producer_stalls")
         self._consumer_stalls = metrics.counter("stream.consumer_stalls")
         self._live_gauge = metrics.gauge("stream.live_shards")
+        # transient read faults (injected or real flaky I/O) are retried
+        # with backoff before the consumer ever hears about them; a read
+        # that stays broken surfaces as ShardError(READ_FAILED) naming
+        # the shard, not a raw exception from the prefetch thread
+        self._retry = faults.Retry(
+            retry_policy or faults.DEFAULT_IO_POLICY,
+            op="stream.read_shard", metrics=metrics,
+        )
         # residency accounting: one permit per resident shard
         self._permits = threading.Semaphore(prefetch_depth + 1)
         self._lock = threading.Lock()
@@ -134,8 +145,14 @@ class ShardReader:
                 if not self._acquire():
                     return  # closed while waiting for a permit
                 try:
-                    X, Y = self.dataset.load_shard(int(i),
-                                                   verify=self.verify)
+                    try:
+                        X, Y = self._retry(self.dataset.load_shard, int(i),
+                                           verify=self.verify)
+                    except faults.RetryExhaustedError as e:
+                        raise ShardError(
+                            self.dataset.manifest.shards[int(i)].filename,
+                            StreamStatus.READ_FAILED, str(e),
+                        ) from e
                     if self.scaler is not None:
                         X = self.scaler.transform(X)
                     if self.dtype is not None:
