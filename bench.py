@@ -189,6 +189,8 @@ def _reexec_cpu(err):
                 "error": "no backend produced a measurement",
                 "init_fallback": err,
                 "cpu_child_rc": rc,
+                # no provenance_record() here: this branch exists because
+                # backend init FAILED — touching jax again could hang
             },
         }))
     sys.exit(0)
@@ -644,6 +646,8 @@ def main():
     if status != Status.CONVERGED:
         log("WARNING: solver did not converge; reporting anyway")
 
+    from benchmarks.common import provenance_record
+
     print(
         json.dumps(
             {
@@ -654,6 +658,9 @@ def main():
                 # top-level on purpose: a dashboard ingesting only the
                 # headline line still sees synthetic-vs-real provenance
                 "workload": workload,
+                # backend/version/host provenance so benchdiff can refuse
+                # cross-backend comparisons (the r02-r05 CPU-fallback trap)
+                "provenance": provenance_record(),
                 "detail": {
                     "baseline": "reference GPU SMO 58.570s on MNIST-60k (B2)",
                     "status": status.name,

@@ -135,7 +135,49 @@ def workload_record(gen_fn, **call_kwargs) -> dict:
             **{k: merged[k] for k in keep if k in merged}}
 
 
+_PROVENANCE = None
+
+
+def provenance_record() -> dict:
+    """Backend/platform provenance for benchmark rows (cached per run).
+
+    Every emitted record carries this so `tpusvm benchdiff` can refuse
+    (or annotate) cross-backend comparisons — the BENCH_r02-r05 failure
+    was single-CPU fallback rounds masquerading as TPU-comparable
+    numbers, with nothing in the rows to flag it."""
+    global _PROVENANCE
+    if _PROVENANCE is None:
+        import platform as _platform
+        import socket
+
+        import jax
+
+        dev = jax.devices()[0]
+        try:
+            import jaxlib
+
+            jaxlib_v = getattr(jaxlib, "__version__", None) or \
+                jaxlib.version.__version__
+        except Exception:  # noqa: BLE001 — provenance is best-effort
+            jaxlib_v = None
+        _PROVENANCE = {
+            "backend": jax.default_backend(),
+            "device_kind": getattr(dev, "device_kind", str(dev)),
+            "device_count": jax.device_count(),
+            "jax": jax.__version__,
+            "jaxlib": jaxlib_v,
+            "hostname": socket.gethostname(),
+            "python": _platform.python_version(),
+        }
+    return _PROVENANCE
+
+
 def emit(record: dict) -> None:
+    # provenance is injected here, centrally, so EVERY harness's rows
+    # (stdout and --jsonl sinks alike — they serialise the same dict)
+    # self-describe their backend without per-harness plumbing
+    if isinstance(record, dict):
+        record.setdefault("provenance", provenance_record())
     print(json.dumps(record), flush=True)
 
 

@@ -1,0 +1,146 @@
+"""Tests for the bench regression gate (tpusvm.obs.benchdiff).
+
+Contracts (the acceptance bars):
+  * SELF-DIFF of every committed benchmarks/results/*.jsonl artifact is
+    clean (exit 0) — the gate can read the whole committed history;
+  * the committed synthetic regression fixture pair FAILS (exit != 0),
+    at full AND smoke (direction-only) levels;
+  * cross-backend comparisons are REFUSED by default (the r02-r05
+    CPU-fallback trap) and annotated under --allow-cross-backend;
+  * a baseline row with no counterpart is a regression (a silently
+    skipped bench), an extra new row is only a note;
+  * text/json/markdown renderings carry the verdict.
+"""
+
+import glob
+import json
+import os
+
+import pytest
+
+from tpusvm.obs import benchdiff
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+RESULTS = os.path.join(ROOT, "benchmarks", "results")
+FIXTURES = os.path.join(ROOT, "tests", "fixtures", "benchdiff")
+
+
+def _cli(*argv):
+    from tpusvm.cli import main
+
+    return main(["benchdiff", *argv])
+
+
+# ---------------------------------------------------------------- self-diff
+@pytest.mark.parametrize(
+    "path", sorted(glob.glob(os.path.join(RESULTS, "*.jsonl"))),
+    ids=os.path.basename,
+)
+def test_self_diff_of_committed_artifacts_is_clean(path, capsys):
+    assert _cli(path, path) == 0, capsys.readouterr().out
+
+
+def test_regression_fixture_fails_full_and_smoke(capsys):
+    base = os.path.join(FIXTURES, "baseline.jsonl")
+    reg = os.path.join(FIXTURES, "regressed.jsonl")
+    assert _cli(base, reg) == 1
+    out = capsys.readouterr().out
+    assert "REGRESSION" in out and "verdict: FAIL" in out
+    assert "qps" in out  # the throughput drop is flagged at full level
+    assert _cli(base, reg, "--level", "smoke") == 1
+    out = capsys.readouterr().out
+    # direction-only: wall-clock metrics are skipped, correctness still gates
+    assert "errors" in out and "qps" not in out
+    # and the baseline is self-clean in both levels
+    assert _cli(base, base) == 0
+    assert _cli(base, base, "--level", "smoke") == 0
+
+
+# --------------------------------------------------------------- provenance
+def _rows(backend):
+    return [{"bench": "serve_latency", "mode": "batched", "threads": 8,
+             "qps": 100.0, "errors": 0,
+             "provenance": {"backend": backend}}]
+
+
+def test_cross_backend_refused_by_default():
+    res = benchdiff.diff_records(_rows("tpu"), _rows("cpu"))
+    assert not res.ok
+    assert res.refusals and "cpu" in res.refusals[0].message
+    assert res.to_text().startswith("benchdiff")
+    assert "REFUSED" in res.to_text()
+
+
+def test_cross_backend_annotated_when_allowed():
+    res = benchdiff.diff_records(_rows("tpu"), _rows("cpu"),
+                                 allow_cross_backend=True)
+    assert res.ok
+    assert any(f.kind == "note" and f.metric == "provenance"
+               for f in res.findings)
+
+
+def test_platform_field_is_provenance_fallback():
+    old = [{"bench": "x", "platform": "tpu"}]
+    new = [{"bench": "x", "platform": "cpu"}]
+    res = benchdiff.diff_records(old, new)
+    assert res.refusals
+
+
+# ------------------------------------------------------------ row matching
+def test_missing_baseline_row_is_regression():
+    old = [{"bench": "b", "n": 1, "violations": []},
+           {"bench": "b", "n": 2, "violations": []}]
+    new = [{"bench": "b", "n": 1, "violations": []}]
+    res = benchdiff.diff_records(old, new)
+    assert any("no counterpart" in f.message for f in res.regressions)
+
+
+def test_extra_new_row_is_only_a_note():
+    old = [{"bench": "b", "n": 1, "violations": []}]
+    new = old + [{"bench": "b", "n": 2, "violations": []}]
+    res = benchdiff.diff_records(old, new)
+    assert res.ok
+    assert any(f.kind == "note" for f in res.findings)
+
+
+def test_unknown_schema_default_rules():
+    old = [{"whatever": 1, "violations": [], "bit_identical": True}]
+    bad = [{"whatever": 1, "violations": ["boom"], "bit_identical": False}]
+    assert benchdiff.diff_records(old, old).ok
+    res = benchdiff.diff_records(old, bad)
+    assert {f.metric for f in res.regressions} == \
+        {"violations", "bit_identical"}
+
+
+def test_tolerance_bands_hold_at_equality_and_for_negatives():
+    # "<=" with a negative old value must not tighten (overhead_frac can
+    # legitimately be -0.5%)
+    old = [{"bench": "telemetry_overhead", "overhead_frac": -0.01,
+            "bit_identical": True, "violations": [],
+            "status": "CONVERGED"}]
+    new = [dict(old[0], overhead_frac=0.005)]
+    assert benchdiff.diff_records(old, new).ok  # within +0.02 abs band
+    worse = [dict(old[0], overhead_frac=0.03)]
+    assert not benchdiff.diff_records(old, worse).ok
+
+
+# ------------------------------------------------------------------ output
+def test_json_and_markdown_formats(capsys):
+    base = os.path.join(FIXTURES, "baseline.jsonl")
+    reg = os.path.join(FIXTURES, "regressed.jsonl")
+    assert _cli(base, reg, "--format", "json") == 1
+    payload = json.loads(capsys.readouterr().out)
+    assert payload["ok"] is False
+    assert any(f["kind"] == "regression" for f in payload["findings"])
+    assert _cli(base, reg, "--format", "markdown") == 1
+    md = capsys.readouterr().out
+    assert "**FAIL**" in md and "| regression |" in md
+
+
+def test_unreadable_input_is_a_clean_error(tmp_path, capsys):
+    bad = tmp_path / "bad.jsonl"
+    bad.write_text("not json\n")
+    base = os.path.join(FIXTURES, "baseline.jsonl")
+    assert _cli(base, str(bad)) == 1
+    assert "benchdiff:" in capsys.readouterr().out
+    assert _cli(base, str(tmp_path / "missing.jsonl")) == 1

@@ -498,3 +498,153 @@ def test_tune_trace_events(tmp_path, capsys):
     winners = [r for r in records if r["kind"] == "event"
                and r["name"] == "tune.winner"]
     assert len(winners) == 1
+
+
+# ----------------------------------------------- rotation (observability 8)
+def test_tracer_rotation_and_rotated_read(tmp_path):
+    from tpusvm.obs import default_registry, reset_default_registry
+
+    reset_default_registry()
+    path = str(tmp_path / "t.jsonl")
+    with Tracer(path, clock=FakeClock(), wall=lambda: 100.0,
+                max_bytes=600) as tr:
+        for i in range(20):
+            tr.event("tick", i=i)
+    import os
+
+    assert os.path.exists(path + ".1")
+    assert os.path.getsize(path) <= 600
+    records = read_trace(path)  # rotated set folded in, oldest first
+    # a continuation meta re-opens each rotated file with the ORIGINAL
+    # t0/wall so timestamps stay on one clock
+    metas = [r for r in records if r["kind"] == "meta"]
+    assert len(metas) >= 2 and metas[-1].get("rotated", 0) >= 1
+    assert all(m["wall"] == 100.0 for m in metas)
+    ticks = [r["attrs"]["i"] for r in records if r["kind"] == "event"
+             and r["name"] == "tick"]
+    assert ticks == sorted(ticks)  # chronological across the set
+    # files the single-backup scheme displaced are COUNTED, not silent
+    snap = {e["name"]: e["value"]
+            for e in default_registry().snapshot()["metrics"]
+            if e["type"] == "counter"}
+    assert snap.get("obs.trace_rotations", 0) >= 2
+    assert snap.get("obs.trace_dropped_records", 0) > 0
+    reset_default_registry()
+
+
+def test_tracer_without_max_bytes_never_rotates(tmp_path):
+    path = str(tmp_path / "t.jsonl")
+    with Tracer(path, clock=FakeClock()) as tr:
+        for i in range(50):
+            tr.event("tick", i=i)
+    import os
+
+    assert not os.path.exists(path + ".1")
+    assert len(read_trace(path)) == 52  # meta + 50 + end
+
+
+# ----------------------------------- Prometheus text rendering edge cases
+def test_render_text_escapes_label_values():
+    from tpusvm.obs.registry import escape_label_value
+
+    reg = MetricsRegistry()
+    reg.counter("weird", path='a"b\\c\nd').inc(2)
+    text = reg.render_text()
+    # per the exposition format: backslash, quote and newline escaped
+    assert 'tpusvm_weird_total{path="a\\"b\\\\c\\nd"} 2' in text
+    assert escape_label_value('say "hi"') == 'say \\"hi\\"'
+    assert escape_label_value("back\\slash") == "back\\\\slash"
+    assert escape_label_value("two\nlines") == "two\\nlines"
+    # and the escaping round-trips through a snapshot merge
+    from tpusvm.obs.registry import render_snapshot_text
+
+    merged = merge_snapshots(reg.snapshot(), reg.snapshot())
+    assert 'path="a\\"b\\\\c\\nd"} 4' in render_snapshot_text(merged)
+
+
+def test_render_text_histogram_inf_bucket_and_sum_count():
+    reg = MetricsRegistry()
+    h = reg.histogram("lat", bounds=(0.1, 1.0), model='m"x')
+    for v in (0.05, 0.5, 5.0):
+        h.observe(v)
+    text = reg.render_text()
+    lines = [line for line in text.splitlines() if "lat" in line]
+    # cumulative buckets, +Inf closing at the total count
+    assert any('le="0.1"} 1' in line for line in lines)
+    assert any('le="1.0"} 2' in line for line in lines)
+    assert any('le="+Inf"} 3' in line for line in lines)
+    sums = [line for line in lines if "_sum" in line]
+    counts = [line for line in lines if "_count" in line]
+    assert len(sums) == 1 and sums[0].endswith(" 5.55")
+    assert len(counts) == 1 and counts[0].endswith(" 3")
+    # the label value is escaped inside bucket lines too
+    assert any('model="m\\"x"' in line for line in lines)
+
+
+def test_serve_metrics_text_escapes_model_label():
+    import jax.numpy as jnp
+
+    from tpusvm.config import SVMConfig
+    from tpusvm.data import rings
+    from tpusvm.models import BinarySVC
+    from tpusvm.serve import ServeConfig, Server
+
+    X, Y = rings(n=160, seed=9)
+    model = BinarySVC(SVMConfig(C=10.0, gamma=10.0),
+                      dtype=jnp.float64).fit(X, Y)
+    with Server(ServeConfig(max_batch=2), dtype=jnp.float64) as srv:
+        srv.add_model('quo"te', model)
+        text = srv.metrics_text()
+    assert 'model="quo\\"te"' in text
+
+
+# ------------------------------------------------- multi-trace collation
+def test_merge_trace_files_interleaves_by_wall_clock(tmp_path):
+    from tpusvm.obs.report import merge_trace_files, phase_summary
+
+    a = str(tmp_path / "a.jsonl")
+    b = str(tmp_path / "b.jsonl")
+    # same monotonic clocks, different wall offsets: b started 10s later
+    with Tracer(a, clock=FakeClock(), wall=lambda: 1000.0) as tr:
+        with tr.span("training", phase=True):
+            tr.event("convergence.round", round=1, gap=0.5, updates=3,
+                     status="RUNNING")
+    with Tracer(b, clock=FakeClock(), wall=lambda: 1010.0) as tr:
+        with tr.span("training", phase=True):
+            tr.event("convergence.round", round=2, gap=0.1, updates=1,
+                     status="CONVERGED")
+    merged = merge_trace_files([a, b])
+    assert all("_wall" in r and "_file" in r for r in merged)
+    walls = [r["_wall"] for r in merged]
+    assert walls == sorted(walls)
+    # a's records all precede b's (10s offset >> the fake-clock ticks)
+    files = [r["_file"] for r in merged]
+    assert files.index(b) == len([f for f in files if f == a])
+    acc, total = phase_summary(merged)
+    # phases accumulate across files; total is the WALL envelope
+    assert acc["training"] == pytest.approx(4.0)  # 2 ticks per span
+    # envelope: a's meta at wall 1000 ... b's end record at wall 1014
+    # (offset 1009 + the fake clock's 5th tick)
+    assert total == pytest.approx(14.0)
+
+
+def test_report_cli_over_directory(tmp_path, capsys):
+    from tpusvm.cli import main
+
+    d = tmp_path / "traces"
+    d.mkdir()
+    _write_demo_trace(str(d / "train.jsonl"))
+    with Tracer(str(d / "worker.jsonl"), clock=FakeClock(),
+                wall=lambda: 50.0) as tr:
+        with tr.span("search", phase=True):
+            pass
+    assert main(["report", str(d), "--smoke"]) == 0
+    out = capsys.readouterr().out
+    assert "2 files" in out
+    assert "training time: " in out and "search time: " in out
+
+    # an empty directory is a clean error, not a crash
+    empty = tmp_path / "empty"
+    empty.mkdir()
+    with pytest.raises(SystemExit):
+        main(["report", str(empty)])

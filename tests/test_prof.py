@@ -1,0 +1,244 @@
+"""Tests for the compile observatory (tpusvm.obs.prof / obs.costs).
+
+Contracts:
+  * BIT-TRANSPARENCY (the acceptance bar): a solve with profiling
+    enabled produces identical alpha bytes / SV ids / b to one with it
+    off — the AOT executable is the same program the jit cache builds;
+  * one compile record per distinct signature: repeat calls hit the
+    cache, scalar-hyperparameter changes (a C/gamma sweep) share one
+    executable exactly like jit's own weak-type rule;
+  * tracer passthrough: a wrapped entry point called inside another
+    trace (jit/vmap) does not try to AOT-compile tracers;
+  * cost/memory normalisation (obs.costs) across the dict / list /
+    absent shapes cost_analysis() has had;
+  * `tpusvm report` renders a compile table with a nonzero
+    compile-time + FLOPs row from a train --trace run (or the explicit
+    unavailable marker).
+"""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from tpusvm.config import SVMConfig  # noqa: E402
+from tpusvm.data import rings  # noqa: E402
+from tpusvm.models import BinarySVC  # noqa: E402
+from tpusvm.obs import costs, prof  # noqa: E402
+from tpusvm.obs.registry import MetricsRegistry  # noqa: E402
+from tpusvm.solver.blocked import blocked_smo_solve  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _profiling_off():
+    yield
+    prof.disable_profiling()
+
+
+def _fit(X, Y, **cfg_kw):
+    return BinarySVC(config=SVMConfig(C=10.0, gamma=10.0, **cfg_kw)).fit(X, Y)
+
+
+# ------------------------------------------------------------ transparency
+def test_profiled_solve_bit_transparent():
+    X, Y = rings(n=240, seed=3)
+    base = _fit(X, Y)
+    with prof.profiling(registry=MetricsRegistry()):
+        profiled = _fit(X, Y)
+    assert np.asarray(base.sv_alpha_).tobytes() == \
+        np.asarray(profiled.sv_alpha_).tobytes()
+    assert np.array_equal(base.sv_ids_, profiled.sv_ids_)
+    assert base.b_ == profiled.b_
+    assert np.array_equal(
+        np.asarray(base.decision_function(X[:16])),
+        np.asarray(profiled.decision_function(X[:16])),
+    )
+
+
+# ---------------------------------------------------------------- records
+def test_compile_event_and_gauges():
+    X, Y = rings(n=200, seed=5)
+    events = []
+    reg = MetricsRegistry()
+    with prof.profiling(registry=reg,
+                        event_sink=lambda n, **a: events.append((n, a))):
+        _fit(X, Y)
+    solver = [a for n, a in events
+              if n == "prof.compile"
+              and a["executable"] == "solver.blocked_smo_solve"]
+    assert len(solver) == 1
+    rec = solver[0]
+    assert rec["compile_s"] > 0 and rec["lower_s"] > 0
+    # either the backend priced it (nonzero FLOPs) or it is marked absent
+    if rec["cost_available"]:
+        assert rec["flops"] > 0
+        assert rec["arith_intensity"] is not None
+    else:
+        assert rec["flops"] is None
+    snap = {(e["name"], tuple(sorted(e["labels"].items()))): e
+            for e in reg.snapshot()["metrics"]}
+    key = ("prof.compiles",
+           (("executable", "solver.blocked_smo_solve"),))
+    assert snap[key]["value"] == 1
+    assert ("prof.compile_s",
+            (("executable", "solver.blocked_smo_solve"),)) in snap
+
+
+def test_compile_cache_and_weak_scalar_key():
+    X, Y = rings(n=200, seed=5)
+    events = []
+    with prof.profiling(registry=MetricsRegistry(),
+                        event_sink=lambda n, **a: events.append(a)):
+        _fit(X, Y)
+        n1 = len(events)
+        _fit(X, Y)                       # identical call: cache hit
+        assert len(events) == n1
+        # a (C, gamma) change is a TRACED-scalar change — shares the
+        # executable exactly like jit's own cache
+        BinarySVC(config=SVMConfig(C=1.0, gamma=2.0)).fit(X, Y)
+        assert len(events) == n1
+        # a shape change is a new signature -> one new compile
+        X2, Y2 = rings(n=150, seed=6)
+        _fit(X2, Y2)
+        assert len(events) > n1
+
+
+def test_tracer_passthrough_under_jit():
+    from tpusvm.solver.predict import decision_function
+
+    X, Y = rings(n=64, seed=1)
+    coef = jnp.zeros((64,), jnp.float32).at[0].set(1.0)
+    Xd = jnp.asarray(X, jnp.float32)
+    direct = np.asarray(decision_function(Xd[:8], Xd, coef, 0.0, gamma=1.0))
+    events = []
+    with prof.profiling(registry=MetricsRegistry(),
+                        event_sink=lambda n, **a: events.append(a)):
+        wrapped = jax.jit(
+            lambda q: decision_function(q, Xd, coef, 0.0, gamma=1.0)
+        )
+        out = np.asarray(wrapped(Xd[:8]))
+    # inside the outer jit the wrapper saw tracers: no AOT attempt, and
+    # numerics match the direct path
+    assert not events
+    np.testing.assert_array_equal(direct, out)
+
+
+def test_profiled_jit_preserves_surface():
+    import inspect
+
+    assert hasattr(blocked_smo_solve, "lower")
+    params = inspect.signature(blocked_smo_solve).parameters
+    assert "q" in params and "telemetry" in params
+    # the AOT surface still lowers/compiles (benchmarks use it directly)
+    X, Y = rings(n=96, seed=2)
+    compiled = blocked_smo_solve.lower(
+        jnp.asarray(X, jnp.float32), jnp.asarray(Y), C=10.0, gamma=10.0,
+        q=32, accum_dtype=jnp.float32,
+    ).compile()
+    res = compiled(jnp.asarray(X, jnp.float32), jnp.asarray(Y),
+                   C=10.0, gamma=10.0)
+    assert int(res.n_iter) >= 1
+
+
+def test_record_compile_without_observatory():
+    reg = MetricsRegistry()
+    rec = prof.record_compile("serve.bucket[m:b4]", 0.01, 0.2,
+                              compiled=None, registry=reg, bucket=4)
+    assert rec["cost_available"] is False
+    entries = {e["name"] for e in reg.snapshot()["metrics"]}
+    assert "prof.compiles" in entries and "prof.compile_s" in entries
+
+
+# ------------------------------------------------------------------ costs
+class _FakeCompiled:
+    def __init__(self, cost, mem=None):
+        self._cost, self._mem = cost, mem
+
+    def cost_analysis(self):
+        if isinstance(self._cost, Exception):
+            raise self._cost
+        return self._cost
+
+    def memory_analysis(self):
+        return self._mem
+
+
+def test_cost_summary_shapes():
+    d = {"flops": 10.0, "bytes accessed": 5.0}
+    assert costs.cost_summary(_FakeCompiled(d)) == {
+        "available": True, "flops": 10.0, "bytes_accessed": 5.0}
+    lst = [{"flops": 4.0, "bytes accessed": 2.0}, {"flops": 6.0}]
+    s = costs.cost_summary(_FakeCompiled(lst))
+    assert s["flops"] == 10.0 and s["bytes_accessed"] == 2.0
+    for bad in (None, [], RuntimeError("no cost model")):
+        s = costs.cost_summary(_FakeCompiled(bad))
+        assert s == {"available": False, "flops": None,
+                     "bytes_accessed": None}
+
+
+def test_arithmetic_intensity_edges():
+    assert costs.arithmetic_intensity(10.0, 5.0) == 2.0
+    assert costs.arithmetic_intensity(None, 5.0) is None
+    assert costs.arithmetic_intensity(10.0, None) is None
+    assert costs.arithmetic_intensity(10.0, 0.0) is None
+
+
+def test_compile_record_unavailable_marker():
+    rec = costs.compile_record("x", 0.1, 0.2, _FakeCompiled(None))
+    assert rec["cost_available"] is False and rec["flops"] is None
+
+
+# --------------------------------------------------------- report surface
+def test_format_compile_table_marks_unavailable():
+    from tpusvm.obs.report import format_compile_table
+
+    rows = [
+        {"executable": "solver.blocked_smo_solve", "lower_s": 0.1,
+         "compile_s": 0.5, "cost_available": True, "flops": 2e9,
+         "bytes_accessed": 1e8},
+        {"executable": "cascade.round_fn", "lower_s": 0.2,
+         "compile_s": 0.9, "cost_available": False, "flops": None,
+         "bytes_accessed": None},
+    ]
+    table = format_compile_table(rows)
+    assert "solver.blocked_smo_solve" in table
+    assert "cost_analysis: unavailable" in table
+    assert "no compile records" in format_compile_table([])
+
+
+def test_train_trace_report_shows_compile_table(tmp_path, capsys):
+    from tpusvm.cli import main
+
+    trace = str(tmp_path / "t.jsonl")
+    assert main(["train", "--platform", "cpu", "--smoke", "-q",
+                 "--trace", trace]) == 0
+    capsys.readouterr()
+    assert main(["report", trace]) == 0
+    out = capsys.readouterr().out
+    assert "compiles (lower/compile wall time" in out
+    assert "solver.blocked_smo_solve" in out
+    # the acceptance bar: >= 1 executable row with nonzero compile time
+    # and FLOPs, or an explicit unavailable marker
+    from tpusvm.obs import read_trace
+    from tpusvm.obs.report import compile_rows
+
+    rows = compile_rows(read_trace(trace))
+    assert rows
+    assert any(r["compile_s"] > 0 and
+               (r["flops"] or not r["cost_available"]) for r in rows)
+
+
+def test_serve_bucket_compiles_recorded():
+    from tpusvm.serve import ServeConfig, Server
+
+    X, Y = rings(n=200, seed=4)
+    model = _fit(X, Y)
+    with Server(ServeConfig(max_batch=4), dtype=jnp.float64) as srv:
+        srv.add_model("m", model)
+        srv.warmup()
+        snap = srv._worker("m").metrics.registry_snapshot()
+    names = {(e["name"], e["labels"].get("executable"))
+             for e in snap["metrics"]}
+    assert any(n == "prof.compiles" and x and x.startswith("serve.bucket[")
+               for n, x in names)

@@ -1,0 +1,209 @@
+"""Serving-SLO tests (ServeConfig slo_*, serve/metrics SLOConfig).
+
+Contracts (the acceptance bar):
+  * a forced-latency fault plan (tpusvm.faults latency injection on
+    serve.score) flips /healthz to "degraded" within one window;
+  * burn-rate gauges appear on /metrics (text) and in the snapshot;
+  * the window actually slides (injectable clock): violations age out
+    and the burn returns to zero;
+  * error burn counts served-and-failed outcomes, not admission-control
+    rejections;
+  * slo_shed feeds the burn into the admission path (OVERLOADED);
+  * with no SLO configured nothing changes (no gauges, health "ok").
+"""
+
+import urllib.request
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from tpusvm import faults  # noqa: E402
+from tpusvm.config import SVMConfig  # noqa: E402
+from tpusvm.data import rings  # noqa: E402
+from tpusvm.models import BinarySVC  # noqa: E402
+from tpusvm.serve import ServeConfig, Server  # noqa: E402
+from tpusvm.serve.metrics import Metrics, SLOConfig  # noqa: E402
+
+
+@pytest.fixture(scope="module")
+def model():
+    X, Y = rings(n=240, seed=3)
+    return BinarySVC(SVMConfig(C=10.0, gamma=10.0), dtype=jnp.float64).fit(
+        X, Y), X
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 1000.0
+
+    def __call__(self):
+        return self.t
+
+
+# ------------------------------------------------------------ metrics unit
+def test_slo_window_slides_with_clock():
+    clock = FakeClock()
+    m = Metrics(buckets=(1, 2), slo=SLOConfig(p99_ms=5.0, window_s=10.0),
+                clock=clock)
+    for _ in range(4):
+        m.observe_latency(0.050)  # 50 ms >> the 5 ms target
+    m.inc("ok", 4)
+    st = m.slo_status()
+    assert st["burning"] and st["latency_burn"] == pytest.approx(100.0)
+    # one window later the violations have aged out
+    clock.t += 11.0
+    st = m.slo_status()
+    assert st["latency_burn"] == 0.0 and not st["burning"]
+    assert st["window_requests"] == 0
+
+
+def test_error_burn_counts_failures_not_shedding():
+    clock = FakeClock()
+    m = Metrics(buckets=(1,), slo=SLOConfig(p99_ms=1000.0,
+                                            error_budget=0.1,
+                                            window_s=60.0), clock=clock)
+    m.inc("ok", 9)
+    m.inc("errors", 1)        # 10% error rate / 10% budget = burn 1.0
+    m.inc("overloaded", 50)   # shedding must NOT burn the error budget
+    m.inc("queue_full", 50)
+    st = m.slo_status()
+    assert st["error_burn"] == pytest.approx(1.0)
+    assert st["burning"]
+    assert st["window_requests"] == 10
+
+
+def test_slo_validation():
+    with pytest.raises(ValueError):
+        SLOConfig(p99_ms=0.0).validate()
+    with pytest.raises(ValueError):
+        SLOConfig(p99_ms=1.0, error_budget=1.5).validate()
+    with pytest.raises(ValueError):
+        ServeConfig(slo_shed=True).resolved_slo()  # shed needs a target
+    assert ServeConfig().resolved_slo() is None
+    assert ServeConfig(slo_p99_ms=5.0).resolved_slo().p99_ms == 5.0
+
+
+def test_no_slo_means_no_gauges_and_unchanged_snapshot():
+    m = Metrics(buckets=(1,))
+    m.inc("ok", 3)
+    m.observe_latency(0.5)
+    snap = m.snapshot()
+    assert "slo" not in snap
+    assert m.slo_status() is None
+    assert "slo" not in m.render_text()
+
+
+# --------------------------------------------------- the acceptance test
+def test_latency_fault_plan_degrades_healthz_within_one_window(model):
+    svc, X = model
+    plan = faults.FaultPlan.from_json({
+        "format_version": 1, "seed": 7,
+        "rules": [{"point": "serve.score", "kind": "latency",
+                   "p": 1.0, "delay_ms": 40.0}],
+    })
+    cfg = ServeConfig(max_batch=4, slo_p99_ms=5.0, slo_window_s=60.0)
+    with Server(cfg, dtype=jnp.float64) as srv:
+        srv.add_model("m", svc)
+        srv.warmup()
+        assert srv.health()["status"] == "ok"
+        with faults.active(plan):
+            for i in range(6):
+                r = srv.submit("m", X[i])
+                assert r.ok, r.status
+        h = srv.health()
+        assert h["status"] == "degraded"
+        assert h["slo"]["m"]["burning"] is True
+        assert h["slo"]["m"]["latency_burn"] >= 1.0
+        # burn gauges are on the text /metrics surface
+        text = srv.metrics_text()
+        assert 'tpusvm_serve_slo_latency_burn{model="m"}' in text
+        assert 'tpusvm_serve_slo_burning{model="m"} 1' in text
+        # and in the JSON snapshot + mergeable registry view
+        snap = srv.metrics("m")
+        assert snap["slo"]["burning"] is True
+        reg = srv._worker("m").metrics.registry_snapshot()
+        names = {e["name"] for e in reg["metrics"]}
+        assert "serve.slo_latency_burn" in names
+
+
+def test_http_healthz_and_metrics_carry_slo(model):
+    import json as _json
+
+    from tpusvm.serve.http import make_http_server, start_http_thread
+
+    svc, X = model
+    plan = faults.FaultPlan.from_json({
+        "format_version": 1, "seed": 7,
+        "rules": [{"point": "serve.score", "kind": "latency",
+                   "p": 1.0, "delay_ms": 40.0}],
+    })
+    cfg = ServeConfig(max_batch=4, slo_p99_ms=5.0, slo_window_s=60.0)
+    with Server(cfg, dtype=jnp.float64) as srv:
+        srv.add_model("m", svc)
+        srv.warmup()
+        httpd = make_http_server(srv, port=0)
+        start_http_thread(httpd)
+        try:
+            base = f"http://127.0.0.1:{httpd.server_address[1]}"
+            with faults.active(plan):
+                body = _json.dumps(
+                    {"instances": X[:4].tolist()}).encode()
+                req = urllib.request.Request(
+                    f"{base}/v1/models/m:predict", data=body,
+                    headers={"Content-Type": "application/json"})
+                urllib.request.urlopen(req).read()
+            h = _json.loads(
+                urllib.request.urlopen(f"{base}/healthz").read())
+            # degraded is still HTTP 200 (other models may be fine)
+            assert h["status"] == "degraded"
+            assert h["slo"]["m"]["burning"] is True
+            metrics = urllib.request.urlopen(
+                f"{base}/metrics").read().decode()
+            assert "tpusvm_serve_slo_latency_burn" in metrics
+        finally:
+            httpd.shutdown()
+
+
+def test_slo_shed_feeds_admission_control(model):
+    from tpusvm.status import ServeStatus
+
+    svc, X = model
+    plan = faults.FaultPlan.from_json({
+        "format_version": 1, "seed": 7,
+        "rules": [{"point": "serve.score", "kind": "latency",
+                   "p": 1.0, "delay_ms": 40.0, "max_hits": 6}],
+    })
+    cfg = ServeConfig(max_batch=4, slo_p99_ms=5.0, slo_window_s=60.0,
+                      slo_shed=True)
+    with Server(cfg, dtype=jnp.float64) as srv:
+        srv.add_model("m", svc)
+        srv.warmup()
+        with faults.active(plan):
+            for i in range(6):
+                r = srv.submit("m", X[i])
+                if not r.ok:
+                    break
+        # the budget is burning: new work is shed with OVERLOADED before
+        # it queues
+        r = srv.submit("m", X[0])
+        assert ServeStatus(r.status) == ServeStatus.OVERLOADED
+        assert srv.metrics("m")["overloaded"] >= 1
+
+
+def test_healthy_traffic_stays_ok(model):
+    svc, X = model
+    cfg = ServeConfig(max_batch=4, slo_p99_ms=2000.0, slo_window_s=60.0)
+    with Server(cfg, dtype=jnp.float64) as srv:
+        srv.add_model("m", svc)
+        srv.warmup()
+        for i in range(8):
+            assert srv.submit("m", X[i]).ok
+        h = srv.health()
+        assert h["status"] == "ok"
+        assert h["slo"]["m"]["burning"] is False
+        scores_direct, _ = srv.predict_direct("m", X[:8])
+        ref = np.asarray(svc.decision_function(X[:8]))
+        np.testing.assert_array_equal(np.asarray(scores_direct), ref)

@@ -135,6 +135,22 @@ class ModuleContext:
         # functions (e.g. the inner and outer `body` of a two-level
         # solver) each bind to their own combinator call
         self.functions: Dict[str, List[ast.AST]] = {}
+        # module-level `NAME = ("a", "b", ...)` string-tuple constants:
+        # solvers share one static_argnames tuple between their jit
+        # decorator and the compile observatory's wrapper, so the
+        # decorator references a Name rather than a literal —
+        # _static_names resolves it here
+        self.module_str_tuples: Dict[str, Set[str]] = {}
+        for n in self.tree.body:
+            if isinstance(n, ast.Assign) and len(n.targets) == 1 \
+                    and isinstance(n.targets[0], ast.Name) \
+                    and isinstance(n.value, (ast.Tuple, ast.List)) \
+                    and all(isinstance(e, ast.Constant)
+                            and isinstance(e.value, str)
+                            for e in n.value.elts):
+                self.module_str_tuples[n.targets[0].id] = {
+                    e.value for e in n.value.elts
+                }
         for n in ast.walk(self.tree):
             if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
                 self.functions.setdefault(n.name, []).append(n)
@@ -216,6 +232,10 @@ class ModuleContext:
                     statics |= {e.value for e in v.elts
                                 if isinstance(e, ast.Constant)
                                 and isinstance(e.value, str)}
+                elif isinstance(v, ast.Name):
+                    # static_argnames=_SOLVER_STATIC — a module-level
+                    # string-tuple constant shared with other consumers
+                    statics |= self.module_str_tuples.get(v.id, set())
             elif kw.arg == "static_argnums":
                 v = kw.value
                 nums = []

@@ -296,6 +296,9 @@ def _build_parser() -> argparse.ArgumentParser:
     ing.add_argument("--trace", metavar="PATH",
                      help="write ingest phase spans + shard/stream "
                      "counters to a JSONL telemetry trace")
+    ing.add_argument("--profile", "--xprof", metavar="DIR", dest="profile",
+                     help="capture a jax.profiler trace of the ingest "
+                     "phase (view in TensorBoard/Perfetto)")
     ing.add_argument("-q", "--quiet", action="store_true")
 
     pr = sub.add_parser("predict", parents=[common],
@@ -369,6 +372,33 @@ def _build_parser() -> argparse.ArgumentParser:
     sv.add_argument("--trace", metavar="PATH",
                     help="write serve phase spans + final per-model "
                     "metric snapshots to a JSONL telemetry trace")
+    sv.add_argument("--trace-max-bytes", type=int, default=None,
+                    metavar="N",
+                    help="size-cap the trace file: rotate PATH -> PATH.1 "
+                    "at N bytes (displaced records are counted in the "
+                    "obs.trace_dropped_records metric) so a long-running "
+                    "serve --trace cannot fill the disk; default: "
+                    "unbounded")
+    sv.add_argument("--profile", "--xprof", metavar="DIR", dest="profile",
+                    help="capture a jax.profiler trace of the serving "
+                    "section (smoke run, or the HTTP serve loop)")
+    slo = sv.add_argument_group("serving SLOs (performance observatory)")
+    slo.add_argument("--slo-p99-ms", type=float, default=None,
+                     metavar="MS",
+                     help="per-model p99 latency target: at most 1%% of "
+                     "windowed requests may exceed it; burn-rate gauges "
+                     "are exported on /metrics and /healthz degrades "
+                     "while a budget burns (default: no SLO)")
+    slo.add_argument("--slo-error-budget", type=float, default=0.001,
+                     metavar="FRAC",
+                     help="allowed windowed error fraction "
+                     "(errors/timeouts/unavailable; default 0.001)")
+    slo.add_argument("--slo-window-s", type=float, default=60.0,
+                     help="sliding SLO evaluation window (default 60)")
+    slo.add_argument("--slo-shed", action="store_true",
+                     help="admission control: shed new requests "
+                     "(OVERLOADED, retryable) while the latency budget "
+                     "burns; requires --slo-p99-ms")
 
     tu = sub.add_parser(
         "tune", parents=[common],
@@ -452,6 +482,10 @@ def _build_parser() -> argparse.ArgumentParser:
     out2.add_argument("--trace", metavar="PATH",
                       help="write search phase spans + per-point "
                       "tune.point events to a JSONL telemetry trace")
+    out2.add_argument("--profile", "--xprof", metavar="DIR",
+                      dest="profile",
+                      help="capture a jax.profiler trace of the search "
+                      "phase (view in TensorBoard/Perfetto)")
     out2.add_argument("--save", metavar="NPZ",
                       help="save the winner model trained on the full data")
     out2.add_argument("--smoke", action="store_true",
@@ -469,18 +503,42 @@ def _build_parser() -> argparse.ArgumentParser:
 
     rep = sub.add_parser(
         "report", parents=[common],
-        help="render a --trace JSONL telemetry file: phase summary "
-        "(the reference's three-line timing contract), convergence-gap "
-        "table, and non-zero counters")
-    rep.add_argument("path", metavar="TRACE",
-                     help="trace file written by --trace on "
-                     "train/tune/serve/ingest")
+        help="render --trace JSONL telemetry: phase summary (the "
+        "reference's three-line timing contract), compile/cost table, "
+        "convergence-gap table, and non-zero counters; several files or "
+        "a directory merge into one wall-clock-interleaved report")
+    rep.add_argument("path", metavar="TRACE", nargs="+",
+                     help="trace file(s) written by --trace on "
+                     "train/tune/serve/ingest, or a directory of them "
+                     "(cascade leaves / tune workers collate into one "
+                     "report; rotated trace.jsonl.1 sets are folded in)")
     rep.add_argument("--max-rows", type=int, default=40,
                      help="convergence table rows before middle elision")
     rep.add_argument("--smoke", action="store_true",
-                     help="CI gate: non-zero exit unless the trace "
-                     "parses at the current schema version and carries "
+                     help="CI gate: non-zero exit unless the trace(s) "
+                     "parse at the current schema version and carry "
                      "at least one phase span and one convergence record")
+
+    bd = sub.add_parser(
+        "benchdiff", parents=[common],
+        help="schema-aware comparison of two benchmark JSONL artifacts "
+        "(tpusvm.obs.benchdiff): per-metric direction/tolerance rules, "
+        "backend-provenance check, non-zero exit on any regression")
+    bd.add_argument("old", metavar="OLD.jsonl",
+                    help="baseline artifact (e.g. a committed "
+                    "benchmarks/results file)")
+    bd.add_argument("new", metavar="NEW.jsonl",
+                    help="candidate artifact to gate")
+    bd.add_argument("--level", choices=["full", "smoke"], default="full",
+                    help="full = every rule; smoke = direction-only "
+                    "(wall-clock rules skipped — the CI gate, where the "
+                    "runner is not the baseline's machine)")
+    bd.add_argument("--format", choices=["text", "json", "markdown"],
+                    default="text", help="verdict rendering")
+    bd.add_argument("--allow-cross-backend", action="store_true",
+                    help="annotate instead of refusing when the two "
+                    "artifacts ran on different backends (default: "
+                    "refuse — cross-backend numbers are not comparable)")
     return p
 
 
@@ -572,6 +630,34 @@ def _parse_solver_opts(items) -> dict:
         else:
             opts[key] = value
     return opts
+
+
+def _make_tracer(args, command: str):
+    """The shared --trace plumbing (train/tune/serve/ingest): one Tracer
+    receiving fault/retry/breaker lifecycle events AND the compile
+    observatory's prof.compile records (lower/compile wall time, XLA
+    cost analysis — tpusvm.obs.prof), plus a profile.capture event when
+    --profile/--xprof is also set so the trace names the capture dir."""
+    if not getattr(args, "trace", None):
+        return None
+    from tpusvm import faults
+    from tpusvm.obs import Tracer, prof
+
+    tracer = Tracer(args.trace, argv=[command],
+                    max_bytes=getattr(args, "trace_max_bytes", None))
+    faults.set_event_sink(tracer.event)
+    prof.enable_profiling(event_sink=tracer.event)
+    if getattr(args, "profile", None):
+        tracer.event("profile.capture", dir=args.profile)
+    return tracer
+
+
+def _close_tracer(tracer) -> None:
+    from tpusvm.obs import prof
+
+    prof.disable_profiling()
+    if tracer is not None:
+        tracer.close()
 
 
 def _cmd_train(args) -> int:
@@ -744,15 +830,7 @@ def _cmd_train(args) -> int:
                              "are the same knob; pass one")
         solver_opts["telemetry"] = args.convergence
 
-    tracer = None
-    if args.trace:
-        from tpusvm.obs import Tracer
-
-        tracer = Tracer(args.trace, argv=["train"])
-        # fault/retry/breaker lifecycle events land in the same trace
-        from tpusvm import faults as _faults
-
-        _faults.set_event_sink(tracer.event)
+    tracer = _make_tracer(args, "train")
     log = RunLogger(jsonl_path=args.jsonl,
                     primary=(jax.process_index() == 0) and not args.quiet)
     timer = PhaseTimer(tracer=tracer)
@@ -916,8 +994,7 @@ def _cmd_train(args) -> int:
     log.info("%s", timer.report())
     log.event("timing", **timer.asdict())
     log.close()
-    if tracer is not None:
-        tracer.close()
+    _close_tracer(tracer)
 
     if args.smoke:
         gate_name = "r2" if args.task == "svr" else "accuracy"
@@ -983,7 +1060,7 @@ def _cmd_ingest(args) -> int:
     """Convert a CSV / synthetic generator into a sharded dataset dir."""
     from tpusvm.status import StreamStatus
     from tpusvm.stream import ingest_arrays, ingest_csv, open_dataset
-    from tpusvm.utils import PhaseTimer
+    from tpusvm.utils import PhaseTimer, trace
 
     say = (lambda msg: None) if args.quiet else print
 
@@ -998,18 +1075,10 @@ def _cmd_ingest(args) -> int:
                          "sine generates continuous SVR targets "
                          "(train --task svr reads it directly)")
 
-    tracer = None
-    if args.trace:
-        from tpusvm.obs import Tracer
-
-        tracer = Tracer(args.trace, argv=["ingest"])
-        # fault/retry/breaker lifecycle events land in the same trace
-        from tpusvm import faults as _faults
-
-        _faults.set_event_sink(tracer.event)
+    tracer = _make_tracer(args, "ingest")
     timer = PhaseTimer(tracer=tracer)
 
-    with timer.phase("ingest"):
+    with timer.phase("ingest"), trace(args.profile):
         if args.train:
             manifest = ingest_csv(
                 args.out, args.train, rows_per_shard=args.rows_per_shard,
@@ -1042,7 +1111,7 @@ def _cmd_ingest(args) -> int:
                      n_shards=len(manifest.shards), out=args.out,
                      valid=not bad)
         tracer.metrics_snapshot(default_registry().snapshot())
-        tracer.close()
+    _close_tracer(tracer)
     if bad:
         print(f"ingest: wrote shards that FAIL validation: {bad}")
         return 1
@@ -1212,26 +1281,21 @@ def _cmd_serve(args) -> int:
         shed_threshold=args.shed_threshold,
         breaker_threshold=args.breaker_threshold,
         breaker_cooldown_s=args.breaker_cooldown_s,
+        slo_p99_ms=args.slo_p99_ms,
+        slo_error_budget=args.slo_error_budget,
+        slo_window_s=args.slo_window_s,
+        slo_shed=args.slo_shed,
     )
-    tracer = None
-    if args.trace:
-        from tpusvm.obs import Tracer
-
-        tracer = Tracer(args.trace, argv=["serve"])
-        # fault/retry/breaker lifecycle events land in the same trace
-        from tpusvm import faults as _faults
-
-        _faults.set_event_sink(tracer.event)
+    tracer = _make_tracer(args, "serve")
 
     def _trace_final_metrics():
-        if tracer is None:
-            return
-        for name in server.registry.names():
-            tracer.event("serve.metrics", model=name,
-                         snapshot=server.metrics(name))
-            tracer.metrics_snapshot(
-                server._worker(name).metrics.registry_snapshot())
-        tracer.close()
+        if tracer is not None:
+            for name in server.registry.names():
+                tracer.event("serve.metrics", model=name,
+                             snapshot=server.metrics(name))
+                tracer.metrics_snapshot(
+                    server._worker(name).metrics.registry_snapshot())
+        _close_tracer(tracer)
 
     server = Server(cfg, dtype=getattr(jnp, args.dtype))
     for spec in args.models:
@@ -1250,10 +1314,12 @@ def _cmd_serve(args) -> int:
             for name, n in server.warmup().items():
                 print(f"warmed {name}: {n} bucket executables compiled")
 
+    from tpusvm.utils import trace as _profile_trace
+
     if args.smoke:
         smoke_span = (tracer.span("smoke", phase=True) if tracer
                       else contextlib.nullcontext())
-        with smoke_span:
+        with smoke_span, _profile_trace(args.profile):
             rc = _serve_smoke(server, args.smoke_threads,
                               args.smoke_requests)
         print(server.metrics_text(), end="")
@@ -1268,7 +1334,8 @@ def _cmd_serve(args) -> int:
     print(f"serving on http://{host}:{port} "
           f"(POST /v1/models/<name>:predict, GET /metrics)")
     try:
-        httpd.serve_forever()
+        with _profile_trace(args.profile):
+            httpd.serve_forever()
     except KeyboardInterrupt:
         pass
     finally:
@@ -1392,15 +1459,7 @@ def _cmd_tune(args) -> int:
             "auto" if args.accum == "float64" else None
         )
 
-    tracer = None
-    if args.trace:
-        from tpusvm.obs import Tracer
-
-        tracer = Tracer(args.trace, argv=["tune"])
-        # fault/retry/breaker lifecycle events land in the same trace
-        from tpusvm import faults as _faults
-
-        _faults.set_event_sink(tracer.event)
+    tracer = _make_tracer(args, "tune")
     timer = PhaseTimer(tracer=tracer)
     dataset = None
     if args.data:
@@ -1434,7 +1493,9 @@ def _cmd_tune(args) -> int:
         f"grid = {grid.shape[0]}x{grid.shape[1]}, folds = {args.folds}, "
         f"schedule = {args.schedule}")
 
-    with timer.phase("search"):
+    from tpusvm.utils import trace as _profile_trace
+
+    with timer.phase("search"), _profile_trace(args.profile):
         result = tune(
             X, Y, grid, config, base=base, dtype=getattr(jnp, args.dtype),
             accum_dtype=accum, scale=not args.no_scale,
@@ -1477,7 +1538,7 @@ def _cmd_tune(args) -> int:
         from tpusvm.obs import default_registry
 
         tracer.metrics_snapshot(default_registry().snapshot())
-        tracer.close()
+    _close_tracer(tracer)
 
     if args.smoke:
         evaluated = [r for r in result.points
@@ -1588,22 +1649,54 @@ def _info_dataset(path: str) -> int:
     return 0
 
 
+def _report_paths(raw_paths) -> list:
+    """Expand the report positionals: directories become their sorted
+    *.jsonl members (rotated .jsonl.N backups are folded in by
+    read_trace, so they are not listed separately)."""
+    import glob
+    import os
+
+    paths = []
+    for p in raw_paths:
+        if os.path.isdir(p):
+            members = sorted(glob.glob(os.path.join(p, "*.jsonl")))
+            if not members:
+                raise SystemExit(
+                    f"report: directory {p!r} holds no *.jsonl trace files"
+                )
+            paths.extend(members)
+        else:
+            paths.append(p)
+    return paths
+
+
 def _cmd_report(args) -> int:
-    """Render a --trace JSONL telemetry file back into the reference's
-    human-readable contracts (phase timing block + convergence table)."""
+    """Render --trace JSONL telemetry back into the reference's
+    human-readable contracts (phase timing block + convergence table),
+    plus the compile observatory's cost table. Several files (or a
+    directory) merge into one wall-clock-interleaved report: registry
+    snapshots merge exactly, phase durations accumulate, and the total
+    is the cross-process wall envelope."""
     from tpusvm.obs import read_trace
     from tpusvm.obs.report import (
+        compile_rows,
         convergence_rows,
+        format_compile_table,
         format_convergence_table,
+        merge_trace_files,
         nonzero_counters,
         phase_summary,
         render_phase_lines,
     )
 
+    paths = _report_paths(args.path)
     try:
-        records = read_trace(args.path)
+        if len(paths) == 1:
+            records = read_trace(paths[0])
+        else:
+            records = merge_trace_files(paths)
     except OSError as e:
-        raise SystemExit(f"report: cannot read {args.path!r} ({e})")
+        raise SystemExit(f"report: cannot read trace ({e})")
     except ValueError as e:
         if args.smoke:
             print(f"REPORT SMOKE FAILED: {e}")
@@ -1614,8 +1707,17 @@ def _cmd_report(args) -> int:
     conv = convergence_rows(records)
     spans = sum(1 for r in records if r["kind"] == "span")
     events = sum(1 for r in records if r["kind"] == "event")
-    print(f"trace: {args.path} ({spans} spans, {events} events)")
+    label = paths[0] if len(paths) == 1 else f"{len(paths)} files"
+    print(f"trace: {label} ({spans} spans, {events} events)")
+    if len(paths) > 1:
+        for p in paths:
+            print(f"  {p}")
     print()
+    comp = compile_rows(records)
+    if comp:
+        print("compiles (lower/compile wall time, XLA cost analysis):")
+        print(format_compile_table(comp))
+        print()
     print("convergence (b_low - b_high per outer round):")
     print(format_convergence_table(conv, max_rows=args.max_rows))
     print()
@@ -1640,6 +1742,13 @@ def _cmd_report(args) -> int:
         print(f"report smoke ok: {len(phases)} phases, "
               f"{len(conv)} convergence rounds")
     return 0
+
+
+def _cmd_benchdiff(args) -> int:
+    """Schema-aware regression gate over two benchmark JSONL artifacts."""
+    from tpusvm.obs.benchdiff import run_benchdiff
+
+    return run_benchdiff(args)
 
 
 def _cmd_info(args) -> int:
@@ -1706,7 +1815,8 @@ def main(argv=None) -> int:
     return {"train": _cmd_train, "ingest": _cmd_ingest,
             "predict": _cmd_predict, "serve": _cmd_serve,
             "tune": _cmd_tune, "info": _cmd_info,
-            "report": _cmd_report}[args.command](args)
+            "report": _cmd_report,
+            "benchdiff": _cmd_benchdiff}[args.command](args)
 
 
 if __name__ == "__main__":

@@ -48,6 +48,7 @@ import numpy as np
 from tpusvm.config import SVMConfig, resolve_accum_dtype
 from tpusvm.data.scaler import MinMaxScaler
 from tpusvm.models.serialization import load_model, save_model
+from tpusvm.obs import prof
 from tpusvm.ops.rbf import sq_norms
 from tpusvm.solver.smo import smo_solve
 from tpusvm.status import Status
@@ -354,12 +355,21 @@ class OneVsRestSVC:
         return model
 
 
-@functools.partial(jax.jit, static_argnames=("kernel", "degree"))
-def _ovr_scores(Xq, X_sv, coef, b, gamma, coef0=0.0, *, kernel="rbf",
-                degree=3):
+_OVR_SCORES_STATIC = ("kernel", "degree")
+
+
+@functools.partial(jax.jit, static_argnames=_OVR_SCORES_STATIC)
+def _ovr_scores_jit(Xq, X_sv, coef, b, gamma, coef0=0.0, *, kernel="rbf",
+                    degree=3):
     from tpusvm import kernels
 
     snB = sq_norms(X_sv) if kernels.needs_norms(kernel) else None
     K = kernels.cross(kernel, Xq, X_sv, gamma=gamma, coef0=coef0,
                       degree=degree, snB=snB)  # (m, n_sv)
     return K @ coef.T - b[None, :]
+
+
+# compile-observatory wrapper (tpusvm.obs.prof); serve's bucket cache
+# uses the preserved `.lower` surface
+_ovr_scores = prof.profiled_jit("predict.ovr_scores", _ovr_scores_jit,
+                                static=_OVR_SCORES_STATIC)

@@ -1,0 +1,373 @@
+"""Machine-checked comparison of two benchmark JSONL artifacts.
+
+The repo carries 20+ committed `benchmarks/results/*.jsonl` artifacts and,
+until this round, NO machine-checked way to compare two of them — a perf
+regression (or a CPU-fallback run masquerading as TPU numbers, the
+BENCH_r02–r05 failure) could land silently. `tpusvm benchdiff old new`
+closes that:
+
+  * records pair up by schema (`bench` field) + identifying fields
+    (mode/engine/n/seed/...); a baseline row with no counterpart in the
+    new artifact is itself a regression (a silently-skipped bench);
+  * each schema declares per-metric RULES — direction + tolerance:
+    `>=` for throughput-like metrics (new may not fall below
+    old - rel·|old|), `<=` for latency/overhead-like ones, `==` for
+    correctness booleans (bit_identical, status). Wall-clock rules are
+    marked `timing` and SKIPPED at `--level smoke` (CI machines are not
+    the committed baseline's machine; correctness/direction metrics
+    still gate) — the "direction-only rules at smoke scale" CI gate;
+  * PROVENANCE is compared first: records carry a backend (the
+    `provenance` dict bench harnesses now emit, falling back to the
+    older `platform` field), and a cross-backend diff is REFUSED unless
+    `--allow-cross-backend` (then it is annotated) — exactly the
+    mismatch that let r02–r05's single-CPU fallbacks read as
+    TPU-comparable numbers.
+
+Unknown schemas get the default rules only (violations must stay empty,
+bit_identical must stay true) so `benchdiff a a` is exit-0 on every
+committed artifact (asserted by tests/test_benchdiff.py) while still
+catching the universal failure shapes.
+
+Output: text (default), --format json / markdown. Exit 0 = clean,
+non-zero = regression, missing rows, or a refused comparison.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Any, Dict, List, Optional, Tuple
+
+# identifying fields, in precedence order, used to pair rows between the
+# two artifacts (only fields PRESENT in a record participate in its key)
+KEY_FIELDS = (
+    "bench", "metric", "summary", "mode", "engine", "kernel", "task",
+    "config", "threads", "topology", "P", "n", "n_train", "d", "q",
+    "seed", "case", "rows_per_shard", "telemetry", "smoke",
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class Rule:
+    """One metric's comparison rule.
+
+    direction: ">=" (new may not fall below old), "<=" (may not rise
+    above), "==" (exact), "empty" (must stay empty when old is empty —
+    the violations-list rule). rel_tol/abs_tol widen the band
+    (new <= old + rel·|old| + abs for "<=", mirrored for ">=").
+    timing=True marks wall-clock metrics, skipped at level="smoke"."""
+
+    metric: str
+    direction: str
+    rel_tol: float = 0.0
+    abs_tol: float = 0.0
+    timing: bool = False
+
+
+DEFAULT_RULES: Tuple[Rule, ...] = (
+    Rule("violations", "empty"),
+    Rule("bit_identical", "=="),
+)
+
+SCHEMA_RULES: Dict[str, Tuple[Rule, ...]] = {
+    "telemetry_overhead": (
+        Rule("status", "=="),
+        Rule("overhead_frac", "<=", abs_tol=0.02, timing=True),
+        Rule("t_on_s", "<=", rel_tol=0.3, timing=True),
+        Rule("t_off_s", "<=", rel_tol=0.3, timing=True),
+    ),
+    "serve_latency": (
+        Rule("errors", "<="),
+        Rule("timeouts", "<="),
+        Rule("queue_full", "<="),
+        Rule("recompiles", "<="),
+        Rule("not_ok", "<="),
+        Rule("qps", ">=", rel_tol=0.25, timing=True),
+        Rule("sequential_qps", ">=", rel_tol=0.25, timing=True),
+        Rule("vs_sequential", ">=", rel_tol=0.25, timing=True),
+        Rule("p99_ms", "<=", rel_tol=0.5, timing=True),
+        Rule("p50_ms", "<=", rel_tol=0.5, timing=True),
+    ),
+    "ingest_throughput": (
+        Rule("max_live_shards", "<="),
+        Rule("ingest_rows_per_s", ">=", rel_tol=0.3, timing=True),
+        Rule("prefetch_speedup", ">=", rel_tol=0.3, timing=True),
+    ),
+    "kernel_matrix": (
+        Rule("status", "=="),
+        Rule("n_sv", "=="),
+        Rule("min_speedup", ">=", rel_tol=0.25, timing=True),
+        Rule("wall_s", "<=", rel_tol=0.4, timing=True),
+    ),
+    "tune_sweep": (
+        Rule("same_winner", "=="),
+        Rule("total_saving", ">=", abs_tol=0.05),
+        Rule("warm_total_updates", "<=", rel_tol=0.1),
+    ),
+    "mnist60k_smo_train_time": (
+        Rule("value", "<=", rel_tol=0.3, timing=True),
+        Rule("vs_baseline", ">=", rel_tol=0.3, timing=True),
+    ),
+}
+
+
+# ------------------------------------------------------------------ loading
+def load_jsonl(path: str) -> List[dict]:
+    records = []
+    with open(path) as f:
+        for i, line in enumerate(f, 1):
+            if not line.strip():
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError as e:
+                raise ValueError(
+                    f"{path}:{i}: not a JSON record ({e})"
+                ) from None
+            if isinstance(rec, dict):
+                records.append(rec)
+    return records
+
+
+def schema_of(rec: dict) -> str:
+    return str(rec.get("bench") or rec.get("metric") or "unknown")
+
+
+def backend_of(rec: dict) -> Optional[str]:
+    prov = rec.get("provenance")
+    if isinstance(prov, dict) and prov.get("backend"):
+        return str(prov["backend"])
+    if rec.get("platform"):
+        return str(rec["platform"])
+    return None
+
+
+def _row_key(rec: dict) -> Tuple:
+    return (schema_of(rec),) + tuple(
+        (k, json.dumps(rec[k], sort_keys=True, default=str))
+        for k in KEY_FIELDS if k in rec
+    )
+
+
+# ------------------------------------------------------------------ diffing
+@dataclasses.dataclass
+class Finding:
+    kind: str        # "regression" | "refused" | "note"
+    schema: str
+    metric: str
+    message: str
+    old: Any = None
+    new: Any = None
+
+    def asdict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+@dataclasses.dataclass
+class DiffResult:
+    old_path: str
+    new_path: str
+    level: str
+    rows_compared: int = 0
+    checks: int = 0
+    findings: List[Finding] = dataclasses.field(default_factory=list)
+
+    @property
+    def regressions(self) -> List[Finding]:
+        return [f for f in self.findings if f.kind == "regression"]
+
+    @property
+    def refusals(self) -> List[Finding]:
+        return [f for f in self.findings if f.kind == "refused"]
+
+    @property
+    def ok(self) -> bool:
+        return not self.regressions and not self.refusals
+
+    # ------------------------------------------------------------ renderers
+    def to_json(self) -> str:
+        return json.dumps({
+            "old": self.old_path, "new": self.new_path,
+            "level": self.level, "rows_compared": self.rows_compared,
+            "checks": self.checks, "ok": self.ok,
+            "findings": [f.asdict() for f in self.findings],
+        }, indent=2)
+
+    def _verdict(self) -> str:
+        if self.refusals:
+            return "REFUSED"
+        return "PASS" if self.ok else "FAIL"
+
+    def to_text(self) -> str:
+        lines = [
+            f"benchdiff: {self.old_path} -> {self.new_path} "
+            f"(level={self.level})",
+            f"  {self.rows_compared} row pairs, {self.checks} checks",
+        ]
+        for f in self.findings:
+            tag = {"regression": "REGRESSION", "refused": "REFUSED",
+                   "note": "note"}[f.kind]
+            lines.append(f"  [{tag}] {f.schema}/{f.metric}: {f.message}")
+        lines.append(f"verdict: {self._verdict()}")
+        return "\n".join(lines)
+
+    def to_markdown(self) -> str:
+        lines = [
+            f"### benchdiff `{self.old_path}` → `{self.new_path}`",
+            "",
+            f"- level: `{self.level}` — {self.rows_compared} row pairs, "
+            f"{self.checks} checks",
+            f"- verdict: **{self._verdict()}**",
+        ]
+        if self.findings:
+            lines += ["", "| kind | schema | metric | old | new | detail |",
+                      "|---|---|---|---|---|---|"]
+            for f in self.findings:
+                lines.append(
+                    f"| {f.kind} | {f.schema} | {f.metric} | {f.old} | "
+                    f"{f.new} | {f.message} |"
+                )
+        return "\n".join(lines)
+
+
+def _check_rule(rule: Rule, old: dict, new: dict, schema: str,
+                result: DiffResult) -> None:
+    m = rule.metric
+    in_old, in_new = m in old, m in new
+    if not in_old and not in_new:
+        return
+    if in_old and not in_new:
+        result.checks += 1
+        result.findings.append(Finding(
+            "regression", schema, m,
+            "metric present in baseline but missing from new artifact",
+            old=old.get(m)))
+        return
+    if not in_old:
+        result.findings.append(Finding(
+            "note", schema, m, "new metric (absent from baseline)",
+            new=new.get(m)))
+        return
+    ov, nv = old[m], new[m]
+    result.checks += 1
+    if rule.direction == "empty":
+        if not ov and nv:
+            result.findings.append(Finding(
+                "regression", schema, m,
+                f"baseline had none, new artifact has {nv}",
+                old=ov, new=nv))
+        return
+    if rule.direction == "==":
+        if ov != nv:
+            result.findings.append(Finding(
+                "regression", schema, m, "values differ", old=ov, new=nv))
+        return
+    if not isinstance(ov, (int, float)) or not isinstance(nv, (int, float)) \
+            or isinstance(ov, bool) or isinstance(nv, bool):
+        if ov != nv:
+            result.findings.append(Finding(
+                "note", schema, m,
+                "non-numeric values differ under a numeric rule",
+                old=ov, new=nv))
+        return
+    band = rule.rel_tol * abs(ov) + rule.abs_tol
+    if rule.direction == "<=":
+        if nv > ov + band:
+            result.findings.append(Finding(
+                "regression", schema, m,
+                f"rose beyond tolerance (allowed <= {ov + band:g})",
+                old=ov, new=nv))
+    elif rule.direction == ">=":
+        if nv < ov - band:
+            result.findings.append(Finding(
+                "regression", schema, m,
+                f"fell beyond tolerance (allowed >= {ov - band:g})",
+                old=ov, new=nv))
+    else:
+        raise ValueError(f"unknown rule direction {rule.direction!r}")
+
+
+def rules_for(schema: str) -> List[Rule]:
+    specific = SCHEMA_RULES.get(schema, ())
+    named = {r.metric for r in specific}
+    return list(specific) + [r for r in DEFAULT_RULES
+                             if r.metric not in named]
+
+
+def diff_records(old_recs: List[dict], new_recs: List[dict],
+                 old_path: str = "<old>", new_path: str = "<new>",
+                 level: str = "full",
+                 allow_cross_backend: bool = False) -> DiffResult:
+    if level not in ("full", "smoke"):
+        raise ValueError(f"level must be full|smoke, got {level!r}")
+    result = DiffResult(old_path, new_path, level)
+
+    # group rows by key, pair in file order within a key
+    def group(recs):
+        g: Dict[Tuple, List[dict]] = {}
+        for r in recs:
+            g.setdefault(_row_key(r), []).append(r)
+        return g
+
+    g_old, g_new = group(old_recs), group(new_recs)
+    for key, olds in g_old.items():
+        news = g_new.get(key, [])
+        schema = key[0]
+        for i, old in enumerate(olds):
+            if i >= len(news):
+                result.checks += 1
+                result.findings.append(Finding(
+                    "regression", schema, "<row>",
+                    f"baseline row {dict(key[1:])} has no counterpart in "
+                    "the new artifact"))
+                continue
+            new = news[i]
+            result.rows_compared += 1
+            ob, nb = backend_of(old), backend_of(new)
+            if ob and nb and ob != nb:
+                kind = "note" if allow_cross_backend else "refused"
+                result.findings.append(Finding(
+                    kind, schema, "provenance",
+                    f"backend mismatch: baseline ran on {ob!r}, new on "
+                    f"{nb!r} — cross-backend numbers are not comparable "
+                    "(the r02-r05 CPU-fallback trap); re-run on the "
+                    "baseline's backend or pass --allow-cross-backend "
+                    "to annotate instead",
+                    old=ob, new=nb))
+                if kind == "refused":
+                    continue
+            for rule in rules_for(schema):
+                if level == "smoke" and rule.timing:
+                    continue
+                _check_rule(rule, old, new, schema, result)
+    for key, news in g_new.items():
+        extra = len(news) - len(g_old.get(key, []))
+        if extra > 0:
+            result.findings.append(Finding(
+                "note", key[0], "<row>",
+                f"{extra} new row(s) with no baseline counterpart"))
+    return result
+
+
+def diff_files(old_path: str, new_path: str, level: str = "full",
+               allow_cross_backend: bool = False) -> DiffResult:
+    return diff_records(load_jsonl(old_path), load_jsonl(new_path),
+                        old_path=old_path, new_path=new_path, level=level,
+                        allow_cross_backend=allow_cross_backend)
+
+
+def run_benchdiff(args) -> int:
+    """CLI entry (`tpusvm benchdiff`): renders the verdict, exit 0/1."""
+    try:
+        result = diff_files(args.old, args.new, level=args.level,
+                            allow_cross_backend=args.allow_cross_backend)
+    except (OSError, ValueError) as e:
+        print(f"benchdiff: {e}")
+        return 1
+    if args.format == "json":
+        print(result.to_json())
+    elif args.format == "markdown":
+        print(result.to_markdown())
+    else:
+        print(result.to_text())
+    return 0 if result.ok else 1

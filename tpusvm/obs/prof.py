@@ -1,0 +1,256 @@
+"""The compile observatory: lower/compile accounting on every jit entry.
+
+The ROADMAP's two hottest open items (the Pallas mixed-precision ladder,
+the traffic-scale serving runtime) are both attribution problems first:
+nobody can say where compile time, FLOPs or bytes actually go. This
+module answers that with ZERO change to the default path:
+
+  * `profiled_jit(name, jitted)` wraps a jit entry point. With the
+    observatory DISABLED (the default) the wrapper is one attribute read
+    + the original jit call — the compiled program, its cache, and every
+    result byte are untouched.
+  * With the observatory ENABLED (CLI `--trace`, or tests via
+    `profiling(...)`), calls route through an explicit
+    `jitted.lower(...).compile()` per distinct input signature: the
+    lower and compile wall times are measured, the executable's
+    `cost_analysis()` / `memory_analysis()` are read (obs.costs), and
+    one `prof.compile` record lands in the metrics registry (gauges +
+    a compile counter) and the trace event sink. The compiled
+    executable is then CALLED and cached, so steady-state profiled runs
+    pay one extra dict lookup, not a recompile.
+
+Bit-transparency is a hard contract: the AOT executable is built from
+the same jaxpr the jit cache would build, so alpha bytes / SV ids / b
+are identical with the observatory on or off (tests/test_prof.py).
+Two escape hatches keep it safe everywhere:
+
+  * tracer passthrough — a wrapped entry called INSIDE another trace
+    (cascade's shard_map body, ovr's vmap) sees abstract tracers and
+    simply calls the jitted function (jit-of-jit inlines as always);
+  * call fallback — if the AOT executable refuses the concrete call
+    (an aval signature this module keyed wrong), the original jit path
+    runs instead and a `prof.fallbacks` counter says so. Wrong never;
+    slow-but-honest at worst.
+
+Signature keys deliberately mirror jit's own cache rules: arrays key by
+(shape, dtype), Python scalars by weak type (NOT value — a tune sweep
+varying C must reuse one executable), static kwargs by value.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import functools
+import threading
+import time
+from typing import Any, Callable, Dict, Iterator, Optional, Tuple
+
+import numpy as np
+
+from tpusvm.obs import costs
+from tpusvm.obs.registry import MetricsRegistry, default_registry
+
+
+class CompileObservatory:
+    """Holds the compile cache + where records go while profiling is on."""
+
+    def __init__(self, registry: Optional[MetricsRegistry] = None,
+                 event_sink: Optional[Callable[..., None]] = None):
+        self.registry = registry if registry is not None \
+            else default_registry()
+        self.event_sink = event_sink
+        self._lock = threading.Lock()
+        # key -> (fn, compiled): fn is kept so id(fn) in the key can
+        # never alias a garbage-collected closure's reused id
+        self._cache: Dict[Tuple, Tuple[Any, Any]] = {}
+        self.records: list = []  # compile records, in compile order
+
+    # ------------------------------------------------------------ recording
+    def record(self, rec: dict) -> None:
+        name = rec["executable"]
+        self.records.append(rec)
+        reg = self.registry
+        reg.counter("prof.compiles", executable=name).inc()
+        reg.gauge("prof.lower_s", executable=name).set_max(rec["lower_s"])
+        reg.gauge("prof.compile_s", executable=name).set_max(
+            rec["compile_s"])
+        for key in ("flops", "bytes_accessed", "arith_intensity",
+                    "temp_bytes"):
+            v = rec.get(key)
+            if v is not None:
+                reg.gauge(f"prof.{key}", executable=name).set_max(v)
+        if self.event_sink is not None:
+            self.event_sink("prof.compile", **rec)
+
+    # ------------------------------------------------------------- the call
+    def call(self, name: str, fn, args: tuple, static: tuple,
+             kwargs: dict):
+        static_kw = {k: kwargs[k] for k in kwargs if k in static}
+        dyn_kw = {k: v for k, v in kwargs.items() if k not in static}
+        key = (name, id(fn), _signature_key(args, dyn_kw),
+               tuple(sorted((k, repr(v)) for k, v in static_kw.items())))
+        with self._lock:
+            entry = self._cache.get(key)
+        if entry is None:
+            try:
+                t0 = time.perf_counter()
+                lowered = fn.lower(*args, **kwargs)
+                t1 = time.perf_counter()
+                compiled = lowered.compile()
+                t2 = time.perf_counter()
+            except Exception:  # noqa: BLE001 — never lose the run to the
+                # observatory: an entry point the AOT surface cannot
+                # lower (donations, custom transforms) falls back whole
+                self.registry.counter("prof.fallbacks",
+                                      executable=name).inc()
+                return fn(*args, **kwargs)
+            self.record(costs.compile_record(name, t1 - t0, t2 - t1,
+                                             compiled))
+            with self._lock:
+                self._cache[key] = entry = (fn, compiled)
+        _, compiled = entry
+        try:
+            return compiled(*args, **dyn_kw)
+        except (TypeError, ValueError):
+            # aval mismatch this module's key failed to distinguish:
+            # honesty over speed — run the normal jit path and count it
+            self.registry.counter("prof.fallbacks", executable=name).inc()
+            return fn(*args, **kwargs)
+
+
+# ------------------------------------------------------------ module state
+_active: Optional[CompileObservatory] = None
+_lock = threading.Lock()
+
+
+def enable_profiling(registry: Optional[MetricsRegistry] = None,
+                     event_sink: Optional[Callable[..., None]] = None,
+                     ) -> CompileObservatory:
+    """Turn the observatory on process-wide; returns it (idempotent-ish:
+    a second enable replaces the first — last caller wins)."""
+    global _active
+    with _lock:
+        _active = CompileObservatory(registry=registry,
+                                     event_sink=event_sink)
+        return _active
+
+
+def disable_profiling() -> None:
+    global _active
+    with _lock:
+        _active = None
+
+
+def profiling_enabled() -> bool:
+    return _active is not None
+
+
+def current() -> Optional[CompileObservatory]:
+    return _active
+
+
+@contextlib.contextmanager
+def profiling(registry: Optional[MetricsRegistry] = None,
+              event_sink: Optional[Callable[..., None]] = None,
+              ) -> Iterator[CompileObservatory]:
+    """Scoped enable/disable (the test surface)."""
+    obs = enable_profiling(registry=registry, event_sink=event_sink)
+    try:
+        yield obs
+    finally:
+        disable_profiling()
+
+
+# --------------------------------------------------------- signature keys
+def _leaf_key(x) -> tuple:
+    import jax
+
+    if isinstance(x, (jax.Array, np.ndarray)):
+        return ("arr", tuple(x.shape), str(x.dtype))
+    if isinstance(x, bool):
+        return ("scalar", "bool")
+    if isinstance(x, (int, float, complex, np.generic)):
+        # weak-typed like jit's own cache: two C values share a program
+        return ("scalar", type(x).__name__)
+    return ("static", repr(x))
+
+
+def _signature_key(args: tuple, dyn_kw: dict) -> str:
+    import jax
+
+    leaves, treedef = jax.tree_util.tree_flatten((args, dyn_kw))
+    return f"{treedef}|{tuple(_leaf_key(x) for x in leaves)}"
+
+
+def _has_tracer(args: tuple, kwargs: dict) -> bool:
+    import jax
+
+    return any(isinstance(x, jax.core.Tracer)
+               for x in jax.tree_util.tree_leaves((args, kwargs)))
+
+
+# -------------------------------------------------------------- public API
+def profiled_call(name: str, fn, *args, static: tuple = (), **kwargs):
+    """Call jit-compiled `fn`; route through the observatory when on.
+
+    static: the fn's static_argnames (static kwargs are baked into the
+    executable and must be stripped from the AOT call)."""
+    obs = _active
+    if obs is None or _has_tracer(args, kwargs):
+        return fn(*args, **kwargs)
+    return obs.call(name, fn, args, static, kwargs)
+
+
+def profiled_jit(name: str, jitted, static: tuple = ()):
+    """Wrap a jit entry point so every call goes via profiled_call.
+
+    The wrapper preserves the jit object's AOT surface (`.lower`, used
+    by serve's bucket cache and the benchmark harnesses) and its
+    introspectable signature (functools.wraps → inspect.signature keeps
+    resolving the original parameters, which the CLI's --solver-opt
+    validation reads)."""
+
+    @functools.wraps(jitted)
+    def wrapper(*args, **kwargs):
+        return profiled_call(name, jitted, *args, static=static, **kwargs)
+
+    wrapper.lower = jitted.lower
+    wrapper._profiled_name = name
+    wrapper._jitted = jitted
+    return wrapper
+
+
+def record_compile(name: str, lower_s: float, compile_s: float,
+                   compiled=None,
+                   registry: Optional[MetricsRegistry] = None,
+                   **extra: Any) -> dict:
+    """Report an externally-driven compile (serve's bucket AOT builds,
+    cascade's shard_map round executable) into the observatory.
+
+    Always writes the gauges into `registry` (default: the observatory's
+    when enabled, else the process default — the write is host-side and
+    cheap, so serve compile accounting exists even unprofiled); the
+    trace event fires only while the observatory is on."""
+    rec = costs.compile_record(name, lower_s, compile_s, compiled, **extra)
+    obs = _active
+    if registry is None:
+        registry = obs.registry if obs is not None else default_registry()
+    if obs is not None and obs.registry is registry:
+        obs.record(rec)
+    else:
+        # record into the caller's registry; mirror the event if profiling
+        reg = registry
+        nm = rec["executable"]
+        reg.counter("prof.compiles", executable=nm).inc()
+        reg.gauge("prof.lower_s", executable=nm).set_max(rec["lower_s"])
+        reg.gauge("prof.compile_s", executable=nm).set_max(rec["compile_s"])
+        for key in ("flops", "bytes_accessed", "arith_intensity",
+                    "temp_bytes"):
+            v = rec.get(key)
+            if v is not None:
+                reg.gauge(f"prof.{key}", executable=nm).set_max(v)
+        if obs is not None:
+            obs.records.append(rec)
+            if obs.event_sink is not None:
+                obs.event_sink("prof.compile", **rec)
+    return rec

@@ -223,10 +223,21 @@ def merge_snapshots(*snaps: dict) -> dict:
             "metrics": [merged[k] for k in sorted(merged)]}
 
 
+def escape_label_value(v: str) -> str:
+    """Escape a label value per the Prometheus exposition format:
+    backslash, double-quote and newline must be backslash-escaped
+    (https://prometheus.io/docs/instrumenting/exposition_formats/).
+    Backslash first — escaping it last would re-escape the others."""
+    return (str(v).replace("\\", "\\\\")
+            .replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
 def _fmt_labels(labels: Dict[str, str]) -> str:
     if not labels:
         return ""
-    inner = ",".join(f'{k}="{v}"' for k, v in sorted(labels.items()))
+    inner = ",".join(f'{k}="{escape_label_value(v)}"'
+                     for k, v in sorted(labels.items()))
     return "{" + inner + "}"
 
 

@@ -30,11 +30,20 @@ def phase_summary(records: Iterable[dict]) -> Tuple[Dict[str, float], float]:
     from trace records.
 
     Phases are spans written with attrs.phase=True (PhaseTimer). Total
-    comes from the `end` record when present, else the span envelope."""
+    comes from the `end` record when present, else the span envelope.
+    Records carrying a `_wall` key (a multi-trace merge —
+    merge_trace_files) use the wall-clock envelope instead: per-process
+    `end` totals would undercount a run spanning several workers."""
     acc: Dict[str, float] = {}
     total = 0.0
     t_min = t_max = None
+    w_min = w_max = None
     for rec in records:
+        if "_wall" in rec:
+            w = rec["_wall"]
+            w_min = w if w_min is None else min(w_min, w)
+            w_end = w + rec.get("dur_s", 0.0)
+            w_max = w_end if w_max is None else max(w_max, w_end)
         if rec["kind"] == "span":
             t_min = rec["t0"] if t_min is None else min(t_min, rec["t0"])
             t_max = rec["t1"] if t_max is None else max(t_max, rec["t1"])
@@ -43,6 +52,8 @@ def phase_summary(records: Iterable[dict]) -> Tuple[Dict[str, float], float]:
                 acc[name] = acc.get(name, 0.0) + rec["dur_s"]
         elif rec["kind"] == "end":
             total = rec["total_s"]
+    if w_min is not None:
+        return acc, w_max - w_min
     if not total and t_min is not None:
         total = t_max - t_min
     return acc, total
@@ -82,6 +93,91 @@ def format_convergence_table(rows: List[dict], max_rows: int = 40) -> str:
     return "\n".join(out)
 
 
+def merge_trace_files(paths: List[str]) -> List[dict]:
+    """Records of several trace files interleaved on ONE wall clock.
+
+    Each file's monotonic timestamps are mapped to wall time via its
+    meta record (wall - t0), so cascade leaves, fold-parallel tune
+    workers and a serve process traced to separate files come out as one
+    chronological stream. Every record gains `_wall` (the sort key) and
+    `_file` (provenance); metrics snapshots across files still merge
+    exactly (nonzero_counters → obs.registry.merge_snapshots)."""
+    from tpusvm.obs.trace import read_trace
+
+    out: List[dict] = []
+    for p in paths:
+        recs = read_trace(p)
+        offset = 0.0
+        for r in recs:
+            if r["kind"] == "meta":
+                offset = r.get("wall", 0.0) - r.get("t0", 0.0)
+                break
+        for r in recs:
+            t = r.get("t0", r.get("ts", r.get("t1")))
+            rr = dict(r)
+            rr["_wall"] = offset + (t if t is not None else 0.0)
+            rr["_file"] = p
+            out.append(rr)
+    out.sort(key=lambda r: r["_wall"])
+    return out
+
+
+def compile_rows(records: Iterable[dict]) -> List[dict]:
+    """The prof.compile events (tpusvm.obs.prof), in record order."""
+    return [r["attrs"] for r in records
+            if r["kind"] == "event" and r["name"] == "prof.compile"]
+
+
+def format_compile_table(rows: List[dict]) -> str:
+    """Per-executable compile/cost table (the observatory's headline).
+
+    One row per executable, compiles and lower/compile seconds summed
+    across events, FLOPs / bytes accessed / arithmetic intensity from the
+    cost analysis (max across events — re-lowers of one entry point are
+    the same program family). Backends without a cost model get an
+    explicit `cost_analysis: unavailable` marker, never silent zeros."""
+    if not rows:
+        return "no compile records in this trace (profiling was off)"
+    agg: Dict[str, dict] = {}
+    order: List[str] = []
+    for r in rows:
+        name = r.get("executable", "?")
+        a = agg.get(name)
+        if a is None:
+            agg[name] = a = {"n": 0, "lower_s": 0.0, "compile_s": 0.0,
+                             "flops": None, "bytes": None,
+                             "available": False}
+            order.append(name)
+        a["n"] += 1
+        a["lower_s"] += r.get("lower_s") or 0.0
+        a["compile_s"] += r.get("compile_s") or 0.0
+        if r.get("cost_available"):
+            a["available"] = True
+            for src, dst in (("flops", "flops"),
+                             ("bytes_accessed", "bytes")):
+                v = r.get(src)
+                if v is not None:
+                    a[dst] = v if a[dst] is None else max(a[dst], v)
+    out = ["executable                        #  lower s  compile s"
+           "     GFLOP       MB  FLOP/B",
+           "----------                        -  -------  ---------"
+           "     -----       --  ------"]
+    for name in order:
+        a = agg[name]
+        left = (f"{name:<32} {a['n']:>2}  {a['lower_s']:>7.3f}  "
+                f"{a['compile_s']:>9.3f}")
+        if not a["available"]:
+            out.append(f"{left}  cost_analysis: unavailable")
+            continue
+        flops, nbytes = a["flops"], a["bytes"]
+        gflop = f"{flops / 1e9:>9.4f}" if flops is not None else "      n/a"
+        mb = (f"{nbytes / 1e6:>8.2f}" if nbytes is not None else "     n/a")
+        ai = (f"{flops / nbytes:>6.2f}" if flops is not None and nbytes
+              else "   n/a")
+        out.append(f"{left}  {gflop} {mb}  {ai}")
+    return "\n".join(out)
+
+
 def nonzero_counters(records: Iterable[dict]) -> List[str]:
     """`name{labels} value` lines for every non-zero counter/gauge in
     embedded metrics snapshots (merged when several are present)."""
@@ -107,11 +203,16 @@ def nonzero_counters(records: Iterable[dict]) -> List[str]:
 
 
 def render_report(records: List[dict]) -> str:
-    """The `tpusvm report` body for one parsed trace."""
+    """The `tpusvm report` body for one parsed (or merged) trace."""
     acc, total = phase_summary(records)
     spans = sum(1 for r in records if r["kind"] == "span")
     events = sum(1 for r in records if r["kind"] == "event")
     parts = [f"trace: {spans} spans, {events} events", ""]
+    comp = compile_rows(records)
+    if comp:
+        parts += ["compiles (lower/compile wall time, "
+                  "XLA cost analysis):",
+                  format_compile_table(comp), ""]
     conv = convergence_rows(records)
     parts += ["convergence (b_low - b_high per outer round):",
               format_convergence_table(conv), ""]

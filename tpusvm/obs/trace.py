@@ -61,7 +61,10 @@ class Tracer:
     """
 
     def __init__(self, path: str, clock=None, wall=None,
-                 argv: Optional[List[str]] = None):
+                 argv: Optional[List[str]] = None,
+                 max_bytes: Optional[int] = None):
+        if max_bytes is not None and max_bytes < 1:
+            raise ValueError(f"max_bytes must be >= 1, got {max_bytes}")
         self._clock = clock or time.perf_counter
         self._wall = wall or time.time
         self._lock = threading.Lock()
@@ -69,21 +72,59 @@ class Tracer:
         self._next_id = 0
         self._f = open(path, "a")
         self.path = path
-        self._t0 = self._clock()
+        # size-capped rotation (serve --trace runs for days; an unbounded
+        # append-only file is a disk-filler): when the current file would
+        # exceed max_bytes it becomes `path.1` (overwriting — the records
+        # in the displaced backup are COUNTED as dropped in the registry,
+        # obs.trace_dropped_records) and a fresh file starts with a
+        # continuation meta record carrying the ORIGINAL t0/wall so span
+        # timestamps stay on one clock. None = unbounded (the default).
+        self.max_bytes = max_bytes
+        self._size = self._f.tell()
+        self.rotations = 0
         self._closed = False
-        rec = {"v": TRACE_SCHEMA_VERSION, "kind": "meta", "t0": self._t0,
-               "wall": self._wall()}
+        self._meta = {"v": TRACE_SCHEMA_VERSION, "kind": "meta",
+                      "t0": self._clock(), "wall": self._wall()}
+        self._t0 = self._meta["t0"]
         if argv is not None:
-            rec["argv"] = list(argv)
-        self._write(rec)
+            self._meta["argv"] = list(argv)
+        self._write(self._meta)
 
     # ------------------------------------------------------------ plumbing
+    def _rotate_locked(self) -> None:
+        import os
+
+        from tpusvm.obs.registry import default_registry
+
+        backup = self.path + ".1"
+        dropped = 0
+        if os.path.exists(backup):
+            with open(backup) as f:
+                dropped = sum(1 for line in f if line.strip())
+        self._f.close()
+        os.replace(self.path, backup)
+        self._f = open(self.path, "a")
+        self._size = 0
+        self.rotations += 1
+        reg = default_registry()
+        reg.counter("obs.trace_rotations").inc()
+        if dropped:
+            reg.counter("obs.trace_dropped_records").inc(dropped)
+        cont = dict(self._meta, rotated=self.rotations)
+        line = json.dumps(cont, default=_jsonable)
+        self._f.write(line + "\n")
+        self._size += len(line) + 1
+
     def _write(self, rec: dict) -> None:
         line = json.dumps(rec, default=_jsonable)
         with self._lock:
             if self._closed:
                 return
+            if (self.max_bytes is not None and self._size > 0
+                    and self._size + len(line) + 1 > self.max_bytes):
+                self._rotate_locked()
             self._f.write(line + "\n")
+            self._size += len(line) + 1
             self._f.flush()
 
     def _stack(self) -> List[int]:
@@ -149,11 +190,37 @@ class Tracer:
         self.close()
 
 
+def trace_file_set(path: str) -> List[str]:
+    """The rotated-set members of a trace, oldest first: `path.K` for
+    descending K (higher = older under the shift-up scheme; the default
+    single-backup rotation only ever produces `.1`), then `path`."""
+    import os
+    import re
+
+    d, base = os.path.split(path)
+    pat = re.compile(re.escape(base) + r"\.(\d+)$")
+    ks = sorted(
+        (int(m.group(1)) for f in os.listdir(d or ".")
+         if (m := pat.match(f))),
+        reverse=True,
+    )
+    return [f"{path}.{k}" for k in ks] + [path]
+
+
 def read_trace(path: str) -> List[dict]:
     """Parse a trace file; raises ValueError on schema mismatch.
 
+    A size-capped Tracer leaves a rotated set (`path.1`, then `path`);
+    the set is read in rotation order so records stay chronological.
     Blank lines are tolerated (crash-truncated final lines are not —
     a torn record is worth hearing about, not skipping silently)."""
+    records: List[dict] = []
+    for member in trace_file_set(path):
+        records.extend(_read_one_trace(member))
+    return records
+
+
+def _read_one_trace(path: str) -> List[dict]:
     records = []
     with open(path) as f:
         for i, line in enumerate(f, 1):

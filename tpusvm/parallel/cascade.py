@@ -53,6 +53,7 @@ from jax.sharding import PartitionSpec as P
 from tpusvm import faults
 from tpusvm.config import CascadeConfig, SVMConfig, resolve_accum_dtype
 from tpusvm.data.partition import partition as make_partition
+from tpusvm.obs import prof
 from tpusvm.parallel.mesh import CASCADE_AXIS, make_mesh
 from tpusvm.parallel.svbuffer import SVBuffer, empty, extract_svs, merge_dedup
 from tpusvm.solver.blocked import blocked_smo_solve
@@ -605,7 +606,13 @@ def cascade_fit(
                       if tracer else contextlib.nullcontext())
         with round_span:
             while True:
-                out_global, b_all, diag = round_fn(part_bufs, global_sv)
+                # the round executable is the cascade's one jit entry:
+                # profiled_call records its (one-off) lower/compile cost
+                # and FLOPs when the compile observatory is on, and is
+                # the plain call otherwise
+                out_global, b_all, diag = prof.profiled_call(
+                    "cascade.round_fn", round_fn, part_bufs, global_sv
+                )
                 diag = {k: np.asarray(v) for k, v in diag.items()}
                 if (
                     cc.topology == "star"

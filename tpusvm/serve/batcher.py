@@ -98,7 +98,8 @@ class MicroBatcher:
     def __init__(self, run_batch: RunBatch, *, max_batch: int = 64,
                  max_delay_s: float = 0.002, queue_size: int = 1024,
                  timeout_s: float = 1.0, metrics=None,
-                 shed_at: Optional[int] = None):
+                 shed_at: Optional[int] = None,
+                 admission=None):
         if max_batch < 1:
             raise ValueError(f"max_batch must be >= 1, got {max_batch}")
         if shed_at is not None and shed_at < 1:
@@ -109,6 +110,9 @@ class MicroBatcher:
         self.timeout_s = timeout_s
         self.metrics = metrics
         self.shed_at = shed_at
+        # optional admission predicate (e.g. the SLO burn gauge): False
+        # sheds the request with OVERLOADED before it queues
+        self.admission = admission
         self._q: "queue.Queue" = queue.Queue(maxsize=queue_size)
         self._closed = False
         self._draining = False
@@ -127,6 +131,11 @@ class MicroBatcher:
             return ServeResult(ServeStatus.DRAINING,
                                latency_s=time.monotonic() - t0)
         if self.shed_at is not None and self._q.qsize() >= self.shed_at:
+            if self.metrics:
+                self.metrics.inc("overloaded")
+            return ServeResult(ServeStatus.OVERLOADED,
+                               latency_s=time.monotonic() - t0)
+        if self.admission is not None and not self.admission():
             if self.metrics:
                 self.metrics.inc("overloaded")
             return ServeResult(ServeStatus.OVERLOADED,

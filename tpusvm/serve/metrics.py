@@ -23,7 +23,9 @@ not a mergeable aggregate, and the serving SLO checks want exactness.)
 from __future__ import annotations
 
 import collections
+import dataclasses
 import threading
+import time
 from typing import Dict, Optional, Sequence
 
 from tpusvm.obs.registry import MetricsRegistry
@@ -46,11 +48,63 @@ _COUNTERS = (
 )
 
 
+# failures that BURN the SLO error budget: outcomes where the server
+# accepted work and failed to serve it. Admission-control rejections
+# (overloaded / queue_full / draining) deliberately do not burn — they
+# are the mechanism protecting the budget, and counting them would make
+# shedding indistinguishable from the overload it prevents.
+_SLO_ERROR_COUNTERS = ("errors", "timeouts", "unavailable")
+
+
+@dataclasses.dataclass(frozen=True)
+class SLOConfig:
+    """Per-model serving SLO budgets (performance-observatory round).
+
+    p99_ms:       latency target — at most 1% of windowed requests may
+                  complete slower than this (the definition of p99);
+    error_budget: allowed fraction of windowed completions that fail;
+    window_s:     sliding evaluation window.
+
+    BURN RATE is (observed violation rate) / (allowed rate): 1.0 means
+    the budget is being consumed exactly as fast as allowed; above 1.0
+    the SLO is burning and /healthz reports "degraded". The gauges are
+    exported on /metrics (serve.slo_latency_burn / serve.slo_error_burn)
+    and feed the admission-control path (ServeConfig.slo_shed)."""
+
+    p99_ms: float
+    error_budget: float = 0.001
+    window_s: float = 60.0
+    # the p99 definition: 1% of requests may exceed the target
+    latency_budget: float = 0.01
+
+    def validate(self) -> "SLOConfig":
+        if self.p99_ms <= 0:
+            raise ValueError(f"slo p99_ms must be > 0, got {self.p99_ms}")
+        if not (0.0 < self.error_budget < 1.0):
+            raise ValueError(
+                f"slo error_budget must be in (0, 1), got "
+                f"{self.error_budget}"
+            )
+        if self.window_s <= 0:
+            raise ValueError(
+                f"slo window_s must be > 0, got {self.window_s}"
+            )
+        return self
+
+
 class Metrics:
     """Thread-safe serving counters for one model (registry-backed)."""
 
-    def __init__(self, buckets: Sequence[int], latency_window: int = 4096):
+    def __init__(self, buckets: Sequence[int], latency_window: int = 4096,
+                 slo: Optional[SLOConfig] = None, clock=None):
         self.registry = MetricsRegistry()
+        self.slo = slo.validate() if slo is not None else None
+        self._clock = clock or time.monotonic
+        # sliding SLO windows: (t, latency_s) completions and
+        # (t, ok_n, err_n) outcome batches, pruned at observation and
+        # scrape time — memory is bounded by window traffic
+        self._slo_lat: collections.deque = collections.deque()
+        self._slo_out: collections.deque = collections.deque()
         self._counts = {k: self.registry.counter(f"serve.{k}")
                         for k in _COUNTERS}
         # per-bucket occupancy: how many batches flushed at this bucket
@@ -69,6 +123,13 @@ class Metrics:
 
     def inc(self, name: str, n: int = 1) -> None:
         self._counts[name].inc(n)
+        if self.slo is not None:
+            if name == "ok":
+                with self._lock:
+                    self._slo_out.append((self._clock(), n, 0))
+            elif name in _SLO_ERROR_COUNTERS:
+                with self._lock:
+                    self._slo_out.append((self._clock(), 0, n))
 
     def observe_batch(self, bucket: int, rows: int) -> None:
         bucket = int(bucket)
@@ -86,6 +147,56 @@ class Metrics:
     def observe_latency(self, seconds: float) -> None:
         with self._lock:
             self._lat.append(float(seconds))
+            if self.slo is not None:
+                self._slo_lat.append((self._clock(), float(seconds)))
+
+    # ---------------------------------------------------------------- SLO
+    def _prune_slo(self, now: float) -> None:
+        """Drop window entries older than window_s (caller holds _lock)."""
+        cutoff = now - self.slo.window_s
+        while self._slo_lat and self._slo_lat[0][0] < cutoff:
+            self._slo_lat.popleft()
+        while self._slo_out and self._slo_out[0][0] < cutoff:
+            self._slo_out.popleft()
+
+    def slo_status(self) -> Optional[dict]:
+        """Burn rates over the current window (None when no SLO is set).
+
+        Computed at scrape time from the windowed completions; also
+        refreshes the serve.slo_* registry gauges so /metrics and merged
+        registry snapshots carry the same numbers."""
+        if self.slo is None:
+            return None
+        s = self.slo
+        with self._lock:
+            self._prune_slo(self._clock())
+            lats = [v for _, v in self._slo_lat]
+            ok = sum(o for _, o, _ in self._slo_out)
+            err = sum(e for _, _, e in self._slo_out)
+        target_s = s.p99_ms / 1e3
+        slow = sum(1 for v in lats if v > target_s)
+        slow_frac = (slow / len(lats)) if lats else 0.0
+        latency_burn = slow_frac / s.latency_budget
+        total = ok + err
+        err_rate = (err / total) if total else 0.0
+        error_burn = err_rate / s.error_budget
+        burning = latency_burn >= 1.0 or error_burn >= 1.0
+        self.registry.gauge("serve.slo_latency_burn").set(latency_burn)
+        self.registry.gauge("serve.slo_error_burn").set(error_burn)
+        self.registry.gauge("serve.slo_burning").set(1.0 if burning else 0.0)
+        self.registry.gauge("serve.slo_window_requests").set(float(total))
+        return {
+            "p99_target_ms": s.p99_ms,
+            "error_budget": s.error_budget,
+            "window_s": s.window_s,
+            "window_requests": total,
+            "window_latencies": len(lats),
+            "slow_frac": slow_frac,
+            "error_rate": err_rate,
+            "latency_burn": latency_burn,
+            "error_burn": error_burn,
+            "burning": burning,
+        }
 
     # ------------------------------------------------------------- export
     @staticmethod
@@ -116,7 +227,7 @@ class Metrics:
             }
             for b in sorted(batches)
         }
-        return {
+        snap = {
             **counts,
             "batch_occupancy": occupancy,
             "mean_batch_rows": (total_rows / total_batches) if total_batches else 0.0,
@@ -128,6 +239,10 @@ class Metrics:
                 "max": lat[-1] if lat else None,
             },
         }
+        slo = self.slo_status()
+        if slo is not None:
+            snap["slo"] = slo
+        return snap
 
     def registry_snapshot(self) -> dict:
         """The mergeable obs.registry view of the same counters (for
@@ -153,4 +268,11 @@ class Metrics:
                 sep = "," if labels else ""
                 qlab = f"{{{labels}{sep}quantile=\"{p[1:]}\"}}"
                 lines.append(f"{prefix}_latency_seconds{qlab} {v:.6f}")
+        slo = snap.get("slo")
+        if slo is not None:
+            for k in ("latency_burn", "error_burn"):
+                lines.append(f"{prefix}_slo_{k}{lab} {slo[k]:.6f}")
+            lines.append(
+                f"{prefix}_slo_burning{lab} {1 if slo['burning'] else 0}"
+            )
         return "\n".join(lines) + "\n"

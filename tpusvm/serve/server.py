@@ -54,6 +54,31 @@ class ServeConfig:
     # open-state cooldown before a half-open probe is admitted
     breaker_threshold: int = 5
     breaker_cooldown_s: float = 30.0
+    # serving SLOs (performance-observatory round): a latency target
+    # activates per-model budget tracking — burn-rate gauges on /metrics,
+    # "degraded" on /healthz while a budget burns. None = no SLO (the
+    # pre-observatory shape, zero overhead).
+    slo_p99_ms: Optional[float] = None
+    slo_error_budget: float = 0.001   # allowed windowed error fraction
+    slo_window_s: float = 60.0        # sliding evaluation window
+    # admission control fed by the burn gauges: True sheds new requests
+    # (OVERLOADED, retryable) while the latency budget burns, protecting
+    # in-flight work — the hook the serving-runtime ROADMAP item inherits
+    slo_shed: bool = False
+
+    def resolved_slo(self):
+        """SLOConfig when a latency target is set, else None."""
+        if self.slo_p99_ms is None:
+            if self.slo_shed:
+                raise ValueError(
+                    "slo_shed=True needs an SLO to consult; set slo_p99_ms"
+                )
+            return None
+        from tpusvm.serve.metrics import SLOConfig
+
+        return SLOConfig(p99_ms=self.slo_p99_ms,
+                         error_budget=self.slo_error_budget,
+                         window_s=self.slo_window_s).validate()
 
     def resolved_buckets(self) -> Tuple[int, ...]:
         if self.buckets is not None:
@@ -83,8 +108,12 @@ class _ModelWorker:
                  clock=None):
         buckets = config.resolved_buckets()
         self.entry = entry
-        self.cache = CompileCache(entry, buckets, block=config.block)
-        self.metrics = Metrics(buckets)
+        self.metrics = Metrics(buckets, slo=config.resolved_slo(),
+                               clock=clock)
+        # the cache reports per-bucket compile time + cost analysis into
+        # this worker's registry, so /metrics carries compile accounting
+        self.cache = CompileCache(entry, buckets, block=config.block,
+                                  registry=self.metrics.registry)
         self.breaker = faults.CircuitBreaker(
             threshold=config.breaker_threshold,
             cooldown_s=config.breaker_cooldown_s,
@@ -110,7 +139,19 @@ class _ModelWorker:
             timeout_s=config.timeout_ms / 1e3,
             metrics=self.metrics,
             shed_at=config.resolved_shed_at(),
+            admission=(self._slo_admission if config.slo_shed else None),
         )
+
+    def _slo_admission(self) -> bool:
+        """SLO-fed admission control (config.slo_shed): refuse new work
+        while the latency budget burns. Error burn deliberately does NOT
+        shed — refusing traffic cannot un-fail requests, and shedding on
+        errors would turn one bad batch into an outage."""
+        st = self.metrics.slo_status()
+        return st is None or st["latency_burn"] < 1.0
+
+    def slo_status(self):
+        return self.metrics.slo_status()
 
     def _on_breaker(self, event: str) -> None:
         if event == "tripped":
@@ -257,10 +298,12 @@ class Server:
         return self._worker(name).metrics.snapshot()
 
     def metrics_text(self) -> str:
+        from tpusvm.obs.registry import escape_label_value
+
         chunks = []
         for n in self.registry.names():
             w = self._worker(n)
-            snap_labels = f'model="{n}"'
+            snap_labels = f'model="{escape_label_value(n)}"'
             chunks.append(w.metrics.render_text(labels=snap_labels))
             chunks.append(
                 f'tpusvm_serve_compiled_shapes{{{snap_labels}}} '
@@ -293,19 +336,30 @@ class Server:
         """The /healthz payload: overall status + per-model breaker state.
 
         "ok" only when the server is accepting work; "draining" after
-        drain(); a model with an open breaker degrades the report to
-        "degraded" without failing the whole health check (the other
-        models still serve)."""
+        drain(); a model with an open breaker OR a burning SLO budget
+        degrades the report to "degraded" without failing the whole
+        health check (the other models still serve)."""
         with self._lock:
             workers = dict(self._workers)
         breakers = {n: w.breaker.state for n, w in workers.items()}
+        slo = {n: st for n, w in workers.items()
+               if (st := w.metrics.slo_status()) is not None}
+        burning = [n for n, st in slo.items() if st["burning"]]
         if self._draining or self._closed:
             status = "draining"
-        elif any(s != "closed" for s in breakers.values()):
+        elif any(s != "closed" for s in breakers.values()) or burning:
             status = "degraded"
         else:
             status = "ok"
-        return {"status": status, "models": breakers}
+        out = {"status": status, "models": breakers}
+        if slo:
+            out["slo"] = {
+                n: {"latency_burn": st["latency_burn"],
+                    "error_burn": st["error_burn"],
+                    "burning": st["burning"]}
+                for n, st in slo.items()
+            }
+        return out
 
     def drain(self, timeout_s: float = 10.0) -> bool:
         """Stop admitting new requests (they come back DRAINING) and wait

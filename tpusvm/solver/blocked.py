@@ -45,6 +45,7 @@ from jax import lax
 
 from tpusvm import kernels
 from tpusvm.config import pallas_flag_errors
+from tpusvm.obs import prof
 from tpusvm.obs.convergence import ConvergenceTelemetry
 from tpusvm.ops.rbf import sq_norms
 from tpusvm.ops.selection import i_high_mask, i_low_mask
@@ -321,16 +322,21 @@ def _inner_smo(K_BB, y_B, a_B, f_B, active_B, C, eps, tau, max_inner,
     return a_B, n_upd, progress, reason
 
 
-@functools.partial(
-    jax.jit,
-    static_argnames=("q", "max_outer", "max_inner", "warm_start",
-                     "accum_dtype", "inner", "refine", "max_refines", "wss",
-                     "matmul_precision", "selection", "fused_fupdate",
-                     "pallas_layout", "pallas_eta_exclude",
-                     "pallas_multipair", "telemetry", "kernel", "degree",
-                     "kernel_fast", "return_state"),
+# one definition of the solver's static argnames, shared with the compile
+# observatory's wrapper below (static kwargs are baked into an AOT
+# executable and must be stripped from its call)
+_BLOCKED_STATIC = (
+    "q", "max_outer", "max_inner", "warm_start",
+    "accum_dtype", "inner", "refine", "max_refines", "wss",
+    "matmul_precision", "selection", "fused_fupdate",
+    "pallas_layout", "pallas_eta_exclude",
+    "pallas_multipair", "telemetry", "kernel", "degree",
+    "kernel_fast", "return_state",
 )
-def blocked_smo_solve(
+
+
+@functools.partial(jax.jit, static_argnames=_BLOCKED_STATIC)
+def _blocked_smo_solve_jit(
     X: jax.Array,
     Y: jax.Array,
     valid: Optional[jax.Array] = None,
@@ -939,3 +945,14 @@ def blocked_smo_solve(
     if return_state:
         return result, final
     return result
+
+
+# every caller (models, tune, checkpoint, kernels.svr, CLI) goes through
+# this wrapper: with the compile observatory off it is the jit call,
+# byte-for-byte; with it on (CLI --trace) lower/compile wall time and the
+# executable's cost/memory analysis are recorded (tpusvm.obs.prof). The
+# `.lower` AOT surface and the introspectable signature are preserved.
+blocked_smo_solve = prof.profiled_jit(
+    "solver.blocked_smo_solve", _blocked_smo_solve_jit,
+    static=_BLOCKED_STATIC,
+)

@@ -20,14 +20,15 @@ import jax
 import jax.numpy as jnp
 
 from tpusvm import kernels
+from tpusvm.obs import prof
 from tpusvm.ops.rbf import sq_norms
 
 
-@functools.partial(
-    jax.jit,
-    static_argnames=("gamma", "block", "kernel", "degree", "coef0"),
-)
-def decision_function(
+_DECISION_STATIC = ("gamma", "block", "kernel", "degree", "coef0")
+
+
+@functools.partial(jax.jit, static_argnames=_DECISION_STATIC)
+def _decision_function_jit(
     X_test: jax.Array,
     X_train: jax.Array,
     coef: jax.Array,  # alpha * y, zeros for non-SVs / padding
@@ -62,10 +63,11 @@ def decision_function(
     return scores.reshape(-1)[:m] - b
 
 
-@functools.partial(
-    jax.jit, static_argnames=("gamma", "kernel", "degree", "coef0")
-)
-def decision_function_flat(
+_DECISION_FLAT_STATIC = ("gamma", "kernel", "degree", "coef0")
+
+
+@functools.partial(jax.jit, static_argnames=_DECISION_FLAT_STATIC)
+def _decision_function_flat_jit(
     X_test: jax.Array,
     X_train: jax.Array,
     coef: jax.Array,
@@ -92,6 +94,20 @@ def decision_function_flat(
     K = kernels.cross(kernel, X_test, X_train, gamma=gamma, coef0=coef0,
                       degree=degree, snB=snB)
     return K @ coef - b
+
+
+# compile-observatory wrappers (tpusvm.obs.prof): the jit call when
+# profiling is off; lower/compile + cost-analysis accounting when on.
+# Serve's bucket cache keeps using the preserved `.lower` AOT surface
+# (it owns its own compile accounting in serve/buckets.py).
+decision_function = prof.profiled_jit(
+    "predict.decision_function", _decision_function_jit,
+    static=_DECISION_STATIC,
+)
+decision_function_flat = prof.profiled_jit(
+    "predict.decision_function_flat", _decision_function_flat_jit,
+    static=_DECISION_FLAT_STATIC,
+)
 
 
 def predict(

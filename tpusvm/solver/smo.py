@@ -45,6 +45,7 @@ from tpusvm.ops.selection import (
     masked_argmax,
     masked_argmin,
 )
+from tpusvm.obs import prof
 from tpusvm.status import Status
 
 
@@ -189,12 +190,11 @@ def _body(state: SMOState, X, Y, valid, sn, C, gamma, eps, tau, max_iter,
 # Only max_iter/warm_start/accum_dtype/kernel/degree are static: the float
 # hyperparameters are traced scalars so a C/gamma (or coef0) grid search
 # reuses one compiled solver per (kernel, degree) family.
-@functools.partial(
-    jax.jit,
-    static_argnames=("max_iter", "warm_start", "accum_dtype", "kernel",
-                     "degree"),
-)
-def smo_solve(
+_SMO_STATIC = ("max_iter", "warm_start", "accum_dtype", "kernel", "degree")
+
+
+@functools.partial(jax.jit, static_argnames=_SMO_STATIC)
+def _smo_solve_jit(
     X: jax.Array,
     Y: jax.Array,
     valid: Optional[jax.Array] = None,
@@ -291,3 +291,12 @@ def smo_solve(
         n_iter=final.n_iter,
         status=final.status,
     )
+
+
+# compile-observatory wrapper (tpusvm.obs.prof): identical to the jit
+# call when profiling is off; records lower/compile time + cost analysis
+# per distinct signature when on. Inside vmap/shard_map traces (the OVR
+# batched path, cascade bodies) the wrapper sees tracers and passes
+# straight through to the jitted function.
+smo_solve = prof.profiled_jit("solver.smo_solve", _smo_solve_jit,
+                              static=_SMO_STATIC)
