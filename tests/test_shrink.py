@@ -1,0 +1,384 @@
+"""Round 9 solver speed ladder: shrinking, K-row cache, precision rungs,
+fused selection.
+
+Parity discipline: every rung must reproduce the never-shrunk/full-
+precision solve at the SOLUTION level (the reference's own criterion —
+identical SV set, b within the oracle-parity bands, stopping rule
+satisfied), and the shrinking driver's final stopping decision must be
+THE UNSHRUNK CRITERION — asserted here against an independent NumPy
+reconstruction of f, not against the solver's own bookkeeping.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpusvm.config import RAW_BF16, resolve_matmul_precision
+from tpusvm.data import MinMaxScaler, blobs, rings
+from tpusvm.solver.blocked import blocked_smo_solve
+from tpusvm.solver.shrink import shrinking_blocked_solve
+from tpusvm.status import Status
+
+f64 = jnp.float64
+
+
+def _data(gen, **kw):
+    X, Y = gen(**kw)
+    return MinMaxScaler().fit_transform(X).astype(np.float32), Y
+
+
+def _keerthi_gap(Xs, Y, alpha, gamma, C, eps=1e-12):
+    """Independent f64 NumPy reconstruction of the full-problem Keerthi
+    gap b_low - b_high — the unshrunk stopping quantity, computed with
+    no solver machinery at all."""
+    Xs = np.asarray(Xs, np.float64)
+    a = np.asarray(alpha, np.float64)
+    y = np.asarray(Y, np.float64)
+    d2 = ((Xs ** 2).sum(1)[:, None] + (Xs ** 2).sum(1)[None, :]
+          - 2.0 * Xs @ Xs.T)
+    K = np.exp(-gamma * np.maximum(d2, 0.0))
+    f = K @ (a * y) - y
+    m_h = np.where(y == 1, a < C - eps, (y == -1) & (a > eps))
+    m_l = np.where(y == 1, a > eps, (y == -1) & (a < C - eps))
+    return float(f[m_l].max() - f[m_h].min())
+
+
+def _gap_band(alpha, tau=1e-5):
+    """2*tau plus the f32-kernel-evaluation noise floor: the solver
+    judges the criterion on f built from f32 kernel values (~1e-7
+    relative), so an f64 re-evaluation of the same alphas can sit
+    ~sum(alpha)*1e-7 past the band (the documented refine-mode floor,
+    solver/blocked.py). Scale-aware, like the fuzz harness's b bands."""
+    return 2.0 * tau + 4e-7 * float(np.sum(np.asarray(alpha)))
+
+
+def _svs(alpha, tol=1e-8):
+    return set(np.flatnonzero(np.asarray(alpha) > tol).tolist())
+
+
+KW = dict(C=10.0, gamma=10.0, tau=1e-5, q=64, max_inner=256,
+          accum_dtype=f64, max_outer=20000, max_iter=10_000_000)
+
+
+# ------------------------------------------------------------- shrinking
+def test_shrink_matches_unshrunk_and_final_criterion_is_global():
+    Xs, Y = _data(rings, n=768, seed=5)
+    Xj, Yj = jnp.asarray(Xs), jnp.asarray(Y)
+    r0 = blocked_smo_solve(Xj, Yj, **KW)
+    r1, hist = shrinking_blocked_solve(
+        Xj, Yj, shrink_every=4, shrink_stable=2, shrink_min=64,
+        return_history=True, **KW)
+    assert int(r0.status) == Status.CONVERGED
+    assert int(r1.status) == Status.CONVERGED
+    assert _svs(r0.alpha) == _svs(r1.alpha)
+    np.testing.assert_allclose(float(r1.b), float(r0.b), atol=1e-3)
+    # the final stopping decision is the UNSHRUNK criterion: both
+    # solutions satisfy it on an independent full-f reconstruction,
+    # judged by the SAME band (criterion identity)
+    for r in (r0, r1):
+        assert _keerthi_gap(Xs, Y, r.alpha, 10.0, 10.0) \
+            <= _gap_band(r.alpha)
+
+
+def test_shrink_adversarial_wrong_freeze_is_revived():
+    """Force WRONG freezing (S=1, shrink at every pause, gap guard off):
+    rows freeze off a single round's look at a still-loose band, so the
+    compacted optimum diverges from the global one. The un-shrink pass
+    must REJECT each compacted convergence claim, revive the wrongly
+    frozen alphas and keep optimising until the GLOBAL criterion holds —
+    observable as repeated un-shrink events with the round counter
+    advancing past them, and a final solution identical to never-shrunk."""
+    from benchmarks.common import make_workload
+
+    Xs, Y = make_workload(512, d=32)
+    Xj, Yj = jnp.asarray(Xs), jnp.asarray(Y)
+    kw = dict(C=10.0, gamma=0.00125 * 784 / 32, tau=1e-5, q=64,
+              max_inner=256, accum_dtype=f64, max_outer=20000,
+              max_iter=10_000_000)
+    r0 = blocked_smo_solve(Xj, Yj, **kw)
+    r1, hist = shrinking_blocked_solve(
+        Xj, Yj, shrink_every=1, shrink_stable=1, shrink_min=64,
+        shrink_gap_factor=0.0, max_unshrinks=6,
+        return_history=True, **kw)
+    assert int(r0.status) == Status.CONVERGED
+    assert int(r1.status) == Status.CONVERGED
+    unshrunk_rounds = [h["round"] for h in hist
+                       if h["event"] == "unshrink"]
+    # at least one compacted claim was rejected (a second un-shrink ran)
+    # and optimisation continued past the first revival
+    assert len(unshrunk_rounds) >= 2
+    assert int(r1.n_outer) > unshrunk_rounds[0]
+    # ...to the never-shrunk solution, under the unshrunk criterion
+    assert _svs(r0.alpha) == _svs(r1.alpha)
+    np.testing.assert_allclose(float(r1.b), float(r0.b), atol=1e-3)
+    gamma = 0.00125 * 784 / 32
+    assert _keerthi_gap(Xs, Y, r1.alpha, gamma, 10.0) \
+        <= _gap_band(r1.alpha)
+
+
+def test_shrink_fuzz_corpus_parity():
+    """Fuzz-corpus gate: on random instances the shrunk solve must keep
+    the never-shrunk solve's SV set exactly and satisfy the identical
+    stopping criterion (independent reconstruction)."""
+    from benchmarks.common import random_instance
+
+    for seed in (101, 202, 303, 404):
+        rng = np.random.default_rng(seed)
+        _, n, X, Y, C, gamma = random_instance(
+            rng, seed, (128, 512), (2, 12), [1.0, 10.0], [0.5, 2.0, 8.0])
+        Xs = MinMaxScaler().fit_transform(X).astype(np.float32)
+        kw = dict(C=C, gamma=gamma, tau=1e-5, q=64, max_inner=256,
+                  accum_dtype=f64, max_outer=20000, max_iter=10_000_000)
+        r0 = blocked_smo_solve(jnp.asarray(Xs), jnp.asarray(Y), **kw)
+        r1 = shrinking_blocked_solve(
+            jnp.asarray(Xs), jnp.asarray(Y), shrink_every=4,
+            shrink_stable=2, shrink_min=64, **kw)
+        assert int(r0.status) == Status.CONVERGED, seed
+        assert int(r1.status) == Status.CONVERGED, seed
+        assert _svs(r0.alpha) == _svs(r1.alpha), seed
+        np.testing.assert_allclose(float(r1.b), float(r0.b), atol=1e-3)
+        gap = _keerthi_gap(Xs, Y, r1.alpha, gamma, C)
+        assert gap <= _gap_band(r1.alpha), (seed, gap)
+
+
+def test_shrink_driver_validation():
+    X = jnp.zeros((16, 2), jnp.float32)
+    Y = jnp.asarray([1, -1] * 8, jnp.int32)
+    with pytest.raises(ValueError, match="shrink_stable"):
+        shrinking_blocked_solve(X, Y, shrink_stable=0)
+    with pytest.raises(ValueError, match="shrink_every"):
+        shrinking_blocked_solve(X, Y, shrink_every=0)
+    with pytest.raises(ValueError, match="segmenting"):
+        shrinking_blocked_solve(X, Y, pause_at=3)
+    with pytest.raises(ValueError, match="bf16_f32"):
+        shrinking_blocked_solve(X, Y, matmul_precision="default")
+
+
+def test_shrink_telemetry_ring_carries_active_set():
+    Xs, Y = _data(rings, n=512, seed=5)
+    r, hist = shrinking_blocked_solve(
+        jnp.asarray(Xs), jnp.asarray(Y), shrink_every=4, shrink_stable=2,
+        shrink_min=64, telemetry=4096, return_history=True, **KW)
+    from tpusvm.obs.convergence import materialize
+
+    conv = materialize(r.telemetry)
+    # the ring crossed driver segments/compactions intact: every body
+    # execution of the whole solve is recorded (proceed rounds plus the
+    # terminal checks each segment/un-shrink runs), and the active
+    # column dips when a compaction was in force
+    assert conv["rounds_recorded"] > int(r.n_outer)
+    assert "active" in conv
+    if any(h["event"] == "shrink" for h in hist):
+        assert conv["active"].min() < 512
+    assert conv["active"].max() == 512
+
+
+# ----------------------------------------------------------- K-row cache
+def test_krow_cache_same_solution_and_accounting():
+    Xs, Y = _data(rings, n=512, seed=5)
+    Xj, Yj = jnp.asarray(Xs), jnp.asarray(Y)
+    kw = dict(KW, q=32, max_inner=64)
+    r0 = blocked_smo_solve(Xj, Yj, **kw)
+    r1 = blocked_smo_solve(Xj, Yj, krow_cache=512, **kw)
+    assert int(r1.status) == Status.CONVERGED
+    assert _svs(r0.alpha) == _svs(r1.alpha)
+    np.testing.assert_allclose(float(r1.b), float(r0.b), atol=1e-4)
+    # accounting: every proceed-round classified as hit or miss, in rows
+    assert (int(r1.cache_hits) + int(r1.cache_misses)
+            == 32 * int(r1.n_outer))
+    # the repeat-violator regime near convergence actually hits
+    assert int(r1.cache_hits) > 0
+
+
+def test_krow_cache_slot_aliasing_evicted_row_recomputed():
+    """Slot-aliasing gate: with the cache squeezed to exactly q slots,
+    EVERY miss round evicts the whole previous working set. A stale-key
+    bug (lookup matching a slot whose row was evicted) would serve wrong
+    K-rows and derail the solve; the solution must stay identical to the
+    pressure-free cache and to no cache at all."""
+    Xs, Y = _data(rings, n=384, seed=7)
+    Xj, Yj = jnp.asarray(Xs), jnp.asarray(Y)
+    kw = dict(KW, q=32, max_inner=64)
+    r_no = blocked_smo_solve(Xj, Yj, **kw)
+    r_tight = blocked_smo_solve(Xj, Yj, krow_cache=32, **kw)   # q slots
+    r_roomy = blocked_smo_solve(Xj, Yj, krow_cache=384, **kw)
+    assert int(r_tight.status) == Status.CONVERGED
+    # tight vs roomy: same rows-form trajectory wherever lookups are
+    # correct — any stale hit would split them
+    np.testing.assert_array_equal(np.asarray(r_tight.alpha),
+                                  np.asarray(r_roomy.alpha))
+    assert float(r_tight.b) == float(r_roomy.b)
+    assert _svs(r_no.alpha) == _svs(r_tight.alpha)
+    np.testing.assert_allclose(float(r_tight.b), float(r_no.b), atol=1e-4)
+
+
+def test_krow_cache_validation():
+    X = jnp.zeros((64, 2), jnp.float32)
+    Y = jnp.asarray([1, -1] * 32, jnp.int32)
+    with pytest.raises(ValueError, match="krow_cache"):
+        blocked_smo_solve(X, Y, q=32, krow_cache=16)  # slots < q
+    with pytest.raises(ValueError, match="krow_cache"):
+        blocked_smo_solve(X, Y, q=32, krow_cache=64, fused_fupdate=True)
+
+
+# ------------------------------------------------------- precision ladder
+def test_matmul_precision_resolver_closes_the_default_footgun():
+    from tpusvm.ops.rbf import matmul_p, rbf_cross_matvec
+
+    with pytest.raises(ValueError, match="RAW SINGLE-PASS bf16"):
+        resolve_matmul_precision("default")
+    assert resolve_matmul_precision(None) == "float32"
+    assert resolve_matmul_precision(RAW_BF16) == RAW_BF16
+    with pytest.raises(ValueError, match="unknown matmul precision"):
+        resolve_matmul_precision("bf16")
+    # the ops layer inherits the gate: the old silent spelling now raises
+    A = jnp.ones((8, 4), jnp.float32)
+    with pytest.raises(ValueError, match="RAW SINGLE-PASS bf16"):
+        rbf_cross_matvec(A, A[:2], jnp.ones(2, jnp.float32), 0.5,
+                         precision="default")
+    # the ladder rungs compute: rounded operands, f32 accumulate,
+    # compensation strictly reduces the left operand's rounding error
+    rng = np.random.default_rng(0)
+    A = jnp.asarray(rng.random((128, 32)), jnp.float32)
+    B = jnp.asarray(rng.random((32, 16)), jnp.float32)
+    exact = np.asarray(matmul_p(A, B, "highest"), np.float64)
+    e1 = np.abs(np.asarray(matmul_p(A, B, "bf16_f32"), np.float64)
+                - exact).max()
+    e2 = np.abs(np.asarray(matmul_p(A, B, "bf16_f32c"), np.float64)
+                - exact).max()
+    assert 0 < e2 < e1
+
+
+def test_bf16_f32_requires_drift_guard_and_matches_baseline():
+    Xs, Y = _data(rings, n=512, seed=5)
+    Xj, Yj = jnp.asarray(Xs), jnp.asarray(Y)
+    with pytest.raises(ValueError, match="bf16_f32"):
+        blocked_smo_solve(Xj, Yj, matmul_precision="bf16_f32", **KW)
+    r0 = blocked_smo_solve(Xj, Yj, **KW)
+    # rung A: refine-guarded (the matmul_precision='default' discipline)
+    r1 = blocked_smo_solve(Xj, Yj, matmul_precision="bf16_f32",
+                           refine=512, max_refines=2, **KW)
+    # rung B: shrink-guarded (the un-shrink rebuild is the revalidation)
+    r2 = shrinking_blocked_solve(
+        Xj, Yj, shrink_every=4, shrink_stable=2, shrink_min=64,
+        matmul_precision="bf16_f32", **KW)
+    for r in (r1, r2):
+        assert int(r.status) == Status.CONVERGED
+        sv0, sv = _svs(r0.alpha), _svs(r.alpha)
+        # bf16-rounded operands genuinely change the arithmetic (unlike
+        # the CPU no-op 'default' hint), so allow tau-band boundary flips
+        assert len(sv0 ^ sv) <= max(2, len(sv0) // 10)
+        np.testing.assert_allclose(float(r.b), float(r0.b), atol=5e-3)
+    # the shrink-guarded run's final claim was re-validated globally
+    assert _keerthi_gap(Xs, Y, r2.alpha, 10.0, 10.0) <= 2e-5 * (1 + 1e-6)
+
+
+def test_bf16_rungs_resolve_fused_off():
+    from tpusvm.solver.blocked import resolve_fused_fupdate
+
+    assert resolve_fused_fupdate(60000, 784, q=2048,
+                                 matmul_precision="bf16_f32") is False
+    with pytest.raises(ValueError, match="full-f32"):
+        resolve_fused_fupdate(60000, 784, q=2048, fused=True,
+                              matmul_precision="bf16_f32")
+
+
+# -------------------------------------------------------- fused selection
+def test_fused_selection_same_optimum_interpret():
+    Xs, Y = _data(rings, n=200, seed=5)
+    Xj, Yj = jnp.asarray(Xs), jnp.asarray(Y)
+    kw = dict(C=10.0, gamma=10.0, tau=1e-5, q=32, max_inner=64,
+              accum_dtype=f64)
+    r0 = blocked_smo_solve(Xj, Yj, **kw)
+    r1 = blocked_smo_solve(Xj, Yj, fused_fupdate=True,
+                           pallas_fused_selection=True, **kw)
+    assert int(r1.status) == Status.CONVERGED
+    assert _svs(r0.alpha) == _svs(r1.alpha)
+    np.testing.assert_allclose(float(r1.b), float(r0.b), atol=1e-3)
+    assert float(r1.b_low) <= float(r1.b_high) + 2e-5 * (1 + 1e-6)
+
+
+def test_fused_selection_flag_validation():
+    X = jnp.zeros((64, 2), jnp.float32)
+    Y = jnp.asarray([1, -1] * 32, jnp.int32)
+    # active flag with the fused f-update resolved OFF = config lie
+    with pytest.raises(ValueError, match="pallas_fused_selection"):
+        blocked_smo_solve(X, Y, q=32, pallas_fused_selection=True)
+    with pytest.raises(ValueError, match="refine"):
+        blocked_smo_solve(X, Y, q=32, fused_fupdate=True,
+                          pallas_fused_selection=True, refine=64)
+    with pytest.raises(ValueError, match="selection"):
+        blocked_smo_solve(X, Y, q=32, fused_fupdate=True,
+                          pallas_fused_selection=True, selection="exact")
+
+
+def test_selection_shape_invariants():
+    from tpusvm.ops.pallas.fused_fupdate import selection_shape
+
+    for n, d, q in ((240, 2, 64), (60000, 784, 2048), (512, 16, 128)):
+        block, nb, k_cand, ncand = selection_shape(n, d, q)
+        assert nb == -(-n // block)
+        assert ncand == nb * k_cand
+        assert ncand >= q // 2          # a full half fits the pool
+        assert k_cand <= block
+        assert ncand <= n or k_cand == 8  # tiny-n floor may overshoot
+
+
+# ------------------------------------------------- persistence/provenance
+def test_model_provenance_roundtrip(tmp_path):
+    from tpusvm.config import SVMConfig
+    from tpusvm.models import BinarySVC
+
+    Xs, Y = _data(rings, n=240, seed=3)
+    # max_iter keeps the fit cheap: provenance recording, not
+    # convergence, is under test (bf16 on this tiny ring can wander)
+    m = BinarySVC(config=SVMConfig(C=10.0, gamma=10.0, max_iter=2000),
+                  solver_opts={"q": 32, "shrink_every": 4,
+                               "shrink_min": 64,
+                               "matmul_precision": "bf16_f32"})
+    import warnings as _w
+
+    with _w.catch_warnings():
+        _w.simplefilter("ignore", RuntimeWarning)
+        m.fit(Xs, Y)
+    assert m.train_precision_ == "bf16_f32"
+    assert m.shrink_every_ == 4
+    path = str(tmp_path / "prov.npz")
+    m.save(path)
+    m2 = BinarySVC.load(path)
+    assert m2.train_precision_ == "bf16_f32"
+    assert m2.shrink_every_ == 4 and m2.shrink_stable_ == 3
+    # pre-v3 state (no provenance fields) loads with the defaults
+    from tpusvm.models.serialization import load_model, save_model
+
+    state, cfg = load_model(path)
+    for k in ("train_precision", "shrink_every", "shrink_stable"):
+        state.pop(k)
+    old = str(tmp_path / "old.npz")
+    save_model(old, state, cfg)
+    m3 = BinarySVC.load(old)
+    assert m3.train_precision_ == "f32"
+    assert m3.shrink_every_ == 0
+    np.testing.assert_array_equal(m3.sv_alpha_, m2.sv_alpha_)
+
+
+def test_checkpoint_fingerprint_pins_ladder_statics(tmp_path):
+    from tpusvm.solver.checkpoint import (
+        load_solver_state,
+        save_solver_state,
+        solve_fingerprint,
+    )
+
+    Xs, Y = _data(blobs, n=64, seed=1)
+    kw = dict(C=1.0, gamma=0.5, q=16)
+    r, st = blocked_smo_solve(jnp.asarray(Xs), jnp.asarray(Y),
+                              return_state=True, **kw)
+    fp = solve_fingerprint(Xs, Y, None, dict(kw, krow_cache=16))
+    path = str(tmp_path / "ck.npz")
+    st_np = type(st)(*(np.asarray(x) for x in st))
+    save_solver_state(path, st_np, fp)
+    load_solver_state(path, fp)  # roundtrips
+    with pytest.raises(ValueError, match="krow_cache"):
+        load_solver_state(path, solve_fingerprint(
+            Xs, Y, None, dict(kw, krow_cache=32)))

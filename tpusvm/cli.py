@@ -186,6 +186,20 @@ def _build_parser() -> argparse.ArgumentParser:
                       metavar="K",
                       help="single-mode checkpoint cadence in outer "
                       "rounds (default 64)")
+    mode.add_argument("--shrink-every", type=int, default=0, metavar="E",
+                      help="active-set shrinking (blocked solver, --mode "
+                      "single): every E outer rounds, freeze alphas that "
+                      "have been at-bound and Keerthi-stable for "
+                      "--shrink-stable consecutive rounds and compact "
+                      "the live rows into a power-of-two bucket — solver "
+                      "work then scales with the active set, not n; an "
+                      "un-shrink full-f rebuild re-validates every "
+                      "convergence claim, so the final stopping check "
+                      "is identical to the unshrunk criterion. 0 = off")
+    mode.add_argument("--shrink-stable", type=int, default=3, metavar="S",
+                      help="rounds a row must stay at-bound and "
+                      "Keerthi-safe before --shrink-every may freeze it "
+                      "(default 3)")
     mode.add_argument("--multiclass", action="store_true",
                       help="one-vs-rest over all labels instead of the "
                       "reference's binary '1 vs rest' mapping")
@@ -237,6 +251,17 @@ def _build_parser() -> argparse.ArgumentParser:
     num = tr.add_argument_group("numerics")
     num.add_argument("--dtype", choices=["float32", "bfloat16", "float64"],
                      default="float32", help="feature/kernel dtype")
+    num.add_argument(
+        "--precision", choices=["f32", "bf16_f32", "bf16_f32c"],
+        default="f32",
+        help="MXU precision rung for the solver's dominant f-update "
+        "contraction (blocked solver): f32 = full-f32 trust anchor "
+        "(default); bf16_f32 = bfloat16 operands with exact f32 "
+        "accumulation (single-pass MXU throughput; pair with "
+        "--shrink-every, whose un-shrink rebuild re-validates claims, "
+        "or --solver-opt refine=N); bf16_f32c adds a compensated "
+        "residual pass. Raw single-pass bf16 stays solver-opt-only "
+        "(matmul_precision=default, refine-gated)")
     num.add_argument(
         "--accum", choices=["none", "float64"], default="float64",
         help="solver accumulator dtype; float64 (default) is the mixed-"
@@ -729,12 +754,30 @@ def _cmd_train(args) -> int:
 
     solver_opts = _parse_solver_opts(args.solver_opt)
 
+    # dedicated ladder flags fold into the same solver_opts the models
+    # consume; passing both spellings is a conflict, not a silent override
+    if args.precision != "f32":
+        if "matmul_precision" in solver_opts:
+            raise SystemExit("--precision and --solver-opt "
+                             "matmul_precision= are the same knob; "
+                             "pass one")
+        solver_opts["matmul_precision"] = args.precision
+    if args.shrink_every:
+        if args.shrink_every < 1:
+            raise SystemExit("--shrink-every must be >= 1")
+        if "shrink_every" in solver_opts:
+            raise SystemExit("--shrink-every and --solver-opt "
+                             "shrink_every= are the same knob; pass one")
+        solver_opts["shrink_every"] = args.shrink_every
+        solver_opts.setdefault("shrink_stable", args.shrink_stable)
+
     # pure flag-consistency checks, before the (possibly long) data load
     if solver_opts:
         if args.mode == "oracle":
             raise SystemExit(
-                "--solver-opt has no effect on --mode oracle (the NumPy "
-                "oracle has no static solver knobs)"
+                "--solver-opt/--precision/--shrink-every have no effect "
+                "on --mode oracle (the NumPy oracle has no static "
+                "solver knobs)"
             )
         # validate knob names against the selected solver's signature now,
         # not minutes later from inside fit
@@ -742,6 +785,7 @@ def _cmd_train(args) -> int:
 
         from tpusvm.solver import smo_solve
         from tpusvm.solver.blocked import blocked_smo_solve
+        from tpusvm.solver.shrink import shrinking_blocked_solve
 
         solver_name = args.solver or ("pair" if args.multiclass else "blocked")
         fn = blocked_smo_solve if solver_name == "blocked" else smo_solve
@@ -751,8 +795,15 @@ def _cmd_train(args) -> int:
                    "kernel", "degree", "coef0"}
         reserved = {"X", "Y", "valid", "alpha0", "sn", "targets",
                     # the checkpoint driver's internal resume surface
-                    "resume_state", "pause_at", "return_state"} | flagged
+                    "resume_state", "pause_at", "return_state",
+                    # the shrink driver's internal surfaces
+                    "return_history", "kw"} | flagged
         known = set(inspect.signature(fn).parameters) - reserved
+        if solver_name == "blocked":
+            # the blocked solver's opts include the shrinking driver's
+            # knobs (models route to solver/shrink.py on shrink_every)
+            known |= set(inspect.signature(
+                shrinking_blocked_solve).parameters) - reserved
         bad = sorted(set(solver_opts) - known)
         if bad:
             hint = [k for k in bad if k in flagged]
@@ -761,6 +812,30 @@ def _cmd_train(args) -> int:
                 f"{bad}; known: {sorted(known)}"
                 + (f" (use the dedicated flags for {hint})" if hint else "")
             )
+        if "matmul_precision" in solver_opts and solver_name != "blocked":
+            raise SystemExit("--precision/matmul_precision is a blocked-"
+                             "solver ladder knob; the pair solver has no "
+                             "laddered contraction")
+        if "shrink_every" in solver_opts:
+            if solver_name != "blocked":
+                raise SystemExit("--shrink-every needs the blocked "
+                                 "solver (working-set rounds are what "
+                                 "gets compacted)")
+            if args.mode != "single":
+                raise SystemExit(
+                    "--shrink-every needs --mode single: the shrinking "
+                    "driver segments the solve host-side, which the "
+                    "cascade's shard_map leaves cannot do"
+                )
+            if args.checkpoint:
+                raise SystemExit(
+                    "--shrink-every and --checkpoint both segment the "
+                    "outer loop and cannot be combined yet; crash-safe "
+                    "shrinking is a future PR"
+                )
+            if args.multiclass:
+                raise SystemExit("--shrink-every supports binary/svr "
+                                 "--mode single training for now")
     if args.task == "svr":
         if args.mode != "single":
             raise SystemExit("--task svr requires --mode single (the "
@@ -1610,6 +1685,17 @@ def _info_artifact(path: str) -> int:
           f"tau={config.tau:g} sv_tol={config.sv_tol:g}"
           + (f" epsilon={config.epsilon:g}" if task == "svr" else ""))
     print(f"scaled: {bool(state.get('scale', False))}")
+    if task in ("svc", "svr"):
+        # training provenance (format v3): which solver-ladder rung and
+        # shrinking cadence produced this artifact; older files load
+        # with the f32/no-shrink defaults
+        prec = (str(state["train_precision"])
+                if "train_precision" in state else "f32")
+        se = int(state["shrink_every"]) if "shrink_every" in state else 0
+        shrink = (f"every {se} rounds "
+                  f"(stable {int(state['shrink_stable'])})"
+                  if se else "off")
+        print(f"trained: precision={prec} shrinking={shrink}")
     if task == "svc":
         if "platt_a" in state:
             print(f"calibrated: yes (Platt A={float(state['platt_a']):.6f} "

@@ -20,6 +20,75 @@ from typing import Optional
 KERNEL_FAMILIES = ("rbf", "linear", "poly")
 
 
+# ---------------------------------------------------------------- precision
+# The explicit resolved token for jax's precision="default" (raw
+# single-pass bf16 MXU matmuls). The jax name is a footgun: callers wrote
+# precision="default" believing they were asking for "the default
+# precision" and silently got ~1e-2-error bf16 Gram entries (enough to
+# break SV-set parity with the f64 oracle — ops/rbf.py DEFAULT_PRECISION).
+# Raw bf16 must now be REQUESTED by this unmistakable name; the string
+# "default" raises everywhere (resolve_matmul_precision). The blocked
+# solver keeps accepting matmul_precision="default" on its own surface
+# for backward compatibility — it translates to this token only after
+# validating the refine pairing that makes raw bf16 safe.
+RAW_BF16 = "raw_bf16"
+
+#: resolved contraction-precision tokens, the solver speed ladder:
+#:   "float32"   full-f32-equivalent multi-pass MXU matmuls (trust anchor)
+#:   "highest"   jax Precision.HIGHEST (same tier, explicit)
+#:   "bf16_f32"  bf16 operands, f32 accumulation (preferred_element_type):
+#:               single-pass MXU throughput with exact f32 adds — operand
+#:               rounding (~0.4% relative) is the only loss. Backend-
+#:               independent semantics (the operands are ROUNDED, not a
+#:               TPU precision hint), so CPU runs exercise the same math.
+#:   "bf16_f32c" ditto plus one compensated residual pass
+#:               (X - bf16(X)) @ bf16(B): recovers most of the left
+#:               operand's rounding error for ~2x the matmul cost —
+#:               still under the ~3x of full-f32 emulation.
+#:   RAW_BF16    raw single-pass bf16 (jax precision="default"); cannot
+#:               be reached by accident — see resolve_matmul_precision.
+MATMUL_PRECISIONS = ("float32", "highest", "bf16_f32", "bf16_f32c",
+                     RAW_BF16)
+
+
+def resolve_matmul_precision(precision):
+    """The single resolver every solver/ops contraction routes through.
+
+    Maps the user-facing knob to a MATMUL_PRECISIONS token:
+      None -> "float32" (the library default, full-f32 trust anchor);
+      "float32"/"highest"/"bf16_f32"/"bf16_f32c"/RAW_BF16 -> themselves;
+      "default" -> ValueError ALWAYS, naming the knob: jax's name for raw
+        bf16 reads like "no preference" and used to silently flip the
+        dominant contraction to ~1e-2-error arithmetic. The ONLY spelling
+        that reaches raw bf16 is the unmistakable RAW_BF16 token — the
+        blocked solver emits it after validating its refine/shrink drift
+        guard, and a human typing "raw_bf16" has read this docstring.
+
+    This is the runtime check the JX-lint hazard class relies on: raw
+    single-pass bf16 is impossible to enable by accident because no
+    accidental spelling resolves to it.
+    """
+    if precision is None:
+        return "float32"
+    if precision == "default":
+        raise ValueError(
+            "precision='default' is jax's name for RAW SINGLE-PASS bf16 "
+            "MXU matmuls (~1e-2 absolute error on unit-scale Gram "
+            "entries), not 'the default precision'. Request it "
+            "explicitly as tpusvm.config.RAW_BF16, use the solver knob "
+            "matmul_precision='default' (which validates the refine "
+            "pairing first), or pick a ladder rung: 'float32' (trust "
+            "anchor), 'bf16_f32' (bf16 operands, f32 accumulation), "
+            "'bf16_f32c' (compensated)."
+        )
+    if precision not in MATMUL_PRECISIONS:
+        raise ValueError(
+            f"unknown matmul precision {precision!r}; supported: "
+            f"{list(MATMUL_PRECISIONS)} (None = 'float32')"
+        )
+    return precision
+
+
 @dataclasses.dataclass(frozen=True)
 class SVMConfig:
     """Hyperparameters and numerical tolerances of the SMO solver.
@@ -200,17 +269,28 @@ PALLAS_FLAG_RULES = {
     "pallas_eta_exclude": {"inactive": False, "requires_wss": 2},
     # batched slot-pair kernel (first-order selection only)
     "pallas_multipair": {"inactive": 1, "requires_wss": 1},
+    # violator-mask + per-block top-k candidate selection fused into the
+    # f-update kernel's epilogue: a FUSED-FUPDATE-path flag, not an
+    # inner-engine flag — it requires the fused f-update contraction to
+    # be the resolved path (requires_fused), with no constraint on the
+    # inner engine or wss
+    "pallas_fused_selection": {"inactive": False, "requires_wss": None,
+                               "requires_fused": True},
 }
 
 
-def pallas_flag_errors(inner, wss, flags: dict) -> list:
+def pallas_flag_errors(inner, wss, flags: dict, fused=None) -> list:
     """Error strings for active pallas_* flags the resolved config ignores.
 
-    `inner`/`wss` are the RESOLVED solver config (after 'auto' resolution);
-    pass None for a dimension the caller does not know — static analysis
-    calls this with only the literals it can see in a call site, the
-    solver calls it with both fully resolved. `flags` maps flag name ->
-    passed value for whichever PALLAS_FLAG_RULES keys the caller has.
+    `inner`/`wss`/`fused` are the RESOLVED solver config (after 'auto'
+    resolution); pass None for a dimension the caller does not know —
+    static analysis calls this with only the literals it can see in a
+    call site, the solver calls it with everything fully resolved.
+    `flags` maps flag name -> passed value for whichever
+    PALLAS_FLAG_RULES keys the caller has. Flags marked requires_fused
+    are judged against the fused-f-update resolution instead of the
+    inner engine (they configure the contraction kernel's epilogue, not
+    the subproblem engine).
     """
     errors = []
     for name, spec in PALLAS_FLAG_RULES.items():
@@ -218,6 +298,16 @@ def pallas_flag_errors(inner, wss, flags: dict) -> list:
             continue
         value = flags[name]
         if type(value) is type(spec["inactive"]) and value == spec["inactive"]:
+            continue
+        if spec.get("requires_fused"):
+            if fused is not None and not fused:
+                errors.append(
+                    f"{name}={value!r} extends the fused Pallas f-update "
+                    "kernel; the effective fused_fupdate here is False "
+                    "(fused_fupdate='auto' resolves to the fused kernel "
+                    "only on TPU at full-f32 precision with a "
+                    "VMEM-feasible shape)"
+                )
             continue
         if inner is not None and inner != "pallas":
             errors.append(

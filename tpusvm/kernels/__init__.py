@@ -15,6 +15,7 @@ from tpusvm.kernels.dispatch import (
     matvec,
     needs_norms,
     rows_at,
+    sq_norms_for,
     validate_family,
 )
 from tpusvm.kernels.platt import fit_platt, log_loss, platt_proba

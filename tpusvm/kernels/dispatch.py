@@ -56,6 +56,17 @@ def needs_norms(family: str) -> bool:
     return validate_family(family) == "rbf"
 
 
+def sq_norms_for(family: str, X: jax.Array) -> Optional[jax.Array]:
+    """The family's precomputable row norms: sq_norms(X) for RBF, None
+    otherwise — the one-liner every sn-caching caller (tune's fold
+    caches, the shrinking driver's per-compaction cache) repeats."""
+    if needs_norms(family):
+        from tpusvm.ops.rbf import sq_norms
+
+        return sq_norms(X)
+    return None
+
+
 def rows_at(family: str, X: jax.Array, idx: jax.Array, *, gamma, coef0=0.0,
             degree: int = 3, sn: Optional[jax.Array] = None,
             precision=None) -> jax.Array:

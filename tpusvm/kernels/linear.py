@@ -23,7 +23,7 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-from tpusvm.ops.rbf import _prec
+from tpusvm.ops.rbf import _prec, matmul_p
 
 
 def linear_row(X: jax.Array, x: jax.Array, precision=None) -> jax.Array:
@@ -33,8 +33,9 @@ def linear_row(X: jax.Array, x: jax.Array, precision=None) -> jax.Array:
 
 def linear_rows_at(X: jax.Array, idx: jax.Array, precision=None) -> jax.Array:
     """K(X[idx[k]], X[j]) — one (k, d) x (d, n) matvec, no row-norm
-    traffic (the K-row IS the matmul for this family). Shape (k, n)."""
-    return jnp.matmul(X[idx], X.T, precision=_prec(precision))
+    traffic (the K-row IS the matmul for this family). Shape (k, n).
+    Routed through the precision ladder (ops.rbf.matmul_p)."""
+    return matmul_p(X[idx], X.T, precision)
 
 
 def linear_cross(XA: jax.Array, XB: jax.Array, precision=None) -> jax.Array:
@@ -56,8 +57,14 @@ def linear_cross_matvec(X: jax.Array, XB: jax.Array, coef: jax.Array, *,
     """
     coef = coef.astype(X.dtype)
     if fast:
-        w = jnp.matmul(XB.T, coef, precision=_prec(precision))  # (d,)
-        return jnp.matmul(X, w, precision=_prec(precision))
+        # the (d,)-weight prologue stays at the trust tier regardless of
+        # the ladder rung (it is O(q*d), not the streamed contraction);
+        # the laddered matmul is the X stream
+        w = jnp.matmul(XB.T, coef,
+                       precision=_prec(None if precision in
+                                       ("bf16_f32", "bf16_f32c")
+                                       else precision))  # (d,)
+        return matmul_p(X, w, precision).astype(X.dtype)
 
     n, d = X.shape
     block = min(block, n)
@@ -66,7 +73,7 @@ def linear_cross_matvec(X: jax.Array, XB: jax.Array, coef: jax.Array, *,
     def step(_, start):
         zero = jnp.zeros((), start.dtype)
         Xblk = jax.lax.dynamic_slice(X, (start, zero), (block, d))
-        K = jnp.matmul(Xblk, XB.T, precision=_prec(precision))
+        K = matmul_p(Xblk, XB.T, precision)
         return None, K @ coef
 
     starts = jnp.minimum(

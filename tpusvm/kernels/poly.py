@@ -15,7 +15,7 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-from tpusvm.ops.rbf import _prec
+from tpusvm.ops.rbf import _prec, matmul_p
 
 
 def _epilogue(dots: jax.Array, gamma, coef0, degree: int) -> jax.Array:
@@ -31,8 +31,12 @@ def poly_row(X: jax.Array, x: jax.Array, gamma, coef0, degree: int,
 
 def poly_rows_at(X: jax.Array, idx: jax.Array, gamma, coef0, degree: int,
                  precision=None) -> jax.Array:
-    """K(X[idx[k]], X[j]) via one (k, d) x (d, n) matmul. Shape (k, n)."""
-    dots = jnp.matmul(X[idx], X.T, precision=_prec(precision))
+    """K(X[idx[k]], X[j]) via one (k, d) x (d, n) matmul. Shape (k, n).
+
+    Routed through the precision ladder (ops.rbf.matmul_p): the K-row
+    refresh is a laddered contraction, like the blocked f update.
+    """
+    dots = matmul_p(X[idx], X.T, precision)
     return _epilogue(dots, gamma, coef0, degree)
 
 
@@ -60,7 +64,7 @@ def poly_cross_matvec(X: jax.Array, XB: jax.Array, coef: jax.Array, gamma,
     def step(_, start):
         zero = jnp.zeros((), start.dtype)
         Xblk = jax.lax.dynamic_slice(X, (start, zero), (block, d))
-        dots = jnp.matmul(Xblk, XB.T, precision=_prec(precision))
+        dots = matmul_p(Xblk, XB.T, precision)
         return None, _epilogue(dots, gamma, coef0, degree) @ coef
 
     starts = jnp.minimum(

@@ -8,17 +8,23 @@ bias, the train-set min/max of the scaler, and the hyperparameters.
 
 Format history:
   v1  binary/OvR RBF classifiers; config carries only numeric fields.
-  v2  the kernel/task matrix (this version): config gains the kernel
-      family + degree/coef0/epsilon, state may carry a `task` marker
-      ("svr" for EpsilonSVR; absent = classification), SVR states store
-      signed `sv_coef` instead of (sv_Y, sv_alpha), and calibrated
-      classifiers add `platt_a`/`platt_b`.
+  v2  the kernel/task matrix: config gains the kernel family +
+      degree/coef0/epsilon, state may carry a `task` marker ("svr" for
+      EpsilonSVR; absent = classification), SVR states store signed
+      `sv_coef` instead of (sv_Y, sv_alpha), and calibrated classifiers
+      add `platt_a`/`platt_b`.
+  v3  the solver speed ladder (this version): state gains the training
+      provenance fields `train_precision` ("f32" | "bf16_f32" |
+      "bf16_f32c" | "default") and `shrink_every`/`shrink_stable` —
+      which ladder rung and shrinking cadence produced the artifact.
+      Scoring never reads them.
 
-Compatibility contract: v1 files LOAD — their configs predate the kernel
-fields, which default to the implicit RBF family (bit-identical scoring to
-the build that wrote them). v2 files with an unknown kernel name fail with
-a specific error (written by a newer/tampered tpusvm), never a downstream
-shape or math error.
+Compatibility contract: v1/v2 files LOAD — configs predating the kernel
+fields default to the implicit RBF family, and states predating the
+provenance fields load as f32/no-shrink; both are bit-identical in
+scoring to the build that wrote them. Files with an unknown kernel name
+fail with a specific error (written by a newer/tampered tpusvm), never a
+downstream shape or math error.
 """
 
 from __future__ import annotations
@@ -30,8 +36,8 @@ import numpy as np
 
 from tpusvm.config import KERNEL_FAMILIES, SVMConfig
 
-_FORMAT_VERSION = 2
-_SUPPORTED_VERSIONS = (1, 2)
+_FORMAT_VERSION = 3
+_SUPPORTED_VERSIONS = (1, 2, 3)
 
 
 def _norm(path: str) -> str:

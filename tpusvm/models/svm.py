@@ -91,6 +91,12 @@ class BinarySVC:
         # materialized convergence telemetry (obs.convergence.materialize
         # output) when the blocked solver ran with telemetry=T > 0
         self.convergence_: Optional[dict] = None
+        # training provenance (round 9): precision rung + shrink cadence
+        # the fit ran under; persisted in the .npz (format v3) so
+        # `tpusvm info` can answer "which ladder rung trained this"
+        self.train_precision_: str = "f32"
+        self.shrink_every_: int = 0
+        self.shrink_stable_: int = 0
         # Platt sigmoid (A, B) after calibrate(); enables predict_proba
         self.platt_: Optional[tuple] = None
 
@@ -151,6 +157,11 @@ class BinarySVC:
                     resume: bool = False) -> "BinarySVC":
         """Shared solve + SV extraction on an already-scaled matrix."""
         cfg = self.config
+        opts = dict(self.solver_opts)
+        shrink_every = opts.pop("shrink_every", 0)
+        driver_kw = {k: opts.pop(k) for k in
+                     ("shrink_min", "shrink_gap_factor", "max_unshrinks")
+                     if k in opts}
         kw = dict(
             C=cfg.C,
             gamma=cfg.gamma,
@@ -161,14 +172,27 @@ class BinarySVC:
             degree=cfg.degree,
             coef0=cfg.coef0,
             accum_dtype=resolve_accum_dtype(self.accum_dtype),
-            **self.solver_opts,
+            **opts,
         )
+        if shrink_every and self.solver != "blocked":
+            raise ValueError(
+                "shrink_every drives the blocked solver's outer loop in "
+                "compacted segments (tpusvm.solver.shrink); the pair "
+                "solver has no working-set rounds to shrink"
+            )
         if checkpoint_path is not None:
             if self.solver != "blocked":
                 raise ValueError(
                     "checkpoint_path requires the blocked solver (the "
                     "outer-loop carry is what gets persisted); the pair "
                     "solver has no checkpointable round structure"
+                )
+            if shrink_every:
+                raise ValueError(
+                    "checkpoint_path and shrink_every both segment the "
+                    "outer loop and cannot be combined yet (the "
+                    "checkpoint carry would span changing compaction "
+                    "buckets); crash-safe shrinking is a future PR"
                 )
             from tpusvm.solver.checkpoint import checkpointed_blocked_solve
 
@@ -177,12 +201,38 @@ class BinarySVC:
                 checkpoint_path=checkpoint_path,
                 checkpoint_every=checkpoint_every, resume=resume, **kw,
             )
+        elif shrink_every:
+            from tpusvm.solver.shrink import shrinking_blocked_solve
+
+            kw.setdefault("shrink_stable", 3)
+            res = shrinking_blocked_solve(
+                jnp.asarray(Xs, self.dtype), jnp.asarray(Y),
+                shrink_every=shrink_every,
+                shrink_stable=kw.pop("shrink_stable"),
+                **driver_kw, **kw,
+            )
         else:
             solve = (blocked_smo_solve if self.solver == "blocked"
                      else smo_solve)
             res = solve(jnp.asarray(Xs, self.dtype), jnp.asarray(Y), **kw)
         alpha = np.asarray(res.alpha)  # device->host copy = completion barrier
         self.train_time_s_ = time.perf_counter() - t0
+        # training provenance persisted with the model (round 9): the
+        # precision rung and shrinking cadence it was trained under —
+        # scoring is unaffected, but `tpusvm info` must be able to answer
+        # "which ladder rung produced this artifact"
+        self.train_precision_ = opts.get("matmul_precision") or "f32"
+        self.shrink_every_ = int(shrink_every)
+        self.shrink_stable_ = int(self.solver_opts.get(
+            "shrink_stable", 3 if shrink_every else 0))
+        if getattr(res, "cache_hits", None) is not None:
+            from tpusvm.obs import default_registry
+
+            reg = default_registry()
+            reg.counter("solver.krow_cache.rows_hit").inc(
+                int(res.cache_hits))
+            reg.counter("solver.krow_cache.rows_miss").inc(
+                int(res.cache_misses))
         tele = getattr(res, "telemetry", None)
         if tele is not None:
             from tpusvm.obs.convergence import materialize
@@ -422,6 +472,11 @@ class BinarySVC:
             state["scaler_max"] = self.scaler_.max_val
         if self.platt_ is not None:
             state["platt_a"], state["platt_b"] = self.platt_
+        # training provenance (format v3): absent in older files, which
+        # load with the f32/no-shrink defaults — scoring ignores these
+        state["train_precision"] = self.train_precision_
+        state["shrink_every"] = self.shrink_every_
+        state["shrink_stable"] = self.shrink_stable_
         save_model(path, state, self.config)
 
     @classmethod
@@ -440,5 +495,12 @@ class BinarySVC:
         if "platt_a" in state:
             model.platt_ = (float(state["platt_a"]),
                             float(state["platt_b"]))
+        # v1/v2 files predate the training-provenance fields: f32 /
+        # no-shrink defaults, bit-identical scoring either way
+        if "train_precision" in state:
+            model.train_precision_ = str(state["train_precision"])
+        if "shrink_every" in state:
+            model.shrink_every_ = int(state["shrink_every"])
+            model.shrink_stable_ = int(state["shrink_stable"])
         model.status_ = Status.CONVERGED
         return model

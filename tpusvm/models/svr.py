@@ -86,11 +86,12 @@ class EpsilonSVR:
         else:
             Xs = X
         Y2, z = doubled_problem(t, cfg.epsilon)
-        solve = blocked_smo_solve if self.solver == "blocked" else smo_solve
-        res = solve(
-            jnp.concatenate([jnp.asarray(Xs, self.dtype)] * 2),
-            jnp.asarray(Y2),
-            targets=jnp.asarray(z),
+        opts = dict(self.solver_opts)
+        shrink_every = opts.pop("shrink_every", 0)
+        driver_kw = {k: opts.pop(k) for k in
+                     ("shrink_min", "shrink_gap_factor", "max_unshrinks")
+                     if k in opts}
+        kw = dict(
             C=cfg.C,
             gamma=cfg.gamma,
             eps=cfg.eps,
@@ -100,8 +101,34 @@ class EpsilonSVR:
             degree=cfg.degree,
             coef0=cfg.coef0,
             accum_dtype=resolve_accum_dtype(self.accum_dtype),
-            **self.solver_opts,
+            **opts,
         )
+        X2 = jnp.concatenate([jnp.asarray(Xs, self.dtype)] * 2)
+        if shrink_every:
+            # the doubled problem is a plain blocked solve with targets=,
+            # exactly what the shrinking driver segments (a frozen beta
+            # is a frozen beta; the twin rows are independent duals)
+            if self.solver != "blocked":
+                raise ValueError(
+                    "shrink_every requires the blocked solver"
+                )
+            from tpusvm.solver.shrink import shrinking_blocked_solve
+
+            res = shrinking_blocked_solve(
+                X2, jnp.asarray(Y2), targets=jnp.asarray(z),
+                shrink_every=shrink_every,
+                shrink_stable=kw.pop("shrink_stable", 3),
+                **driver_kw, **kw,
+            )
+        else:
+            solve = (blocked_smo_solve if self.solver == "blocked"
+                     else smo_solve)
+            res = solve(
+                X2,
+                jnp.asarray(Y2),
+                targets=jnp.asarray(z),
+                **kw,
+            )
         beta = np.asarray(res.alpha)  # device->host copy = completion barrier
         self.train_time_s_ = time.perf_counter() - t0
         tele = getattr(res, "telemetry", None)

@@ -43,7 +43,7 @@ from typing import Any, Dict, List, Optional, Tuple
 KEY_FIELDS = (
     "bench", "metric", "summary", "mode", "engine", "kernel", "task",
     "config", "threads", "topology", "P", "n", "n_train", "d", "q",
-    "seed", "case", "rows_per_shard", "telemetry", "smoke",
+    "seed", "case", "rows_per_shard", "telemetry", "smoke", "rung",
 )
 
 
@@ -107,6 +107,21 @@ SCHEMA_RULES: Dict[str, Tuple[Rule, ...]] = {
     "mnist60k_smo_train_time": (
         Rule("value", "<=", rel_tol=0.3, timing=True),
         Rule("vs_baseline", ">=", rel_tol=0.3, timing=True),
+    ),
+    # round 9, the solver speed ladder: per-rung rows pair on (bench,
+    # rung, n, d, q). Correctness metrics are exact — every rung must
+    # keep the control's solution (sv_count/accuracy) byte-for-byte
+    # across artifact generations — update counts may only fall, and the
+    # wall-clock/speedup metrics are direction-gated at full level
+    "solver_ladder": (
+        Rule("status", "=="),
+        Rule("sv_count", "=="),
+        Rule("accuracy", "=="),
+        Rule("updates", "<=", rel_tol=0.1),
+        Rule("train_s", "<=", rel_tol=0.35, timing=True),
+        Rule("speedup_vs_control", ">=", rel_tol=0.25, timing=True),
+        Rule("cache_hit_rate", ">=", abs_tol=0.05),
+        Rule("best_speedup", ">=", rel_tol=0.25, timing=True),
     ),
 }
 

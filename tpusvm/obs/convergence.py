@@ -34,12 +34,17 @@ class ConvergenceTelemetry(NamedTuple):
     status: (T,) int32 — Status value the round ended with.
     count:  scalar int32 — total rounds recorded (may exceed T: the ring
             then holds the LAST T rounds).
+    active: (T,) int32 — live (unfrozen) rows that round: the active-set
+            size under the shrinking heuristic (= all valid rows when
+            shrink tracking is off). None on rings recorded before
+            round 9.
     """
 
     gap: Any
     n_upd: Any
     status: Any
     count: Any
+    active: Any = None
 
 
 def materialize(tele: ConvergenceTelemetry) -> Dict[str, Any]:
@@ -58,13 +63,16 @@ def materialize(tele: ConvergenceTelemetry) -> Dict[str, Any]:
         order = np.arange(count)
     else:
         order = (count + np.arange(T)) % T  # oldest surviving slot first
-    return {
+    out = {
         "gap": gap[order],
         "updates": n_upd[order],
         "status": status[order],
         "rounds_recorded": count,
         "wrapped": count > T,
     }
+    if tele.active is not None:
+        out["active"] = np.asarray(tele.active)[order]
+    return out
 
 
 def to_trace_events(tracer, conv: Dict[str, Any]) -> None:
@@ -73,14 +81,17 @@ def to_trace_events(tracer, conv: Dict[str, Any]) -> None:
     from tpusvm.status import Status
 
     first = conv["rounds_recorded"] - len(conv["gap"]) + 1
+    active = conv.get("active")
     for i in range(len(conv["gap"])):
         g = float(conv["gap"][i])
+        extra = {} if active is None else {"active": int(active[i])}
         tracer.event(
             "convergence.round",
             round=first + i,
             gap=None if np.isnan(g) else g,
             updates=int(conv["updates"][i]),
             status=Status(int(conv["status"][i])).name,
+            **extra,
         )
 
 
@@ -91,13 +102,17 @@ def format_gap_table(conv: Dict[str, Any], max_rows: int = 40) -> str:
     from tpusvm.status import Status
 
     first = conv["rounds_recorded"] - len(conv["gap"]) + 1
+    active = conv.get("active")
     rows = []
     for i in range(len(conv["gap"])):
         g = float(conv["gap"][i])
-        rows.append({
+        row = {
             "round": first + i,
             "gap": None if np.isnan(g) else g,
             "updates": int(conv["updates"][i]),
             "status": Status(int(conv["status"][i])).name,
-        })
+        }
+        if active is not None:
+            row["active"] = int(active[i])
+        rows.append(row)
     return format_convergence_table(rows, max_rows=max_rows)

@@ -66,15 +66,22 @@ def convergence_rows(records: Iterable[dict]) -> List[dict]:
 
 
 def format_convergence_table(rows: List[dict], max_rows: int = 40) -> str:
-    """Fixed-width outer-round table: round, Keerthi gap, updates, status.
+    """Fixed-width outer-round table: round, Keerthi gap, updates,
+    active-set size (when the ring recorded one — round 9 shrink
+    telemetry), status.
 
     Long runs are elided in the middle (first/last max_rows//2 rounds) —
     the interesting structure is the head (cold-start collapse) and the
     tail (the approach to 2*tau)."""
     if not rows:
         return "no convergence records in this trace"
-    head = ["round      gap            updates  status",
-            "-----      ---            -------  ------"]
+    has_active = any(r.get("active") is not None for r in rows)
+    if has_active:
+        head = ["round      gap            updates   active  status",
+                "-----      ---            -------   ------  ------"]
+    else:
+        head = ["round      gap            updates  status",
+                "-----      ---            -------  ------"]
     idx = list(range(len(rows)))
     if len(idx) > max_rows:
         k = max_rows // 2
@@ -88,8 +95,12 @@ def format_convergence_table(rows: List[dict], max_rows: int = 40) -> str:
         r = rows[i]
         gap = r.get("gap")
         gap_s = f"{gap:.6e}" if gap is not None else "n/a"
-        out.append(f"{r.get('round', i + 1):>5}  {gap_s:>13}  "
-                   f"{r.get('updates', 0):>7}  {r.get('status', '?')}")
+        line = (f"{r.get('round', i + 1):>5}  {gap_s:>13}  "
+                f"{r.get('updates', 0):>7}")
+        if has_active:
+            act = r.get("active")
+            line += f"  {act if act is not None else 'n/a':>7}"
+        out.append(f"{line}  {r.get('status', '?')}")
     return "\n".join(out)
 
 

@@ -29,12 +29,26 @@ VMEM-infeasible / unaligned shapes, 'auto' keeps the XLA contraction.
 
 from __future__ import annotations
 
+import contextlib
 import functools
 
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
+
+
+def _x64_off():
+    """`jax.enable_x64(False)` where available, else a no-op context.
+
+    The grid index maps' i64 promotion only breaks MOSAIC lowering (see
+    the trace-time comment at the call sites); older jax builds without
+    the context manager (0.4.3x) cannot hit that path off-TPU, where
+    interpret mode runs true f32 math regardless."""
+    try:
+        return jax.enable_x64(False)
+    except AttributeError:
+        return contextlib.nullcontext()
 
 
 def _kernel(gamma_ref, x_ref, sn_ref, xb_t_ref, snb_ref, coef_ref, out_ref):
@@ -185,7 +199,7 @@ def rbf_cross_matvec_pallas(
     # kernel). Every operand here is explicitly f32, so disabling
     # promotion inside the call changes nothing semantically. The grid-less
     # inner_smo kernel never hits this (no index maps).
-    with jax.enable_x64(False):
+    with _x64_off():
         out = pl.pallas_call(
             _kernel,
             grid=(nb,),
@@ -212,3 +226,204 @@ def rbf_cross_matvec_pallas(
             coef.astype(jnp.float32)[:, None],
         )
     return out[:, 0].astype(X.dtype)
+
+
+# --------------------------------------------------------------------------
+# Fused f-update + working-set selection (round 9, ladder rung 3): the
+# violator-mask + per-block top-k candidate selection runs in the SAME
+# kernel epilogue that computes df, so the separate mask+top_k pass the
+# solver used to make over all n rows disappears. Each grid step emits,
+# besides its df block, the k best I_high candidates (smallest updated f)
+# and k best I_low candidates (largest updated f) of its rows; the solver
+# assembles the next working set from the (nb * k)-sized candidate pool.
+# Selection quality is the per-block-top-k approximation (each block's
+# extremes always survive — the same progress argument as
+# selection='approx'); the Keerthi STOP decision stays outside on exact
+# global reductions, so the convergence criterion is unchanged.
+# --------------------------------------------------------------------------
+
+
+def selection_shape(n: int, d: int, q: int, k_min: int = 8):
+    """(block, nb, k_cand, ncand) the fused-selection kernel will use.
+
+    One definition shared by the kernel wrapper and the solver (the
+    candidate arrays live in the solver's loop carry, so their static
+    shapes must agree with the kernel's grid). k_cand is sized so the
+    candidate pool covers a full q/2 half (plus a k_min floor for
+    selection quality on small grids); nb * k_cand <= n always holds
+    because k_cand <= block (half <= n/2 <= nb*block/2).
+    """
+    try:
+        block = _auto_block(q, d, n)
+    except ValueError:
+        block = 1024
+    block = min(block, max(n, 8))
+    nb = -(-n // block)
+    half = max(q // 2, 1)
+    k_cand = max(k_min, -(-half // nb))
+    k_cand = min(k_cand, block)
+    return block, nb, k_cand, nb * k_cand
+
+
+def _make_select_kernel(block: int, k_cand: int):
+    def kernel(fscal_ref, nscal_ref, x_ref, sn_ref, xb_t_ref, snb_ref,
+               coef_ref, f_ref, a_ref, ye_ref,
+               df_ref, upv_ref, upi_ref, lov_ref, loi_ref):
+        gamma = fscal_ref[0]
+        C = fscal_ref[1]
+        eps = fscal_ref[2]
+        n = nscal_ref[0]
+        # --- the f-update contraction, exactly as _kernel ----------------
+        xdot = jax.lax.dot_general(
+            x_ref[:], xb_t_ref[:],
+            dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.HIGHEST,
+        )
+        d2 = sn_ref[:] + snb_ref[:] - 2.0 * xdot
+        d2 = jnp.maximum(d2, 0.0)
+        k = jnp.exp(-gamma * d2)
+        df = jax.lax.dot_general(
+            k, coef_ref[:],
+            dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.HIGHEST,
+        )
+        df_ref[:] = df
+        # --- epilogue: violator masks + per-block top-k candidates -------
+        f_new = f_ref[:] + df                    # (block, 1) f32
+        a = a_ref[:]                             # (block, 1) f32
+        ye = ye_ref[:]                           # (block, 1) i32; 0=invalid
+        rows = jax.lax.broadcasted_iota(jnp.int32, (block, 1), 0)
+        gidx = rows + pl.program_id(0) * block
+        in_range = gidx < n
+        m_h = jnp.where(ye == 1, a < C - eps, (ye == -1) & (a > eps))
+        m_l = jnp.where(ye == 1, a > eps, (ye == -1) & (a < C - eps))
+        key_up = jnp.where(m_h & in_range, f_new, jnp.inf)
+        key_lo = jnp.where(m_l & in_range, f_new, -jnp.inf)
+
+        def pick(key, chosen, largest):
+            eff = jnp.where(chosen, -jnp.inf if largest else jnp.inf, key)
+            v = jnp.max(eff) if largest else jnp.min(eff)
+            cand = (eff == v) & ~chosen
+            pos = jnp.max(jnp.where(cand, rows, -1))
+            return v, pos, chosen | (rows == pos)
+
+        up_v, up_i, lo_v, lo_i = [], [], [], []
+        chosen_up = jnp.zeros((block, 1), bool)
+        chosen_lo = jnp.zeros((block, 1), bool)
+        base = pl.program_id(0) * block
+        for _ in range(k_cand):  # static unroll: k_cand is small
+            v, pos, chosen_up = pick(key_up, chosen_up, largest=False)
+            up_v.append(v.reshape(1, 1))
+            up_i.append((pos + base).reshape(1, 1))
+            v, pos, chosen_lo = pick(key_lo, chosen_lo, largest=True)
+            lo_v.append(v.reshape(1, 1))
+            lo_i.append((pos + base).reshape(1, 1))
+        upv_ref[:] = jnp.concatenate(up_v, axis=1)
+        upi_ref[:] = jnp.concatenate(up_i, axis=1)
+        lov_ref[:] = jnp.concatenate(lo_v, axis=1)
+        loi_ref[:] = jnp.concatenate(lo_i, axis=1)
+
+    return kernel
+
+
+@functools.partial(
+    jax.jit, static_argnames=("k_cand", "block", "interpret")
+)
+def fused_fupdate_select_pallas(
+    X: jax.Array,
+    XB: jax.Array,
+    coef: jax.Array,
+    gamma,
+    sn: jax.Array | None,
+    f32_f: jax.Array,
+    alpha32: jax.Array,
+    y_eff: jax.Array,
+    C,
+    eps,
+    *,
+    k_cand: int,
+    block: int | None = None,
+    interpret: bool = False,
+):
+    """df + next-round working-set candidates, fused in VMEM.
+
+    Returns (df (n,) f32, up_val (ncand,) f32, up_idx (ncand,) i32,
+    low_val, low_idx) with ncand = nb * k_cand. f32_f is the CURRENT f's
+    f32 face (candidate keys were already f32 in the two-pass path — the
+    exact adt f stays with the solver for the stop decision); alpha32 the
+    POST-round alphas (next round's masks); y_eff = y * valid, so invalid
+    rows (y=0) belong to neither index set. Filler candidates carry
+    +/-inf values; their indices may alias real rows (the solver clamps
+    and first-occurrence-dedups them). The df face of this kernel is the
+    same full-f32 pipeline as rbf_cross_matvec_pallas.
+    """
+    from tpusvm.ops.rbf import sq_norms
+
+    n, d = X.shape
+    q = XB.shape[0]
+    if sn is None:
+        sn = sq_norms(X)
+    snB = sq_norms(XB)
+
+    if block is None:
+        try:
+            block = _auto_block(q, d, n)
+        except ValueError:
+            if not interpret:
+                raise
+            block = 1024
+    block = min(block, max(n, 8))
+    nb = -(-n // block)
+
+    kernel = _make_select_kernel(block, k_cand)
+    with _x64_off():
+        out = pl.pallas_call(
+            kernel,
+            grid=(nb,),
+            in_specs=[
+                pl.BlockSpec(memory_space=pltpu.SMEM),  # gamma, C, eps
+                pl.BlockSpec(memory_space=pltpu.SMEM),  # n
+                pl.BlockSpec((block, d), lambda i: (i, 0)),
+                pl.BlockSpec((block, 1), lambda i: (i, 0)),
+                # XB^T, snB, coef: whole-array, VMEM-resident across grid
+                pl.BlockSpec((d, q), lambda i: (0, 0)),
+                pl.BlockSpec((1, q), lambda i: (0, 0)),
+                pl.BlockSpec((q, 1), lambda i: (0, 0)),
+                pl.BlockSpec((block, 1), lambda i: (i, 0)),  # f32 f
+                pl.BlockSpec((block, 1), lambda i: (i, 0)),  # alpha32
+                pl.BlockSpec((block, 1), lambda i: (i, 0)),  # y_eff
+            ],
+            out_specs=[
+                pl.BlockSpec((block, 1), lambda i: (i, 0)),
+                pl.BlockSpec((1, k_cand), lambda i: (i, 0)),
+                pl.BlockSpec((1, k_cand), lambda i: (i, 0)),
+                pl.BlockSpec((1, k_cand), lambda i: (i, 0)),
+                pl.BlockSpec((1, k_cand), lambda i: (i, 0)),
+            ],
+            out_shape=[
+                jax.ShapeDtypeStruct((n, 1), jnp.float32),
+                jax.ShapeDtypeStruct((nb, k_cand), jnp.float32),
+                jax.ShapeDtypeStruct((nb, k_cand), jnp.int32),
+                jax.ShapeDtypeStruct((nb, k_cand), jnp.float32),
+                jax.ShapeDtypeStruct((nb, k_cand), jnp.int32),
+            ],
+            interpret=interpret,
+        )(
+            jnp.stack([jnp.asarray(gamma, jnp.float32).reshape(()),
+                       jnp.asarray(C, jnp.float32).reshape(()),
+                       jnp.asarray(eps, jnp.float32).reshape(())]),
+            jnp.asarray(n, jnp.int32).reshape(1),
+            X.astype(jnp.float32),
+            sn.astype(jnp.float32)[:, None],
+            XB.astype(jnp.float32).T,
+            snB.astype(jnp.float32)[None, :],
+            coef.astype(jnp.float32)[:, None],
+            f32_f.astype(jnp.float32)[:, None],
+            alpha32.astype(jnp.float32)[:, None],
+            y_eff.astype(jnp.int32)[:, None],
+        )
+    df, upv, upi, lov, loi = out
+    return (df[:, 0].astype(X.dtype), upv.reshape(-1), upi.reshape(-1),
+            lov.reshape(-1), loi.reshape(-1))

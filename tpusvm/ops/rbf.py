@@ -25,18 +25,86 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
+from tpusvm.config import RAW_BF16, resolve_matmul_precision
+
 # Matmul precision for the distance dot-products. TPU MXUs compute f32
-# matmuls in bfloat16 passes by default (~1e-2 absolute error on [0,1]^d
-# Gram entries) — enough to perturb the SMO trajectory and break SV-set
-# parity with the f64 oracle (the reference's correctness criterion,
-# SURVEY.md §4). "float32" forces full-f32-equivalent MXU passes; pass
-# precision="default" explicitly where raw bf16 speed is worth trajectory
-# divergence. CPU/GPU backends ignore this knob (always true f32).
+# matmuls in bfloat16 passes when asked for jax precision="default"
+# (~1e-2 absolute error on [0,1]^d Gram entries) — enough to perturb the
+# SMO trajectory and break SV-set parity with the f64 oracle (the
+# reference's correctness criterion, SURVEY.md §4). "float32" forces
+# full-f32-equivalent MXU passes. FOOTGUN, now closed: precision=
+# "default" READS like "no preference" but REQUESTS raw bf16 — every
+# precision knob in this module therefore routes through
+# tpusvm.config.resolve_matmul_precision, which raises on the "default"
+# spelling and admits raw bf16 only as the unmistakable
+# config.RAW_BF16 token (the blocked solver emits it after validating
+# its refine drift guard). CPU/GPU backends ignore the precision= hint
+# (always true f32); the bf16_f32* rungs ROUND OPERANDS and so behave
+# identically on every backend.
 DEFAULT_PRECISION = "float32"
 
 
 def _prec(precision):
-    return DEFAULT_PRECISION if precision is None else precision
+    """Resolved token -> the jax `precision=` argument for plain matmuls.
+
+    The bf16_f32* rungs are not expressible as a precision hint (they
+    cast operands); contractions that support them go through matmul_p.
+    """
+    p = resolve_matmul_precision(precision)
+    if p in ("bf16_f32", "bf16_f32c"):
+        raise ValueError(
+            f"precision={p!r} casts operands to bfloat16 and is only "
+            "implemented for the laddered contractions (ops.rbf.matmul_p "
+            "call sites: the solver f-update / K-row refresh); this "
+            "computation runs at the trust-anchor tiers only"
+        )
+    return "default" if p == RAW_BF16 else p
+
+
+def matmul_p(A: jax.Array, B: jax.Array, precision=None) -> jax.Array:
+    """A @ B at the requested precision rung — the laddered contraction.
+
+    The solver's dominant cost (the (n, d) x (d, q) f-update distance
+    dot and the K-row refresh) routes through here so every rung of the
+    speed ladder is requested the same explicit way:
+
+      "float32"/"highest": plain matmul at the full-f32 trust tier.
+      "bf16_f32":  operands ROUNDED to bfloat16, accumulated in f32
+        (preferred_element_type) — single-pass MXU throughput; the only
+        loss is the ~2^-9 relative operand rounding. Backend-independent
+        semantics: CPU runs round the same operands, so cross-precision
+        parity harnesses exercise the real arithmetic off-TPU.
+      "bf16_f32c": compensated — adds (A - bf16(A)) @ bf16(B), the
+        residual of the LEFT operand (the streamed X block, which
+        dominates the rounding error budget; B is the q-sized working
+        set). ~2x the matmul cost, still under full-f32 emulation's ~3x.
+      RAW_BF16: raw single-pass bf16 (jax precision="default").
+
+    Output dtype is f32 for the bf16 rungs (the f32 accumulator),
+    A's promotion otherwise — callers cast to their accumulator dtype,
+    exactly as they do for the plain matmul.
+    """
+    p = resolve_matmul_precision(precision)
+    if p in ("bf16_f32", "bf16_f32c"):
+        Ab = A.astype(jnp.bfloat16)
+        Bb = B.astype(jnp.bfloat16)
+        out = jnp.matmul(Ab, Bb, preferred_element_type=jnp.float32)
+        if p == "bf16_f32c":
+            resid = (A.astype(jnp.float32)
+                     - Ab.astype(jnp.float32)).astype(jnp.bfloat16)
+            out = out + jnp.matmul(resid, Bb,
+                                   preferred_element_type=jnp.float32)
+        return out
+    return jnp.matmul(A, B, precision=_prec(p))
+
+
+def _norm_prec(precision):
+    """Precision for the row-norm prologues of a laddered contraction:
+    the bf16 rungs keep their norms at the trust anchor (norms feed the
+    distance formula's cancellation — rounding them costs accuracy for
+    no bandwidth win; they are O(n*d) once, not per-round)."""
+    p = resolve_matmul_precision(precision)
+    return None if p in ("bf16_f32", "bf16_f32c") else p
 
 
 def sq_norms(X: jax.Array, precision=None) -> jax.Array:
@@ -69,9 +137,9 @@ def rbf_rows_at(X: jax.Array, idx: jax.Array, gamma,
     """
     Xi = X[idx]  # (k, d)
     if sn is None:
-        sn = sq_norms(X, precision)
+        sn = sq_norms(X, _norm_prec(precision))
     d2 = (sn[idx][:, None] + sn[None, :]
-          - 2.0 * jnp.matmul(Xi, X.T, precision=_prec(precision)))
+          - 2.0 * matmul_p(Xi, X.T, precision))
     d2 = jnp.maximum(d2, 0.0)
     return jnp.exp(-gamma * d2)
 
@@ -125,8 +193,8 @@ def rbf_cross_matvec(
     block = min(block, n)
     nb = -(-n // block)
     if sn is None:
-        sn = sq_norms(X, precision)
-    snB = sq_norms(XB, precision)
+        sn = sq_norms(X, _norm_prec(precision))
+    snB = sq_norms(XB, _norm_prec(precision))
     coef = coef.astype(X.dtype)
 
     def step(_, start):
@@ -134,7 +202,7 @@ def rbf_cross_matvec(
         Xblk = jax.lax.dynamic_slice(X, (start, zero), (block, d))
         snblk = jax.lax.dynamic_slice(sn, (start,), (block,))
         d2 = (snblk[:, None] + snB[None, :]
-              - 2.0 * jnp.matmul(Xblk, XB.T, precision=_prec(precision)))
+              - 2.0 * matmul_p(Xblk, XB.T, precision))
         d2 = jnp.maximum(d2, 0.0)
         return None, jnp.exp(-gamma * d2) @ coef
 

@@ -477,7 +477,14 @@ def cascade_fit(
     as future work (SURVEY.md §2.3 last row). Both converge to the same
     stopping criterion, so the cascade's SV-set fixed point is unchanged.
     solver_opts: extra static solver knobs (blocked: q, max_outer,
-    max_inner).
+    max_inner, matmul_precision — bf16_f32 rungs require a refine
+    budget here, since leaves run under shard_map where the shrinking
+    driver's un-shrink revalidation cannot; krow_cache works per leaf).
+    The host-driven shrink_every/shrink_min/... driver knobs
+    (solver/shrink.py) are rejected with a specific error: compaction
+    is a host-side segmenting loop, which a shard_map'd leaf solve
+    cannot run — single-chip shrinking of a cascade's leaf problems is
+    a future PR.
 
     stratified: deal each class round-robin over the shards instead of
     the reference's contiguous scatter (data.partition) — label-sorted
@@ -493,6 +500,18 @@ def cascade_fit(
     """
     if solver not in ("pair", "blocked"):
         raise ValueError(f"unknown solver {solver!r}")
+    driver_keys = sorted(set(solver_opts or ()) & {
+        "shrink_every", "shrink_min", "shrink_gap_factor",
+        "max_unshrinks"})
+    if driver_keys:
+        # fail specifically, not as a TypeError from a shard_map'd solve
+        raise ValueError(
+            f"solver_opts {driver_keys} belong to the host-side "
+            "shrinking driver (tpusvm.solver.shrink), which cannot run "
+            "inside the cascade's shard_map leaves; use --mode single "
+            "for shrinking, or drop the knobs (shrink_stable alone is "
+            "a valid leaf-solver static: stability tracking only)"
+        )
     accum_dtype = resolve_accum_dtype(accum_dtype)
     cc = cascade_config
     n_shards = cc.n_shards

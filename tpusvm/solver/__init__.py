@@ -1,5 +1,6 @@
 from tpusvm.solver.blocked import blocked_smo_solve
 from tpusvm.solver.predict import decision_function, predict
+from tpusvm.solver.shrink import shrinking_blocked_solve
 from tpusvm.solver.smo import SMOResult, SMOState, smo_solve
 
 __all__ = [
@@ -7,6 +8,7 @@ __all__ = [
     "SMOState",
     "smo_solve",
     "blocked_smo_solve",
+    "shrinking_blocked_solve",
     "decision_function",
     "predict",
 ]

@@ -44,7 +44,7 @@ import numpy as np
 from jax import lax
 
 from tpusvm import kernels
-from tpusvm.config import pallas_flag_errors
+from tpusvm.config import RAW_BF16, pallas_flag_errors
 from tpusvm.obs import prof
 from tpusvm.obs.convergence import ConvergenceTelemetry
 from tpusvm.ops.rbf import sq_norms
@@ -135,15 +135,16 @@ def resolve_fused_fupdate(n: int, d: int, *, q: int = 1024,
     # rejection the solver applies only to `fused is True`
     if fused is True:
         # mirror blocked_smo_solve's validation: explicit fused=True with
-        # bf16 matmuls is a config the solver REJECTS, so the helper must
-        # not report fused_eff=True for it (a benchmark deriving its
-        # recorded "effective config" from here would otherwise describe
-        # a run that cannot exist)
-        if matmul_precision == "default":
+        # reduced-precision matmuls is a config the solver REJECTS, so the
+        # helper must not report fused_eff=True for it (a benchmark
+        # deriving its recorded "effective config" from here would
+        # otherwise describe a run that cannot exist)
+        if matmul_precision in ("default", "bf16_f32", "bf16_f32c"):
             raise ValueError(
                 "fused_fupdate=True cannot honour matmul_precision="
-                "'default' (raw bf16); blocked_smo_solve rejects this "
-                "combination — use fused='auto' or the XLA path"
+                f"{matmul_precision!r} (the fused dot runs at the "
+                "full-f32 trust-anchor tier); blocked_smo_solve rejects "
+                "this combination — use fused='auto' or the XLA path"
             )
         return True
     if fused is False:
@@ -158,7 +159,7 @@ def resolve_fused_fupdate(n: int, d: int, *, q: int = 1024,
     # can pin it; None = the live default backend, which is what the
     # solver itself and effective-config records use
     if (backend or jax.default_backend()) != "tpu" \
-            or matmul_precision == "default":
+            or matmul_precision in ("default", "bf16_f32", "bf16_f32c"):
         return False
     from tpusvm.ops.pallas.fused_fupdate import fused_feasible
 
@@ -188,6 +189,28 @@ class _OuterState(NamedTuple):
     tele_upd: jax.Array     # (T,) int32: inner updates that round
     tele_status: jax.Array  # (T,) int32: end-of-round Status
     tele_i: jax.Array       # scalar int32: rounds recorded so far
+    tele_active: jax.Array  # (T,) int32: live (unfrozen) rows that round
+    # shrink-stability counters (shrink_stable=S > 0; shape-(0,) when
+    # off): consecutive rounds each row has been at-bound AND Keerthi-safe
+    # — written every round, read only by the shrinking driver
+    # (tpusvm.solver.shrink), so the solve itself is bit-transparent to S
+    stable: jax.Array       # (n,) int32
+    # K-row LRU cache (krow_cache=slots > 0; zero-size when off): rows of
+    # K(X[key], X) keyed by training-row index, with carry-resident age
+    # counters — consulted before the (n,d)x(d,q) refresh
+    cache: jax.Array        # (slots, n) float32
+    cache_keys: jax.Array   # (slots,) int32; -1 = empty slot
+    cache_age: jax.Array    # (slots,) int32: rounds since last touch
+    cache_hits: jax.Array   # int32: rows served from cache (all-hit rounds)
+    cache_misses: jax.Array  # int32: rows computed fresh (X streamed)
+    # fused-selection candidate ring (pallas_fused_selection; (0,) when
+    # off): per-block working-set candidates written by the fused
+    # f-update kernel's epilogue at the END of round r, consumed by round
+    # r+1's selection — the two-pass mask+top_k over all n rows is gone
+    cand_up_val: jax.Array   # (ncand,) f32; +inf = filler (non-member)
+    cand_up_idx: jax.Array   # (ncand,) int32
+    cand_low_val: jax.Array  # (ncand,) f32; -inf = filler
+    cand_low_idx: jax.Array  # (ncand,) int32
 
 
 def _inner_smo(K_BB, y_B, a_B, f_B, active_B, C, eps, tau, max_inner,
@@ -330,9 +353,39 @@ _BLOCKED_STATIC = (
     "accum_dtype", "inner", "refine", "max_refines", "wss",
     "matmul_precision", "selection", "fused_fupdate",
     "pallas_layout", "pallas_eta_exclude",
-    "pallas_multipair", "telemetry", "kernel", "degree",
-    "kernel_fast", "return_state",
+    "pallas_multipair", "pallas_fused_selection", "telemetry",
+    "kernel", "degree", "kernel_fast", "shrink_stable", "krow_cache",
+    "return_state",
 )
+
+
+def bootstrap_candidates(f, alpha, Y, valid, C, eps, ncand: int):
+    """Working-set candidate lists from scratch (the two-pass XLA path).
+
+    The fused-selection carry needs round-1 candidates before the kernel
+    has ever run (and the shrinking driver needs them again after a
+    compaction changes the candidate shapes): one exact masked top-ncand
+    over the full f — the same arrays the kernel's per-block epilogue
+    approximates every later round. Returns
+    (up_val, up_idx, low_val, low_idx); fillers are +/-inf with idx 0.
+    """
+    n = f.shape[0]
+    m_h = i_high_mask(alpha, Y, C, eps, valid)
+    m_l = i_low_mask(alpha, Y, C, eps, valid)
+    key_up = jnp.where(m_h, f, jnp.inf).astype(jnp.float32)
+    key_lo = jnp.where(m_l, f, -jnp.inf).astype(jnp.float32)
+    k = min(ncand, n)
+    neg_uv, ui = lax.top_k(-key_up, k)
+    lv, li = lax.top_k(key_lo, k)
+    uv = -neg_uv
+    pad = ncand - k
+    if pad:
+        uv = jnp.concatenate([uv, jnp.full((pad,), jnp.inf, uv.dtype)])
+        lv = jnp.concatenate([lv, jnp.full((pad,), -jnp.inf, lv.dtype)])
+        zi = jnp.zeros((pad,), jnp.int32)
+        ui = jnp.concatenate([ui.astype(jnp.int32), zi])
+        li = jnp.concatenate([li.astype(jnp.int32), zi])
+    return (uv, ui.astype(jnp.int32), lv, li.astype(jnp.int32))
 
 
 @functools.partial(jax.jit, static_argnames=_BLOCKED_STATIC)
@@ -363,11 +416,14 @@ def _blocked_smo_solve_jit(
     pallas_layout: str = "packed",
     pallas_eta_exclude: bool = False,
     pallas_multipair: int = 1,
+    pallas_fused_selection: bool = False,
     telemetry: int = 0,
     kernel: str = "rbf",
     degree: int = 3,
     coef0: float = 0.0,
     kernel_fast: bool = True,
+    shrink_stable: int = 0,
+    krow_cache: int = 0,
     targets: Optional[jax.Array] = None,
     resume_state: Optional["_OuterState"] = None,
     pause_at: Optional[jax.Array] = None,
@@ -507,6 +563,59 @@ def _blocked_smo_solve_jit(
     the rebuild is skipped and the claim is accepted on the drifted f —
     in fast mode size the cap generously above the expected SV count.
 
+    Rounds above the raw rung (round 9, the solver speed ladder —
+    tpusvm.config.resolve_matmul_precision is the single resolver):
+    matmul_precision="bf16_f32" ROUNDS the f-update operands to bfloat16
+    and accumulates in f32 (preferred_element_type) — single-pass MXU
+    throughput with exact adds; "bf16_f32c" adds one compensated
+    residual pass. Both are backend-independent (operands are rounded,
+    not hinted), so CPU parity runs exercise the real arithmetic. Every
+    trust anchor stays full precision exactly as for "default" (K_BB,
+    refine rebuilds, row norms); the drift guard is refine > 0 OR
+    shrink_stable > 0 (the shrinking driver re-validates every
+    convergence claim on a full-precision f rebuild at un-shrink).
+
+    shrink_stable (static): S > 0 carries per-row stability counters
+    through the loop — consecutive rounds a row has been at-bound
+    (alpha in {0, C} to eps) and Keerthi-SAFE (unable to join a
+    violating pair at the current band: not in I_high with
+    f < b_low - 2*tau, not in I_low with f > b_high + 2*tau). The
+    counters are written, never read, by the solve (bit-transparent,
+    like the telemetry ring); the shrinking driver
+    (tpusvm.solver.shrink.shrinking_blocked_solve) reads them between
+    segments to freeze rows and compact the live set. 0 (default) = off,
+    shape-(0,) carry.
+
+    krow_cache (static): slots > 0 keeps a (slots, n) device-resident
+    LRU cache of K-rows keyed by training-row index with carry-resident
+    age counters, consulted before the f-update refresh. Rounds whose
+    ENTIRE working set is cached compute f += rows^T @ dcoef straight
+    from the cache — no X stream, no kernel evaluation (repeat
+    violators are the common case near convergence); any miss streams X
+    once through the K-row batch (kernels.rows_at), uses those rows for
+    the update, and inserts all q of them into the oldest/empty slots.
+    Cached values equal the fresh computation bitwise (K-rows are pure
+    functions of X), so hit rounds are bit-identical to recomputing.
+    Requires slots >= q (a whole working set must fit) and forces the
+    rows-form f-update (fused_fupdate resolves off; explicit True
+    raises). cache_hits/cache_misses count ROWS served per source and
+    surface on SMOResult and the obs registry.
+
+    pallas_fused_selection (static): fold the next round's violator-mask
+    + per-block top-k candidate selection into the fused f-update
+    kernel's epilogue (ops/pallas/fused_fupdate.py) — the kernel writes
+    df AND, per row-block, the k best I_high/I_low candidates of the
+    updated f, so the separate mask+top_k pass over all n rows
+    disappears. Selection quality matches selection='approx'
+    (each block's extremes always survive, so the globally maximal
+    violating pair is always selectable and progress per round is
+    preserved); the Keerthi STOP decision stays on exact global
+    reductions over the full f, so the convergence criterion is
+    unchanged. Requires the fused f-update to be the resolved path
+    (pallas_flag_errors, requires_fused), refine=0 (a rebuilt f would
+    orphan the carried candidates) and selection='auto' (the fused
+    candidates replace that knob). XLA fallback = today's two-pass path.
+
     kernel/degree/coef0 (kernel and degree static): kernel family and its
     parameters (tpusvm.kernels). "rbf" (the default) runs the pre-refactor
     code path byte-for-byte — K_BB, the f-update contraction, warm starts
@@ -565,10 +674,12 @@ def _blocked_smo_solve_jit(
         raise ValueError(f"inner must be auto|xla|pallas, got {inner!r}")
     if wss not in (1, 2):
         raise ValueError(f"wss must be 1 or 2, got {wss}")
-    if matmul_precision not in (None, "float32", "default", "highest"):
+    if matmul_precision not in (None, "float32", "default", "highest",
+                                "bf16_f32", "bf16_f32c"):
         raise ValueError(
-            f"matmul_precision must be None, 'float32', 'default' or "
-            f"'highest', got {matmul_precision!r}"
+            f"matmul_precision must be None, 'float32', 'default', "
+            f"'highest', 'bf16_f32' or 'bf16_f32c', "
+            f"got {matmul_precision!r}"
         )
     if selection not in ("auto", "exact", "approx"):
         raise ValueError(
@@ -579,9 +690,39 @@ def _blocked_smo_solve_jit(
             f"telemetry must be a non-negative int ring size, "
             f"got {telemetry!r}"
         )
+    if not isinstance(shrink_stable, int) or shrink_stable < 0:
+        raise ValueError(
+            f"shrink_stable must be a non-negative int round count, "
+            f"got {shrink_stable!r}"
+        )
+    if not isinstance(krow_cache, int) or krow_cache < 0:
+        raise ValueError(
+            f"krow_cache must be a non-negative int slot count, "
+            f"got {krow_cache!r}"
+        )
+    if pallas_fused_selection and selection != "auto":
+        raise ValueError(
+            "pallas_fused_selection replaces working-set selection with "
+            "the kernel epilogue's per-block candidates; an explicit "
+            f"selection={selection!r} would be silently ignored — pass "
+            "selection='auto'"
+        )
+    if pallas_fused_selection and refine:
+        raise ValueError(
+            "pallas_fused_selection carries next-round candidates "
+            "computed by the f-update kernel; refine mode rebuilds f "
+            "outside the kernel, which would orphan them — use one or "
+            "the other"
+        )
     q, inner, wss, selection = resolve_solver_config(
         n, q, inner=inner, wss=wss, selection=selection
     )
+    if krow_cache and krow_cache < q:
+        raise ValueError(
+            f"krow_cache={krow_cache} slots cannot hold a full working "
+            f"set (q={q} after clamping): a miss round inserts all q "
+            "fresh rows at once — use krow_cache >= q or a smaller q"
+        )
     half = q // 2
     if pallas_layout not in ("packed", "flat"):
         raise ValueError(
@@ -601,7 +742,18 @@ def _blocked_smo_solve_jit(
     if flag_errors:
         raise ValueError("; ".join(flag_errors))
     kernels.validate_family(kernel)
-    if kernel != "rbf":
+    if krow_cache:
+        # the cache consults/streams EXPLICIT K-rows; the fused Pallas
+        # f-update never materialises them — the two paths are disjoint
+        if fused_fupdate is True:
+            raise ValueError(
+                "krow_cache consults explicit K-rows before the refresh; "
+                "the fused Pallas f-update (fused_fupdate=True) never "
+                "materialises rows to cache — pick one "
+                "(fused_fupdate='auto' resolves to the rows path)"
+            )
+        fused_fupdate = False
+    elif kernel != "rbf":
         # the fused Pallas contraction implements the RBF distance+exp
         # pipeline only; an explicit request for it with another family is
         # a config lie, 'auto' just resolves to the generic path
@@ -615,11 +767,20 @@ def _blocked_smo_solve_jit(
     else:
         # fused=True + bf16 matmuls is rejected INSIDE resolve_fused_fupdate
         # (single source of truth; the fused contraction runs at the full-f32
-        # trust-anchor tier and cannot honour matmul_precision='default')
+        # trust-anchor tier and cannot honour reduced-precision rungs)
         fused_fupdate = resolve_fused_fupdate(
             n, X.shape[1], q=q, fused=fused_fupdate,
             matmul_precision=matmul_precision,
         )
+    # an ACTIVE pallas_fused_selection must reach the fused kernel it
+    # extends — same recorded-config-lie rule as the engine flags, judged
+    # against the RESOLVED fused-f-update path
+    flag_errors = pallas_flag_errors(
+        None, None, {"pallas_fused_selection": pallas_fused_selection},
+        fused=fused_fupdate,
+    )
+    if flag_errors:
+        raise ValueError("; ".join(flag_errors))
     if matmul_precision == "default" and (refine <= 0 or max_refines < 1):
         raise ValueError(
             "matmul_precision='default' (raw bf16 MXU passes) accumulates "
@@ -627,6 +788,21 @@ def _blocked_smo_solve_jit(
             ">= 1 so convergence claims are re-validated on a "
             "full-precision reconstruction"
         )
+    if matmul_precision in ("bf16_f32", "bf16_f32c") \
+            and (refine <= 0 or max_refines < 1) and shrink_stable <= 0:
+        raise ValueError(
+            f"matmul_precision={matmul_precision!r} rounds the f-update "
+            "operands to bfloat16; accumulated convergence claims need a "
+            "full-precision revalidation — pair with refine > 0 and "
+            "max_refines >= 1, or run under the shrinking driver "
+            "(shrink_stable > 0: tpusvm.solver.shrink re-checks every "
+            "claim on a rebuilt f at un-shrink)"
+        )
+    # the jax name "default" (raw single-pass bf16) is rejected by the
+    # ops-layer resolver; having validated the refine pairing above, the
+    # solver requests it by its unmistakable token (config.RAW_BF16)
+    ops_precision = (RAW_BF16 if matmul_precision == "default"
+                     else matmul_precision)
     if inner == "pallas" and q % _PALLAS_LANE:
         raise ValueError(
             f"inner='pallas' needs the working-set size to be a multiple of "
@@ -663,6 +839,14 @@ def _blocked_smo_solve_jit(
 
     refine_cap = min(refine, n) if refine > 0 else 0
 
+    if pallas_fused_selection:
+        from tpusvm.ops.pallas.fused_fupdate import selection_shape
+
+        _kblock, _knb, _kcand, _ncand = selection_shape(n, X.shape[1], q)
+        # invalid rows enter the kernel with y=0, which belongs to neither
+        # index set — one operand instead of a separate mask input
+        y_eff = (Y * valid).astype(jnp.int32)
+
     def body(st: _OuterState) -> _OuterState:
         alpha, f = st.alpha, st.f
         m_h = i_high_mask(alpha, Y, C, eps, valid)
@@ -671,6 +855,20 @@ def _blocked_smo_solve_jit(
         b_high = jnp.where(found, jnp.min(jnp.where(m_h, f, jnp.inf)), st.b_high)
         b_low = jnp.where(found, jnp.max(jnp.where(m_l, f, -jnp.inf)), st.b_low)
         converged = found & (b_low <= b_high + 2.0 * tau)
+        if shrink_stable:
+            # per-row shrink stability: at-bound AND unable to join a
+            # violating pair at this round's band. Written, never read,
+            # by the solve (the shrinking driver consumes the counters
+            # between segments), so the trajectory is bit-identical with
+            # tracking on or off.
+            at_bound = (alpha <= eps) | (alpha >= C - eps)
+            unsafe = (m_h & (f < b_low - 2.0 * tau)) \
+                | (m_l & (f > b_high + 2.0 * tau))
+            keep = at_bound & ~unsafe & valid
+            stable = jnp.where(
+                found, jnp.where(keep, st.stable + 1, 0), st.stable)
+        else:
+            stable = st.stable
         # refine mode: a convergence claim on an accumulated (drifted) f is
         # not an exit while the reconstruction budget lasts — it triggers a
         # from-scratch rebuild of f, and the claim must survive on the
@@ -689,35 +887,64 @@ def _blocked_smo_solve_jit(
         proceed = found & ~converged
 
         def do_round(args):
-            alpha, f = args
+            (alpha, f, cache, cache_keys, cache_age,
+             cand_up_val, cand_up_idx, cand_low_val, cand_low_idx) = args
             # --- working-set selection: q distinct indices ----------------
-            key_up = jnp.where(m_h, f, jnp.inf).astype(jnp.float32)
-            if selection == "approx":
-                _, idx_up = lax.approx_min_k(key_up, half)
+            if pallas_fused_selection:
+                # consume the candidate lists the PREVIOUS round's fused
+                # f-update epilogue wrote (round 1 / resume: the
+                # bootstrap lists) — no mask+top_k pass over n here, only
+                # a top-k over the ncand-sized candidate pool. Filler
+                # lanes carry +/-inf values and possibly out-of-range or
+                # duplicate indices: clamp here, dedup below.
+                _, sel_up = lax.top_k(-cand_up_val, half)
+                idx_up = jnp.minimum(cand_up_idx[sel_up], n - 1)
+                in_up = jnp.zeros((n,), bool).at[idx_up].set(m_h[idx_up])
+                low_safe = jnp.minimum(cand_low_idx, n - 1)
+                low_key = jnp.where(in_up[low_safe], -jnp.inf,
+                                    cand_low_val)
+                _, sel_lo = lax.top_k(low_key, half)
+                idx_low = low_safe[sel_lo]
             else:
-                _, idx_up = lax.top_k(-key_up, half)  # q/2 smallest f in I_high
-            # only genuine I_high members count as taken: when |I_high| < q/2
-            # top_k pads idx_up with arbitrary non-members, and excluding
-            # those from the I_low pick could hide real violators
-            in_up = jnp.zeros((n,), bool).at[idx_up].set(m_h[idx_up])
-            key_low = jnp.where(m_l & ~in_up, f, -jnp.inf).astype(jnp.float32)
-            if selection == "approx":
-                _, idx_low = lax.approx_max_k(key_low, half)
-            else:
-                _, idx_low = lax.top_k(key_low, half)  # q/2 largest f in I_low
+                key_up = jnp.where(m_h, f, jnp.inf).astype(jnp.float32)
+                if selection == "approx":
+                    _, idx_up = lax.approx_min_k(key_up, half)
+                else:
+                    _, idx_up = lax.top_k(-key_up, half)  # q/2 smallest f in I_high
+                # only genuine I_high members count as taken: when |I_high| < q/2
+                # top_k pads idx_up with arbitrary non-members, and excluding
+                # those from the I_low pick could hide real violators
+                in_up = jnp.zeros((n,), bool).at[idx_up].set(m_h[idx_up])
+                key_low = jnp.where(m_l & ~in_up, f, -jnp.inf).astype(jnp.float32)
+                if selection == "approx":
+                    _, idx_low = lax.approx_max_k(key_low, half)
+                else:
+                    _, idx_low = lax.top_k(key_low, half)  # q/2 largest f in I_low
             B = jnp.concatenate([idx_up, idx_low]).astype(jnp.int32)
 
             # B can contain one sample twice (an idx_up filler re-picked by
             # idx_low); keep only the first occurrence active — two live
-            # copies of one dual variable would corrupt the f update. Each
-            # half's indices are distinct (top-k picks distinct positions),
-            # so duplicates are only cross-half and first-occurrence means
-            # the up-half copy wins: a (q/2)^2 membership test, not an
-            # (n,)-sized scatter-min (scatters lower poorly on TPU)
-            dup_low = (idx_low[:, None] == idx_up[None, :]).any(axis=1)
-            is_first = jnp.concatenate(
-                [jnp.ones((half,), bool), ~dup_low]
-            )
+            # copies of one dual variable would corrupt the f update.
+            if pallas_fused_selection:
+                # fused candidates are per-block top-k lists: beyond the
+                # cross-half case, one row can also appear twice WITHIN a
+                # half via clamped filler lanes, so first-occurrence is
+                # computed over the whole q (a q^2 membership test, the
+                # same idiom as the cross-half check below)
+                pos_q = jnp.arange(q, dtype=jnp.int32)
+                earlier = (B[:, None] == B[None, :]) \
+                    & (pos_q[None, :] < pos_q[:, None])
+                is_first = ~jnp.any(earlier, axis=1)
+            else:
+                # Each half's indices are distinct (top-k picks distinct
+                # positions), so duplicates are only cross-half and
+                # first-occurrence means the up-half copy wins: a (q/2)^2
+                # membership test, not an (n,)-sized scatter-min
+                # (scatters lower poorly on TPU)
+                dup_low = (idx_low[:, None] == idx_up[None, :]).any(axis=1)
+                is_first = jnp.concatenate(
+                    [jnp.ones((half,), bool), ~dup_low]
+                )
 
             X_B = X[B]
             y_B = Y[B]
@@ -781,6 +1008,87 @@ def _blocked_smo_solve_jit(
                 da_B = a_B_new - a_B
 
             dcoef = da_B * y_B.astype(adt)
+            zero_i = jnp.int32(0)
+            alpha_new = alpha.at[B].add(da_B)  # .add, not .set: inactive
+            # duplicate rows carry a zero delta, so double-indexed
+            # scatter stays correct
+            if pallas_fused_selection:
+                from tpusvm.ops.pallas.fused_fupdate import (
+                    fused_fupdate_select_pallas,
+                )
+
+                # the epilogue needs POST-round alphas (next round's masks)
+                # and the f32 face of f — selection keys were already f32
+                # in the two-pass path, and the stop decision stays on the
+                # exact adt f in the body above
+                (df32, cand_up_val, cand_up_idx, cand_low_val,
+                 cand_low_idx) = fused_fupdate_select_pallas(
+                    X, X_B, dcoef.astype(dtype), gamma, sn,
+                    f.astype(jnp.float32),
+                    alpha_new.astype(jnp.float32), y_eff, C, eps,
+                    k_cand=_kcand, block=_kblock,
+                    interpret=jax.default_backend() != "tpu",
+                )
+                return (alpha_new, f + df32.astype(adt),
+                        cache, cache_keys, cache_age, zero_i, zero_i,
+                        cand_up_val, cand_up_idx, cand_low_val,
+                        cand_low_idx, upd, progress, inner_reason)
+            if krow_cache:
+                # LRU K-row cache: a round needs a K-row only for members
+                # whose alpha actually MOVED (dcoef == 0 contributes
+                # nothing to df) — near convergence the inner solve
+                # touches a few repeat violators per round, so the needed
+                # set is small and hot. Rounds whose entire needed set is
+                # cached are served straight from HBM-resident rows (no X
+                # stream, no kernel evaluation); any needed miss streams
+                # X once through the K-row batch and re-inserts ALL q
+                # rows (hit rows recompute to the exact bytes the cache
+                # holds — K-rows are pure functions of X — so
+                # overwriting them is a no-op in value)
+                match = cache_keys[None, :] == B[:, None]  # (q, slots)
+                hit = jnp.any(match, axis=1)
+                moved = dcoef != 0.0
+                all_hit = jnp.all(hit | ~moved)
+                slot_of = jnp.argmax(match, axis=1)
+                dc32 = dcoef.astype(dtype)
+                # un-moved misses have slot_of pointing at an arbitrary
+                # slot; their dcoef is exactly 0, so the gathered row is
+                # multiplied away — zero the coef explicitly so that
+                # holds even if dtypes round
+                dc32_cached = jnp.where(hit, dc32, 0.0).astype(dc32.dtype)
+
+                def from_cache(cache, keys, age):
+                    rows = cache[slot_of]  # (q, n) gather, no X stream
+                    df = (rows.T @ dc32_cached).astype(adt)
+                    age = (age + 1).at[jnp.where(hit, slot_of, 0)].min(
+                        jnp.where(hit, 0, jnp.int32(2 ** 30)))
+                    return (df, cache, keys, age,
+                            jnp.int32(q), jnp.int32(0))
+
+                def from_fresh(cache, keys, age):
+                    rows = kernels.rows_at(
+                        kernel, X, B, gamma=gamma, coef0=coef0,
+                        degree=degree, sn=sn, precision=ops_precision,
+                    ).astype(jnp.float32)
+                    df = (rows.T @ dc32).astype(adt)
+                    # evict empty-first, then oldest: top_k picks q
+                    # DISTINCT slots, so the q-row insert cannot collide
+                    score = jnp.where(keys < 0, jnp.int32(2 ** 30), age)
+                    _, tgt = lax.top_k(score, q)
+                    cache = cache.at[tgt].set(rows)
+                    keys = keys.at[tgt].set(B)
+                    age = (age + 1).at[tgt].set(0)
+                    return (df, cache, keys, age,
+                            jnp.int32(0), jnp.int32(q))
+
+                df, cache, cache_keys, cache_age, d_hit, d_miss = lax.cond(
+                    all_hit, from_cache, from_fresh,
+                    cache, cache_keys, cache_age,
+                )
+                return (alpha_new, f + df, cache, cache_keys, cache_age,
+                        d_hit, d_miss, cand_up_val, cand_up_idx,
+                        cand_low_val, cand_low_idx, upd, progress,
+                        inner_reason)
             if fused_fupdate:
                 from tpusvm.ops.pallas.fused_fupdate import (
                     rbf_cross_matvec_pallas,
@@ -793,21 +1101,26 @@ def _blocked_smo_solve_jit(
             else:
                 df = kernels.cross_matvec(
                     kernel, X, X_B, dcoef, gamma=gamma, coef0=coef0,
-                    degree=degree, sn=sn, precision=matmul_precision,
+                    degree=degree, sn=sn, precision=ops_precision,
                     fast=kernel_fast,
                 ).astype(adt)
-            # .add, not .set: inactive duplicate rows carry a zero delta, so
-            # double-indexed scatter stays correct
-            return (alpha.at[B].add(da_B), f + df, upd, progress,
+            return (alpha_new, f + df, cache, cache_keys, cache_age,
+                    zero_i, zero_i, cand_up_val, cand_up_idx,
+                    cand_low_val, cand_low_idx, upd, progress,
                     inner_reason)
 
         def skip_round(args):
-            alpha, f = args
-            return (alpha, f, jnp.int32(0), jnp.array(False),
+            (alpha, f, cache, cache_keys, cache_age,
+             cand_up_val, cand_up_idx, cand_low_val, cand_low_idx) = args
+            zero_i = jnp.int32(0)
+            return (alpha, f, cache, cache_keys, cache_age, zero_i,
+                    zero_i, cand_up_val, cand_up_idx, cand_low_val,
+                    cand_low_idx, zero_i, jnp.array(False),
                     jnp.int32(Status.RUNNING))
 
         def do_refine(args):
-            alpha, f = args
+            (alpha, f, cache, cache_keys, cache_age,
+             cand_up_val, cand_up_idx, cand_low_val, cand_low_idx) = args
             coef = alpha * yf
             # largest-|coef| rows cover all nonzeros (needs_refine already
             # checked the live count fits refine_cap)
@@ -816,29 +1129,39 @@ def _blocked_smo_solve_jit(
                 kernel, X, X[idx], coef[idx].astype(dtype), gamma=gamma,
                 coef0=coef0, degree=degree, sn=sn, fast=kernel_fast,
             ).astype(adt) - z
-            return (alpha, jnp.where(valid, f_new, 0.0), jnp.int32(0),
+            zero_i = jnp.int32(0)
+            return (alpha, jnp.where(valid, f_new, 0.0), cache,
+                    cache_keys, cache_age, zero_i, zero_i, cand_up_val,
+                    cand_up_idx, cand_low_val, cand_low_idx, zero_i,
                     jnp.array(False), jnp.int32(Status.RUNNING))
 
         # terminal round (converged / no working set) skips the whole
         # selection + K_BB + inner solve + O(n*d*q) f-update machinery
+        operands = (alpha, f, st.cache, st.cache_keys, st.cache_age,
+                    st.cand_up_val, st.cand_up_idx, st.cand_low_val,
+                    st.cand_low_idx)
         if refine_cap:
-            alpha, f, upd, progress, inner_reason = lax.cond(
+            out = lax.cond(
                 needs_refine,
                 do_refine,
                 lambda args: lax.cond(proceed, do_round, skip_round, args),
-                (alpha, f),
+                operands,
             )
         else:
-            alpha, f, upd, progress, inner_reason = lax.cond(
-                proceed, do_round, skip_round, (alpha, f)
-            )
+            out = lax.cond(proceed, do_round, skip_round, operands)
+        (alpha, f, cache, cache_keys, cache_age, d_hit, d_miss,
+         cand_up_val, cand_up_idx, cand_low_val, cand_low_idx,
+         upd, progress, inner_reason) = out
+        cache_hits = st.cache_hits + d_hit
+        cache_misses = st.cache_misses + d_miss
         f_exact = needs_refine | (st.f_exact & ~proceed)
         n_refines = st.n_refines + needs_refine.astype(jnp.int32)
 
         n_outer = st.n_outer + jnp.where(proceed, 1, 0).astype(jnp.int32)
         n_updates = st.n_updates + upd
-        tele_gap, tele_upd, tele_status, tele_i = (
-            st.tele_gap, st.tele_upd, st.tele_status, st.tele_i)
+        tele_gap, tele_upd, tele_status, tele_i, tele_active = (
+            st.tele_gap, st.tele_upd, st.tele_status, st.tele_i,
+            st.tele_active)
         # zero progress: surface the inner numerical bail-out that caused it
         # (same statuses as smo_solve on the same degenerate data), generic
         # STALLED otherwise
@@ -883,11 +1206,30 @@ def _blocked_smo_solve_jit(
             tele_gap = tele_gap.at[t_idx].set(gap)
             tele_upd = tele_upd.at[t_idx].set(upd)
             tele_status = tele_status.at[t_idx].set(status)
+            # active-set size: rows the shrinking heuristic would keep
+            # live right now (all valid rows when tracking is off) — the
+            # per-round shrink trajectory `tpusvm report` renders
+            if shrink_stable:
+                n_live = jnp.sum(valid & (stable < shrink_stable))
+            else:
+                n_live = jnp.sum(valid)
+            tele_active = tele_active.at[t_idx].set(
+                n_live.astype(jnp.int32))
             tele_i = tele_i + 1
         return _OuterState(alpha, f, b_high, b_low, n_updates, n_outer,
                            status, f_exact, n_refines,
-                           tele_gap, tele_upd, tele_status, tele_i)
+                           tele_gap, tele_upd, tele_status, tele_i,
+                           tele_active, stable, cache, cache_keys,
+                           cache_age, cache_hits, cache_misses,
+                           cand_up_val, cand_up_idx, cand_low_val,
+                           cand_low_idx)
 
+    if pallas_fused_selection:
+        cuv0, cui0, clv0, cli0 = bootstrap_candidates(
+            f0, alpha0, Y, valid, C, eps, _ncand)
+    else:
+        cuv0 = clv0 = jnp.zeros((0,), jnp.float32)
+        cui0 = cli0 = jnp.zeros((0,), jnp.int32)
     init = _OuterState(
         alpha=alpha0,
         f=f0,
@@ -906,6 +1248,17 @@ def _blocked_smo_solve_jit(
         tele_upd=jnp.zeros((telemetry,), jnp.int32),
         tele_status=jnp.zeros((telemetry,), jnp.int32),
         tele_i=jnp.int32(0),
+        tele_active=jnp.zeros((telemetry,), jnp.int32),
+        stable=jnp.zeros((n if shrink_stable else 0,), jnp.int32),
+        cache=jnp.zeros((krow_cache, n), jnp.float32),
+        cache_keys=jnp.full((krow_cache,), -1, jnp.int32),
+        cache_age=jnp.zeros((krow_cache,), jnp.int32),
+        cache_hits=jnp.int32(0),
+        cache_misses=jnp.int32(0),
+        cand_up_val=cuv0,
+        cand_up_idx=cui0,
+        cand_low_val=clv0,
+        cand_low_idx=cli0,
     )
     if resume_state is not None:
         if resume_state.tele_gap.shape[0] != telemetry:
@@ -940,7 +1293,10 @@ def _blocked_smo_solve_jit(
         telemetry=(ConvergenceTelemetry(
             gap=final.tele_gap, n_upd=final.tele_upd,
             status=final.tele_status, count=final.tele_i,
+            active=final.tele_active,
         ) if telemetry else None),
+        cache_hits=(final.cache_hits if krow_cache else None),
+        cache_misses=(final.cache_misses if krow_cache else None),
     )
     if return_state:
         return result, final

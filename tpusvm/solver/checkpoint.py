@@ -41,14 +41,21 @@ from tpusvm.solver.blocked import _OuterState, blocked_smo_solve
 from tpusvm.solver.smo import SMOResult
 from tpusvm.status import Status
 
-SOLVER_CKPT_VERSION = 1
+# v2 (round 9): the carry gained the shrink-stability counters, the
+# K-row cache (rows/keys/ages/hit counters) and the fused-selection
+# candidate ring — all snapshotted like every other field, so resumed
+# solves stay bit-identical. v1 files predate those fields and cannot
+# resume into this build (the carry would be incomplete); the version
+# gate names that instead of a KeyError.
+SOLVER_CKPT_VERSION = 2
 
 #: static config the fingerprint pins (a resumed solve with any of these
 #: changed would silently walk a different trajectory)
 _FP_KEYS = ("C", "gamma", "eps", "tau", "max_iter", "q", "max_outer",
             "max_inner", "wss", "inner", "refine", "max_refines",
             "selection", "matmul_precision", "kernel", "degree", "coef0",
-            "kernel_fast", "telemetry")
+            "kernel_fast", "telemetry", "shrink_stable", "krow_cache",
+            "pallas_fused_selection")
 
 _STATE_FIELDS = _OuterState._fields
 
@@ -109,7 +116,11 @@ def load_solver_state(path: str, fingerprint: dict) -> _OuterState:
         if v != SOLVER_CKPT_VERSION:
             raise ValueError(
                 f"unsupported solver checkpoint version {v} (this build "
-                f"reads version {SOLVER_CKPT_VERSION})"
+                f"reads version {SOLVER_CKPT_VERSION}"
+                + (": v1 carries predate the round-9 shrink/cache/"
+                   "candidate fields — restart the solve fresh"
+                   if v == 1 else "")
+                + ")"
             )
         saved = json.loads(str(z["fingerprint"]))
         want = json.loads(json.dumps(fingerprint, sort_keys=True))

@@ -80,6 +80,10 @@ class SMOResult(NamedTuple):
     # telemetry is off — the default, so the pair solver and every
     # existing caller see an unchanged result surface
     telemetry: Optional[Any] = None
+    # blocked solver only, krow_cache=slots > 0: rows served from the
+    # K-row LRU cache vs computed fresh (int32 scalars; None when off)
+    cache_hits: Optional[jax.Array] = None
+    cache_misses: Optional[jax.Array] = None
 
 
 def _body(state: SMOState, X, Y, valid, sn, C, gamma, eps, tau, max_iter,
