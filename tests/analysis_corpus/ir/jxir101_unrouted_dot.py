@@ -1,0 +1,28 @@
+"""JXIR101 corpus — a contraction that never went through the precision
+resolver: `K @ coef` emits a dot_general with precision=None, which on
+TPU MXUs means raw single-pass bf16 passes over f32 operands (the exact
+footgun config.resolve_matmul_precision closes at the ops layer)."""
+
+import jax
+import jax.numpy as jnp
+
+from tpusvm.analysis.ir.entrypoints import IREntryPoint
+
+RULE = "JXIR101"
+
+
+def _build():
+    def f_update(K, coef):
+        # BAD: raw matmul — no precision routed to the IR
+        return K @ coef
+
+    s = jax.ShapeDtypeStruct
+    return f_update, (s((1024, 256), jnp.float32),
+                      s((256, 128), jnp.float32)), {}
+
+
+ENTRY = IREntryPoint(
+    name="corpus.jxir101_unrouted_dot",
+    build=_build,
+    description="raw K @ coef contraction, precision unrouted",
+)

@@ -1,0 +1,28 @@
+"""JXIR102 corpus — a Python-scalar-derived ARRAY in the traced graph:
+broadcasting the weak hyperparameter scalar materialises a weak-typed
+(128, 128) aval whose dtype follows promotion accidents instead of a
+declared dtype (and whose weakness would force jax's fixpoint re-trace
+if it reached a loop carry)."""
+
+import jax
+import jax.numpy as jnp
+
+from tpusvm.analysis.ir.entrypoints import IREntryPoint
+
+RULE = "JXIR102"
+
+
+def _build(c=2.0):
+    def shift(x):
+        # BAD: weak scalar broadcast into a weak-typed array aval
+        bias = jnp.broadcast_to(c, (128, 128))
+        return x + bias
+
+    return shift, (jax.ShapeDtypeStruct((128, 128), jnp.float32),), {}
+
+
+ENTRY = IREntryPoint(
+    name="corpus.jxir102_weak_promotion",
+    build=_build,
+    description="weak Python scalar broadcast to a weak-typed array",
+)

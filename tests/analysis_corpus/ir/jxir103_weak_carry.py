@@ -1,0 +1,36 @@
+"""JXIR103 corpus — a while-loop carry initialised from a bare Python
+int: the counter slot enters the loop as a WEAK int32 aval, so jax must
+run its weak-type fixpoint re-trace and the carry dtype is decided by
+promotion, not by the code — exactly what shrink-compaction /
+checkpoint-resume re-entry (which rebuilds carries from saved avals)
+cannot tolerate."""
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from tpusvm.analysis.ir.entrypoints import IREntryPoint
+
+RULE = "JXIR103"
+
+
+def _build():
+    def count_rounds(x):
+        def cond(c):
+            return c[0] < 8
+
+        def body(c):
+            i, s = c
+            return i + 1, s * 0.5 + 1.0
+
+        # BAD: carry slot 0 starts as Python int 0 -> weak int32
+        return lax.while_loop(cond, body, (0, jnp.float32(0.0)))
+
+    return count_rounds, (jax.ShapeDtypeStruct((8,), jnp.float32),), {}
+
+
+ENTRY = IREntryPoint(
+    name="corpus.jxir103_weak_carry",
+    build=_build,
+    description="while carry seeded from a bare Python int",
+)

@@ -1,0 +1,30 @@
+"""JXIR104 corpus — a contraction whose CONTRACTED dimension (130) sits
+off the TPU tile grid: the compiler pads every 130-wide operand tile to
+the next lane/sublane multiple and the padding cost is paid on every
+output tile of the contraction loop. Precision is explicitly routed so
+only the alignment rule fires (single-hazard corpus discipline)."""
+
+import jax
+import jax.numpy as jnp
+
+from tpusvm.analysis.ir.entrypoints import IREntryPoint
+
+RULE = "JXIR104"
+
+
+def _build():
+    def gram(xa, xb):
+        # BAD: d=130 contracting dim — not a multiple of 128 (lane) on
+        # the lhs nor of 8 (sublane) on the rhs
+        return jnp.matmul(xa, xb, precision="highest")
+
+    s = jax.ShapeDtypeStruct
+    return gram, (s((256, 130), jnp.float32),
+                  s((130, 256), jnp.float32)), {}
+
+
+ENTRY = IREntryPoint(
+    name="corpus.jxir104_misaligned_tile",
+    build=_build,
+    description="contracting dim 130 off the (8, 128) tile grid",
+)

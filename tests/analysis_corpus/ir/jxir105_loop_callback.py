@@ -1,0 +1,42 @@
+"""JXIR105 corpus — a host callback reachable from a compiled loop body
+at IR level: the debug print hides inside a helper function, so JX009's
+AST walker (which inspects the combinator body's own nodes) has nothing
+to flag — but the traced while body carries a debug_callback equation
+all the same: one device->host round trip per iteration."""
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from tpusvm.analysis.ir.entrypoints import IREntryPoint
+
+RULE = "JXIR105"
+
+
+def _log_gap(gap):
+    # the indirection that blinds the AST rule
+    jax.debug.print("gap={g}", g=gap)
+    return gap
+
+
+def _build():
+    def solve(f):
+        def cond(c):
+            return c[0] < jnp.int32(8)
+
+        def body(c):
+            i, s = c
+            # BAD (semantically): helper inserts a per-iteration callback
+            return i + jnp.int32(1), s + _log_gap(jnp.max(f))
+
+        return lax.while_loop(
+            cond, body, (jnp.int32(0), jnp.float32(0.0)))
+
+    return solve, (jax.ShapeDtypeStruct((128,), jnp.float32),), {}
+
+
+ENTRY = IREntryPoint(
+    name="corpus.jxir105_loop_callback",
+    build=_build,
+    description="host callback smuggled into a while body via a helper",
+)

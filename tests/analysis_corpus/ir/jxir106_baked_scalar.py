@@ -1,0 +1,29 @@
+"""JXIR106 corpus — a hyperparameter baked into the trace: the builder
+captures C in a closure instead of passing it as a traced argument, so
+its concrete value lands in the jaxpr as a literal and every sweep
+value compiles a fresh executable (the weak-scalar recompile hazard
+jit's cache-by-weak-type rule exists to prevent)."""
+
+import jax
+import jax.numpy as jnp
+
+from tpusvm.analysis.ir.entrypoints import IREntryPoint
+
+RULE = "JXIR106"
+
+
+def _build(C=10.0):
+    def clip_box(alpha):
+        # BAD: C is a Python closure constant, not a traced argument —
+        # its VALUE is a literal in the jaxpr
+        return jnp.clip(alpha, 0.0, C)
+
+    return clip_box, (jax.ShapeDtypeStruct((1024,), jnp.float32),), {}
+
+
+ENTRY = IREntryPoint(
+    name="corpus.jxir106_baked_scalar",
+    build=_build,
+    sweep={"C": (10.0, 3.0)},
+    description="hyperparameter closure-baked into the trace",
+)

@@ -7,4 +7,4 @@ PROJECTION = np.random.randn(1024, 1024)
 
 @jax.jit
 def project(x):
-    return x @ PROJECTION  # JX005: constant-folded into the jaxpr
+    return x * PROJECTION  # JX005: constant-folded into the jaxpr

@@ -1,0 +1,18 @@
+# JX010: a raw contraction outside tpusvm/ops and tpusvm/kernels — the
+# `@` emits a dot_general with jax's DEFAULT precision (raw single-pass
+# bf16 on TPU MXUs) because no precision resolver ever saw it. The jnp
+# call form is equally unrouted. Both must route through the kernel
+# dispatch / ops.rbf.matmul_p.
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def f_update(f, K, coef):
+    df = K @ coef
+    return f + df
+
+
+@jax.jit
+def scores(K, coef, b):
+    return jnp.dot(K, coef) - b

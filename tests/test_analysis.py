@@ -21,7 +21,7 @@ from tpusvm.analysis import all_rules, lint_file, lint_paths, lint_source
 REPO = Path(__file__).resolve().parent.parent
 CORPUS = REPO / "tests" / "analysis_corpus"
 RULE_IDS = ("JX001", "JX002", "JX003", "JX004", "JX005", "JX006",
-            "JX007", "JX008", "JX009")
+            "JX007", "JX008", "JX009", "JX010")
 
 
 # ---------------------------------------------------------------- registry
@@ -317,3 +317,54 @@ def test_midscale_effective_cfg_does_not_mutate_module_config():
     assert cfg.max_iter == 123
     assert CFG.max_iter == before  # the module global is untouched
     assert effective_cfg(None) is CFG
+
+
+# ------------------------------------------------------- CI sweep coverage
+def test_ci_lint_sweep_covers_all_roots():
+    """The CI lint step must sweep every Python root the repo grows code
+    in — tpusvm/, benchmarks/ and scripts/ (plus the bench.py harness).
+    A root missing from the workflow would let hazards land unlinted; a
+    legacy finding in a newly-added root belongs in the fingerprinted
+    baseline, never in a narrower sweep."""
+    ci = (REPO / ".github" / "workflows" / "ci.yml").read_text(
+        encoding="utf-8")
+    lint_lines = [ln for ln in ci.splitlines()
+                  if "python -m tpusvm.analysis" in ln
+                  and "ir-audit" not in ln]
+    assert lint_lines, "CI has no tpusvm-lint invocation"
+    sweep = " ".join(lint_lines)
+    for root in ("tpusvm/", "benchmarks/", "scripts/", "bench.py"):
+        assert root in sweep, (
+            f"CI lint sweep is missing the {root} root: {sweep!r}")
+
+
+def test_ci_self_corpus_expects_every_rule():
+    """The CI self-corpus step's expected-rule set must track the
+    registry — a rule added without a corpus case (or a corpus case the
+    CI never asserts on) silently weakens the gate."""
+    ci = (REPO / ".github" / "workflows" / "ci.yml").read_text(
+        encoding="utf-8")
+    # the step derives its expected set from the registry, so it cannot
+    # lag RULE_IDS; this pins that derivation (and the corpus walk)
+    assert "set(all_rules()) - fired" in ci
+    assert 'glob("tests/analysis_corpus/*.py")' in ci
+    # ... and the in-process registry actually covers RULE_IDS
+    assert tuple(sorted(all_rules())) == RULE_IDS
+
+
+def test_jx010_scope_exempts_contraction_homes():
+    from tpusvm.analysis.lint import lint_source
+
+    src = ("import jax\nimport jax.numpy as jnp\n"
+           "@jax.jit\ndef f(a, b):\n    return a @ b\n")
+    # same source: flagged outside the home modules, exempt inside
+    active, _ = lint_source(src, "tpusvm/solver/somefile.py",
+                            select={"JX010"})
+    assert {f.rule for f in active} == {"JX010"}
+    for home in ("tpusvm/ops/x.py", "tpusvm/kernels/x.py"):
+        active, _ = lint_source(src, home, select={"JX010"})
+        assert active == []
+    # host-side NumPy `@` (no tracing context) is not flagged
+    host = "import numpy as np\ndef f(a, b):\n    return a @ b\n"
+    active, _ = lint_source(host, "tpusvm/oracle/x.py", select={"JX010"})
+    assert active == []

@@ -1,0 +1,294 @@
+"""Tests for tpusvm.analysis.ir — the jaxpr-level semantic auditor.
+
+Contracts:
+  * the registry traces at least 8 real entry points on CPU, and the
+    repo's own traces audit CLEAN against the EMPTY committed baseline;
+  * every JXIR rule fires on its known-bad corpus entry
+    (tests/analysis_corpus/ir/) and ONLY that rule fires there;
+  * a deliberately unrouted dot_general fails the gate (the regression
+    fixture the acceptance criterion names);
+  * the committed audit artifact (benchmarks/results/ir_audit_cpu.json)
+    matches the schema and carries zero findings;
+  * two audit runs produce byte-identical artifacts (determinism);
+  * the baseline mechanism grandfathers findings exactly like the AST
+    linter's.
+
+The full audit traces every entry point once (~3 s on CPU); it runs
+once per module via a session fixture and every structural test reads
+from it.
+"""
+
+import importlib.util
+import json
+from pathlib import Path
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from tpusvm.analysis.ir.audit import (
+    AUDIT_SCHEMA_VERSION,
+    render_audit_json,
+    run_ir_audit,
+)
+from tpusvm.analysis.ir.entrypoints import IREntryPoint, default_entrypoints
+from tpusvm.analysis.ir.rules import IR_RULE_SUMMARIES, all_ir_rules
+
+REPO = Path(__file__).resolve().parent.parent
+IR_CORPUS = REPO / "tests" / "analysis_corpus" / "ir"
+ARTIFACT = REPO / "benchmarks" / "results" / "ir_audit_cpu.json"
+
+JXIR_IDS = ("JXIR101", "JXIR102", "JXIR103", "JXIR104", "JXIR105",
+            "JXIR106")
+
+
+@pytest.fixture(scope="module")
+def full_audit():
+    return run_ir_audit()
+
+
+def _load_corpus(path: Path):
+    spec = importlib.util.spec_from_file_location(
+        f"ir_corpus_{path.stem}", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+# ---------------------------------------------------------------- registry
+def test_all_six_rules_registered():
+    rules = all_ir_rules()
+    assert tuple(sorted(rules)) == JXIR_IDS
+    assert tuple(sorted(IR_RULE_SUMMARIES)) == JXIR_IDS
+    for rid, rule in rules.items():
+        assert rule.id == rid and rule.summary
+
+
+def test_rule_summaries_importable_without_tracing():
+    # the lint CI job lists IR rules with no accelerator deps; the
+    # summaries path must not pull jax in at import time
+    import subprocess
+    import sys
+
+    code = ("import sys; sys.modules['jax'] = None; "
+            "from tpusvm.analysis.ir.rules import IR_RULE_SUMMARIES; "
+            "assert len(IR_RULE_SUMMARIES) == 6")
+    res = subprocess.run([sys.executable, "-c", code], cwd=str(REPO),
+                         capture_output=True, text=True)
+    assert res.returncode == 0, res.stderr
+
+
+def test_entrypoint_names_unique_and_required_present():
+    names = [e.name for e in default_entrypoints()]
+    assert len(names) == len(set(names))
+    required = {
+        "solver.blocked_smo_solve", "solver.smo_solve",
+        "solver.shrink_segment", "predict.decision_function",
+        "predict.decision_function_flat", "predict.ovr_scores",
+        "serve.bucket[binary]", "serve.bucket[ovr]",
+        "kernels.cross_matvec[rbf]", "kernels.cross_matvec[linear]",
+        "kernels.cross_matvec[poly]", "cascade.round_fn",
+    }
+    assert required <= set(names), sorted(required - set(names))
+
+
+# ------------------------------------------------------------ repo audit
+def test_repo_audits_clean_with_at_least_eight_entries(full_audit):
+    assert full_audit.traced_count >= 8, [
+        (e.name, e.skip_reason) for e in full_audit.entries]
+    assert full_audit.findings == [], "\n".join(
+        f.render() + " :: " + f.message for f in full_audit.findings)
+    # skipped entries must say why
+    for e in full_audit.entries:
+        if not e.traced:
+            assert e.skip_reason
+
+
+def test_pallas_body_is_walked(full_audit):
+    fused = {e.name: e for e in full_audit.entries}[
+        "solver.blocked_smo_solve[fused]"]
+    if not fused.traced:  # pragma: no cover — env without pallas
+        pytest.skip(fused.skip_reason)
+    assert fused.stats["pallas_calls"] >= 1
+
+
+def test_swept_entries_declare_scalars(full_audit):
+    swept = [e for e in full_audit.entries if e.swept]
+    assert len(swept) >= 5  # solvers + kernel dispatch sweeps
+    assert any("C" in e.swept for e in swept)
+
+
+# ------------------------------------------------------------- IR corpus
+@pytest.mark.parametrize("rule_id", JXIR_IDS)
+def test_rule_fires_on_its_ir_corpus_entry(rule_id):
+    matches = sorted(IR_CORPUS.glob(f"{rule_id.lower()}_*.py"))
+    assert matches, f"no IR corpus file for {rule_id}"
+    mod = _load_corpus(matches[0])
+    assert mod.RULE == rule_id
+    res = run_ir_audit(entries=[mod.ENTRY])
+    fired = {f.rule for f in res.findings}
+    assert rule_id in fired, f"{rule_id} did not fire; got {fired}"
+    # single-hazard corpus discipline, like the AST corpus
+    assert fired == {rule_id}, (
+        f"extra rules fired on {matches[0].name}: {fired - {rule_id}}")
+    assert res.exit_code == 1
+
+
+def test_every_registered_rule_has_a_corpus_entry():
+    for rid in all_ir_rules():
+        assert sorted(IR_CORPUS.glob(f"{rid.lower()}_*.py")), (
+            f"rule {rid} has no tests/analysis_corpus/ir/ case")
+
+
+# ------------------------------------------- the unrouted-dot regression
+def test_gate_fails_on_deliberately_unrouted_dot_general():
+    """The acceptance fixture: introduce an entry whose contraction
+    skips the precision resolver and the audit must fail."""
+
+    def build():
+        def f_update(K, coef):
+            return K @ coef  # unrouted on purpose
+
+        s = jax.ShapeDtypeStruct
+        return f_update, (s((1024, 256), jnp.float32),
+                          s((256,), jnp.float32)), {}
+
+    bad = IREntryPoint(name="regression.unrouted_dot", build=build)
+    res = run_ir_audit(entries=default_entrypoints() + [bad])
+    assert res.exit_code == 1
+    hits = [f for f in res.findings if f.rule == "JXIR101"]
+    assert hits and all(
+        f.path == "jaxpr://regression.unrouted_dot" for f in hits)
+
+
+def test_bf16_pattern_rejected_outside_bf16_rung():
+    def build():
+        def f(a, b):
+            return jnp.matmul(a.astype(jnp.bfloat16),
+                              b.astype(jnp.bfloat16),
+                              preferred_element_type=jnp.float32)
+
+        s = jax.ShapeDtypeStruct
+        return f, (s((128, 128), jnp.float32),
+                   s((128, 128), jnp.float32)), {}
+
+    # same trace: a finding on the f32 rung, clean on the bf16 rung
+    bad = IREntryPoint(name="t.bf16_on_f32_rung", build=build)
+    res = run_ir_audit(entries=[bad])
+    assert {f.rule for f in res.findings} == {"JXIR101"}
+    ok = IREntryPoint(name="t.bf16_on_bf16_rung", build=build,
+                      precision="bf16_f32")
+    assert run_ir_audit(entries=[ok]).findings == []
+
+
+# ---------------------------------------------------- committed artifact
+def test_committed_artifact_schema():
+    doc = json.loads(ARTIFACT.read_text(encoding="utf-8"))
+    assert doc["version"] == AUDIT_SCHEMA_VERSION
+    assert doc["tool"] == "tpusvm.analysis.ir"
+    assert tuple(sorted(doc["rules"])) == JXIR_IDS
+    assert doc["findings"] == []          # the empty-baseline contract
+    assert doc["counts"] == {}
+    assert doc["traced_entry_points"] >= 8
+    names = [e["name"] for e in doc["entry_points"]]
+    assert "solver.blocked_smo_solve" in names
+    assert "kernels.cross_matvec[poly]" in names
+    for e in doc["entry_points"]:
+        assert set(e) == {"name", "description", "precision", "traced",
+                          "skip_reason", "swept_scalars", "stats"}
+        if e["traced"]:
+            assert e["stats"]["eqns"] > 0
+        else:
+            assert e["skip_reason"]
+    for f in doc["findings"]:  # schema of findings, if any ever land
+        assert set(f) == {"rule", "path", "line", "col", "message",
+                          "snippet", "fingerprint"}
+
+
+def test_committed_artifact_matches_current_registry():
+    doc = json.loads(ARTIFACT.read_text(encoding="utf-8"))
+    committed = {e["name"] for e in doc["entry_points"]}
+    current = {e.name for e in default_entrypoints()}
+    assert committed == current, (
+        "registry drifted from the committed artifact — regenerate with "
+        "`python -m tpusvm.analysis ir-audit --json-out "
+        "benchmarks/results/ir_audit_cpu.json`")
+
+
+def test_committed_baseline_is_empty():
+    from tpusvm.analysis.baseline import load_baseline
+
+    assert load_baseline(REPO / ".tpusvm-ir-baseline.json") == set()
+
+
+# ------------------------------------------------------------ determinism
+def test_audit_is_deterministic(full_audit):
+    again = run_ir_audit()
+    assert render_audit_json(full_audit) == render_audit_json(again)
+
+
+# --------------------------------------------------------------- baseline
+def test_baseline_grandfathers_ir_findings(tmp_path):
+    from tpusvm.analysis.baseline import load_baseline, write_baseline
+
+    mod = _load_corpus(next(iter(
+        sorted(IR_CORPUS.glob("jxir101_*.py")))))
+    res = run_ir_audit(entries=[mod.ENTRY])
+    assert res.findings
+    bl = tmp_path / "ir-baseline.json"
+    write_baseline(bl, res.findings)
+    res2 = run_ir_audit(entries=[mod.ENTRY], baseline=load_baseline(bl))
+    assert res2.findings == []
+    assert len(res2.baselined) == len(res.findings)
+    assert res2.exit_code == 0
+
+
+def test_fingerprints_stable_across_runs():
+    mod = _load_corpus(next(iter(
+        sorted(IR_CORPUS.glob("jxir104_*.py")))))
+    f1 = run_ir_audit(entries=[mod.ENTRY]).findings
+    f2 = run_ir_audit(entries=[mod.ENTRY]).findings
+    assert [f.fingerprint for f in f1] == [f.fingerprint for f in f2]
+    assert all(len(f.fingerprint) == 12 for f in f1)
+
+
+# -------------------------------------------------------------------- CLI
+def test_cli_smoke_ok(capsys):
+    from tpusvm.analysis.ir.cli import main
+
+    rc = main(["--smoke", "--baseline",
+               str(REPO / ".tpusvm-ir-baseline.json")])
+    out = capsys.readouterr().out
+    assert rc == 0, out
+    assert "--smoke ok" in out
+
+
+def test_cli_dispatch_from_analysis_cli(capsys):
+    from tpusvm.analysis.cli import main
+
+    rc = main(["ir-audit", "--list-rules"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    for rid in JXIR_IDS:
+        assert rid in out
+
+
+def test_cli_list_entries(capsys):
+    from tpusvm.analysis.ir.cli import main
+
+    assert main(["--list-entries"]) == 0
+    out = capsys.readouterr().out
+    assert "solver.blocked_smo_solve" in out
+    assert "cascade.round_fn" in out
+
+
+def test_cli_unknown_rule_is_usage_error(capsys):
+    from tpusvm.analysis.ir.cli import main
+
+    assert main(["--select", "JXIR999"]) == 2
+
+
+def test_cli_unknown_entry_is_usage_error(capsys):
+    from tpusvm.analysis.ir.cli import main
+
+    assert main(["--entry", "no.such.entry"]) == 2

@@ -20,9 +20,21 @@ Rules (see README "Static analysis" for the full contract):
   JX007  leftover jax.debug.print/breakpoint() on kernel paths
   JX008  pallas_* flag combinations the resolved solver config ignores
          (driven by tpusvm.config.PALLAS_FLAG_RULES)
+  JX009  host callbacks / tracer materialisation inside lax loop bodies
+  JX010  raw @ / jnp.dot / jnp.einsum / lax.dot_general outside
+         tpusvm/ops and tpusvm/kernels (contraction precision never
+         resolved)
 
 The package imports no JAX: it is stdlib `ast` over source text, so the
 CI lint gate runs without accelerator dependencies.
+
+`python -m tpusvm.analysis ir-audit` runs the jaxpr-level semantic
+auditor (tpusvm.analysis.ir, rules JXIR101-106): it traces the repo's
+real jit entry points and machine-checks precision routing, dtype
+provenance, loop-carry stability, TPU tile alignment, loop-body host
+callbacks, and weak-scalar recompile hazards at the IR the compiler
+actually solves. That subcommand DOES need jax; everything else here
+stays accelerator-free.
 """
 
 from tpusvm.analysis.core import Finding  # noqa: F401

@@ -3,6 +3,10 @@
 Exit codes: 0 = clean (modulo baseline), 1 = findings, 2 = usage error.
 The linter itself imports no JAX — it is pure stdlib `ast` over source
 text — so the CI lint job runs without accelerator deps installed.
+
+`python -m tpusvm.analysis ir-audit [...]` dispatches to the jaxpr-level
+semantic auditor (tpusvm.analysis.ir — rules JXIR101-106), which DOES
+need jax and runs in the CI test job on JAX_PLATFORMS=cpu.
 """
 
 from __future__ import annotations
@@ -53,11 +57,27 @@ def build_parser() -> argparse.ArgumentParser:
 
 
 def main(argv=None) -> int:
+    if argv is None:
+        argv = sys.argv[1:]
+    if argv and argv[0] == "ir-audit":
+        # the jaxpr-level semantic auditor (rules JXIR101-106) — a
+        # separate CLI because it NEEDS jax, while this linter must
+        # stay importable/runnable without accelerator deps
+        from tpusvm.analysis.ir.cli import main as ir_main
+
+        return ir_main(argv[1:])
+
     args = build_parser().parse_args(argv)
 
     if args.list_rules:
         for rid, rule in all_rules().items():
             print(f"{rid}  {rule.summary}")
+        # the IR rules live in tpusvm.analysis.ir (run via the
+        # `ir-audit` subcommand); listing them here needs no jax
+        from tpusvm.analysis.ir.rules import IR_RULE_SUMMARIES
+
+        for rid, summary in sorted(IR_RULE_SUMMARIES.items()):
+            print(f"{rid}  {summary}  [ir-audit]")
         return 0
 
     select = _parse_rule_list(args.select) or None

@@ -1,0 +1,27 @@
+"""tpusvm.analysis.ir — jaxpr-level semantic auditor (rules JXIR1xx).
+
+The AST linter checks the *source text*; this subpackage checks the
+*solved problem*: it traces the repo's real jit entry points against a
+canonical registry of abstract signatures (entrypoints.py, fed by the
+compile observatory's JIT_ENTRY_POINTS registry), walks the closed
+jaxprs — while/scan/cond sub-jaxprs and pallas bodies included — and
+machine-checks precision routing (JXIR101), dtype/weak-type provenance
+(JXIR102), loop-carry stability (JXIR103), TPU tile alignment
+(JXIR104), loop-body host callbacks (JXIR105), and weak-scalar
+recompile hazards (JXIR106).
+
+Run it with `python -m tpusvm.analysis ir-audit` (needs jax; CI runs it
+on JAX_PLATFORMS=cpu). Findings share the AST linter's Finding type,
+reporters, and fingerprinted-baseline mechanism; the committed baseline
+(.tpusvm-ir-baseline.json) is EMPTY and the committed audit artifact
+lives at benchmarks/results/ir_audit_cpu.json.
+
+This __init__ stays import-light (no jax): the lint CI job imports
+`tpusvm.analysis.ir.rules.IR_RULE_SUMMARIES` to list the JXIR rules
+without accelerator deps; everything that traces lives behind function
+calls in audit/entrypoints/tracing.
+"""
+
+from tpusvm.analysis.ir.rules import IR_RULE_SUMMARIES  # noqa: F401
+
+__all__ = ["IR_RULE_SUMMARIES"]

@@ -1,0 +1,160 @@
+"""IR audit driver: trace every registered entry point, run the JXIR
+rules, apply the fingerprinted baseline, and render results.
+
+The result object mirrors analysis.lint.LintResult (findings /
+suppressed / baselined / files_scanned) so the existing text and JSON
+reporters render IR findings unchanged; `render_audit_json` additionally
+emits the committed machine-readable artifact
+(benchmarks/results/ir_audit_cpu.json): schema-versioned, byte-
+deterministic (sorted keys, no timestamps — two runs must produce
+identical bytes, tests/test_ir_audit.py::test_audit_is_deterministic).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Dict, List, Optional, Set, Tuple
+
+from tpusvm.analysis.core import Finding, fingerprint_findings
+from tpusvm.analysis.ir.rules import (
+    IR_RULE_SUMMARIES,
+    TraceAudit,
+    select_ir_rules,
+)
+from tpusvm.analysis.ir.tracing import SkipTrace, eqn_stats, trace_entry
+
+AUDIT_SCHEMA_VERSION = 1
+DEFAULT_IR_BASELINE_NAME = ".tpusvm-ir-baseline.json"
+
+
+@dataclasses.dataclass
+class EntryReport:
+    """Per-entry-point trace outcome for the audit artifact."""
+
+    name: str
+    description: str
+    precision: str
+    traced: bool
+    skip_reason: Optional[str] = None
+    swept: Tuple[str, ...] = ()
+    stats: Optional[dict] = None
+
+
+@dataclasses.dataclass
+class IRAuditResult:
+    findings: List[Finding]
+    suppressed: List[Finding]          # always [] — no source to annotate
+    baselined: List[Finding]
+    entries: List[EntryReport]
+
+    @property
+    def files_scanned(self) -> int:    # reporter compatibility: one
+        return self.traced_count       # "file" per traced entry point
+
+    @property
+    def traced_count(self) -> int:
+        return sum(1 for e in self.entries if e.traced)
+
+    @property
+    def exit_code(self) -> int:
+        return 1 if self.findings else 0
+
+
+def audit_entry(entry, rules) -> Tuple[List[Finding], EntryReport]:
+    """Trace one entry (twice when it declares a sweep) and run rules."""
+    report = EntryReport(name=entry.name, description=entry.description,
+                         precision=entry.precision, traced=False,
+                         swept=tuple(sorted(entry.sweep)))
+    try:
+        first = {k: v[0] for k, v in entry.sweep.items()}
+        fn, args, kwargs = entry.build(**first)
+        jaxpr = trace_entry(fn, args, kwargs)
+        alt_str = None
+        if entry.sweep:
+            second = {k: v[1] for k, v in entry.sweep.items()}
+            fn2, args2, kwargs2 = entry.build(**second)
+            alt_str = str(trace_entry(fn2, args2, kwargs2))
+    except SkipTrace as e:
+        report.skip_reason = str(e)
+        return [], report
+    report.traced = True
+    report.stats = eqn_stats(jaxpr)
+    audit = TraceAudit(entry=entry, jaxpr=jaxpr, jaxpr_str=str(jaxpr),
+                       jaxpr_alt_str=alt_str)
+    findings: List[Finding] = []
+    for rule in rules:
+        findings.extend(rule.check(audit))
+    return findings, report
+
+
+def run_ir_audit(entries=None, select: Optional[Set[str]] = None,
+                 ignore: Optional[Set[str]] = None,
+                 baseline: Optional[Set[Tuple[str, str, str]]] = None,
+                 entry_filter: Optional[Set[str]] = None) -> IRAuditResult:
+    """Audit `entries` (default: the full registry) under the rules.
+
+    `baseline` is the same (rule, path, fingerprint) key set the AST
+    linter grandfathers with (analysis/baseline.py); matching findings
+    are reported separately and do not fail the gate.
+    """
+    if entries is None:
+        from tpusvm.analysis.ir.entrypoints import default_entrypoints
+
+        entries = default_entrypoints()
+    if entry_filter:
+        known = {e.name for e in entries}
+        unknown = set(entry_filter) - known
+        if unknown:
+            raise ValueError(f"unknown entry point(s): {sorted(unknown)}; "
+                             f"known: {sorted(known)}")
+        entries = [e for e in entries if e.name in entry_filter]
+    rules = select_ir_rules(select, ignore)
+
+    all_findings: List[Finding] = []
+    reports: List[EntryReport] = []
+    for entry in entries:
+        findings, report = audit_entry(entry, rules)
+        all_findings.extend(findings)
+        reports.append(report)
+
+    all_findings.sort(key=lambda f: (f.path, f.rule, f.snippet))
+    all_findings = fingerprint_findings(all_findings)
+    active, baselined = [], []
+    for f in all_findings:
+        key = (f.rule, f.path, f.fingerprint)
+        if baseline and key in baseline:
+            baselined.append(f)
+        else:
+            active.append(f)
+    return IRAuditResult(findings=active, suppressed=[],
+                         baselined=baselined, entries=reports)
+
+
+def render_audit_json(result: IRAuditResult) -> str:
+    """The committed machine-readable audit artifact (schema v1)."""
+    from collections import Counter
+
+    counts = Counter(f.rule for f in result.findings)
+    doc: Dict = {
+        "version": AUDIT_SCHEMA_VERSION,
+        "tool": "tpusvm.analysis.ir",
+        "rules": dict(sorted(IR_RULE_SUMMARIES.items())),
+        "entry_points": [
+            {
+                "name": e.name,
+                "description": e.description,
+                "precision": e.precision,
+                "traced": e.traced,
+                "skip_reason": e.skip_reason,
+                "swept_scalars": list(e.swept),
+                "stats": e.stats,
+            }
+            for e in result.entries
+        ],
+        "traced_entry_points": result.traced_count,
+        "findings": [f.to_dict() for f in result.findings],
+        "counts": dict(sorted(counts.items())),
+        "baselined": len(result.baselined),
+    }
+    return json.dumps(doc, indent=2, sort_keys=False) + "\n"

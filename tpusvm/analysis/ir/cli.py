@@ -1,0 +1,151 @@
+"""`python -m tpusvm.analysis ir-audit` — the IR auditor's CLI.
+
+Unlike the AST linter (pure stdlib, no accelerator deps), the IR audit
+traces real jaxprs and therefore needs jax; CI runs it in the test job
+under JAX_PLATFORMS=cpu. Exit codes match the linter: 0 = clean (modulo
+baseline), 1 = findings, 2 = usage error.
+
+`--smoke` is the CI gate: full audit + structural assertions (at least
+`--min-entries` entry points actually traced, every JXIR rule
+registered) + the committed-baseline diff — the committed baseline is
+EMPTY, so any finding fails the build.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from tpusvm.analysis.baseline import load_baseline, write_baseline
+from tpusvm.analysis.core import _parse_rule_list
+from tpusvm.analysis.ir.audit import (
+    DEFAULT_IR_BASELINE_NAME,
+    render_audit_json,
+    run_ir_audit,
+)
+from tpusvm.analysis.ir.rules import IR_RULE_SUMMARIES
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m tpusvm.analysis ir-audit",
+        description=("jaxpr-level semantic auditor for the repo's jit "
+                     "entry points (rules JXIR101-JXIR106)"),
+    )
+    p.add_argument("--format", choices=("text", "json"), default="text",
+                   help="stdout report format (json = the audit "
+                        "artifact schema)")
+    p.add_argument("--json-out", default="",
+                   help="also write the audit artifact to this path "
+                        "(benchmarks/results/ir_audit_cpu.json is the "
+                        "committed instance)")
+    p.add_argument("--select", default="",
+                   help="comma-separated JXIR rule ids to run")
+    p.add_argument("--ignore", default="",
+                   help="comma-separated JXIR rule ids to skip")
+    p.add_argument("--entry", action="append", default=[],
+                   help="audit only this entry point (repeatable)")
+    p.add_argument("--baseline", default=DEFAULT_IR_BASELINE_NAME,
+                   help="baseline file of grandfathered findings "
+                        f"(default: {DEFAULT_IR_BASELINE_NAME}; missing "
+                        "file = empty baseline)")
+    p.add_argument("--no-baseline", action="store_true")
+    p.add_argument("--write-baseline", action="store_true",
+                   help="write all current findings to the baseline "
+                        "file and exit 0")
+    p.add_argument("--list-rules", action="store_true")
+    p.add_argument("--list-entries", action="store_true")
+    p.add_argument("--smoke", action="store_true",
+                   help="CI gate: assert >= --min-entries traced, all "
+                        "rules registered, and no non-baselined finding")
+    p.add_argument("--min-entries", type=int, default=8,
+                   help="--smoke: minimum entry points that must "
+                        "actually trace (default 8)")
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+
+    if args.list_rules:
+        for rid, summary in sorted(IR_RULE_SUMMARIES.items()):
+            print(f"{rid}  {summary}")
+        return 0
+    if args.list_entries:
+        from tpusvm.analysis.ir.entrypoints import default_entrypoints
+
+        for e in default_entrypoints():
+            sweep = f" sweep={sorted(e.sweep)}" if e.sweep else ""
+            print(f"{e.name}  [{e.precision}]{sweep}  {e.description}")
+        return 0
+
+    select = _parse_rule_list(args.select) or None
+    ignore = _parse_rule_list(args.ignore) or None
+    baseline = None
+    if not args.no_baseline and not args.write_baseline:
+        try:
+            baseline = load_baseline(args.baseline) or None
+        except ValueError as e:
+            print(f"tpusvm-ir-audit: {e}", file=sys.stderr)
+            return 2
+
+    try:
+        result = run_ir_audit(select=select, ignore=ignore,
+                              baseline=baseline,
+                              entry_filter=set(args.entry) or None)
+    except ValueError as e:
+        print(f"tpusvm-ir-audit: {e}", file=sys.stderr)
+        return 2
+
+    if args.write_baseline:
+        write_baseline(args.baseline, result.findings)
+        print(f"tpusvm-ir-audit: wrote {len(result.findings)} finding(s) "
+              f"to {args.baseline}")
+        return 0
+
+    if args.json_out:
+        with open(args.json_out, "w", encoding="utf-8") as fh:
+            fh.write(render_audit_json(result))
+
+    if args.format == "json":
+        print(render_audit_json(result), end="")
+    else:
+        from tpusvm.analysis.report import render_text
+
+        print(render_text(result))
+        skipped = [e for e in result.entries if not e.traced]
+        traced = result.traced_count
+        print(f"tpusvm-ir-audit: traced {traced}/{len(result.entries)} "
+              "entry point(s)"
+              + (f"; skipped: "
+                 + "; ".join(f"{e.name} ({e.skip_reason})"
+                             for e in skipped) if skipped else ""))
+
+    if args.smoke:
+        problems = []
+        if result.traced_count < args.min_entries:
+            problems.append(
+                f"only {result.traced_count} entry point(s) traced "
+                f"(smoke floor: {args.min_entries})")
+        missing = set(IR_RULE_SUMMARIES) - {
+            rid for rid in IR_RULE_SUMMARIES}  # registry self-check
+        if missing:  # pragma: no cover — structural invariant
+            problems.append(f"rules missing from registry: {missing}")
+        if result.findings:
+            problems.append(
+                f"{len(result.findings)} non-baselined finding(s) — the "
+                "committed baseline is empty by design; fix the hazard "
+                "or (for a deliberate exception) regenerate the "
+                "baseline with --write-baseline and justify it in "
+                "review")
+        if problems:
+            for p in problems:
+                print(f"tpusvm-ir-audit --smoke FAILED: {p}",
+                      file=sys.stderr)
+            return 1
+        print(f"tpusvm-ir-audit --smoke ok: {result.traced_count} entry "
+              f"points traced, {len(IR_RULE_SUMMARIES)} rules, "
+              f"{len(result.baselined)} baselined finding(s)")
+        return 0
+
+    return result.exit_code

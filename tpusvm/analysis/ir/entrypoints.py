@@ -1,0 +1,316 @@
+"""Canonical abstract signatures for every audited jit entry point.
+
+The compile observatory's registry (tpusvm.obs.prof.JIT_ENTRY_POINTS —
+populated as a side effect of `profiled_jit`, so it lists exactly the jit
+objects the repo ships) supplies the functions; this module supplies the
+shapes. One `IREntryPoint` per audited configuration pairs a builder —
+which returns (fn, args, kwargs) with arrays as `jax.ShapeDtypeStruct`
+and sweep hyperparameters as concrete Python floats — with the resolved
+precision rung its trace must obey (JXIR101) and the scalars whose
+values must NOT leak into the trace (JXIR106's dual-trace check).
+
+Canonical shapes follow the repo's power-of-two bucket discipline
+(serve's compile-cache buckets, the shrink driver's compaction buckets):
+every dimension is a multiple of the widest TPU tile in play
+(config.TPU_TILE_SHAPES — (16, 128) for the bf16 rung), so the JXIR104
+tile-alignment report is clean by construction on the shipped shapes and
+any misalignment a future change introduces is a real regression.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Callable, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from tpusvm.analysis.ir.tracing import SkipTrace
+
+# canonical dimensions (all multiples of the (16, 128) bf16 tile)
+N = 1024      # training rows
+D = 128       # features
+Q = 256       # blocked working-set size
+M = 512       # prediction batch rows
+N_SV = 512    # support-vector rows (prediction/serving operand)
+N_CLS = 16    # OVR classes
+BUCKET = 128  # serve compile-cache bucket (power of two, tile-aligned)
+
+F32 = jnp.float32
+
+
+def _s(shape, dtype=F32):
+    return jax.ShapeDtypeStruct(shape, dtype)
+
+
+@dataclasses.dataclass(frozen=True)
+class IREntryPoint:
+    """One audited trace configuration.
+
+    build(**scalars) -> (fn, args, kwargs). `sweep` maps each scalar
+    kwarg of build to a (first, second) value pair: the auditor traces
+    once with the first values (the jaxpr every rule walks) and once
+    with the second, and JXIR106 requires the two jaxprs to be
+    IDENTICAL — a difference means a weak scalar's concrete value leaked
+    into the trace, i.e. jit recompiles per hyperparameter value. An
+    empty sweep declares every scalar static by design (the serving
+    contract: one executable per model).
+    """
+
+    name: str
+    build: Callable[..., Tuple[Callable, tuple, dict]]
+    sweep: Dict[str, Tuple[float, float]] = dataclasses.field(
+        default_factory=dict)
+    precision: str = "float32"   # resolved matmul rung (JXIR101)
+    allow_f64: bool = False      # entry legitimately carries f64 avals
+    description: str = ""
+
+
+def _registered(name: str):
+    """The raw jit object + statics behind an observatory name."""
+    from tpusvm.obs import prof
+
+    entry = prof.JIT_ENTRY_POINTS.get(name)
+    if entry is None:  # pragma: no cover — registry drift is a bug
+        raise SkipTrace(f"{name!r} not in obs.prof.JIT_ENTRY_POINTS "
+                        f"(known: {sorted(prof.JIT_ENTRY_POINTS)})")
+    return entry
+
+
+# ------------------------------------------------------------- solvers
+def _blocked_builder(sweep_statics: dict, with_pause: bool = False):
+    import tpusvm.solver.blocked  # noqa: F401 — registers the entry
+
+    def build(C=10.0, gamma=0.5):
+        jitted, _ = _registered("solver.blocked_smo_solve")
+        fn = functools.partial(jitted, q=Q, telemetry=0, **sweep_statics)
+        args = (_s((N, D)), _s((N,)))
+        kwargs = dict(C=C, gamma=gamma)
+        if with_pause:
+            kwargs["pause_at"] = _s((), jnp.int32)
+        return fn, args, kwargs
+
+    return build
+
+
+def _smo_build(C=10.0, gamma=0.5):
+    import tpusvm.solver.smo  # noqa: F401
+
+    jitted, _ = _registered("solver.smo_solve")
+    return jitted, (_s((N, D)), _s((N,))), dict(C=C, gamma=gamma)
+
+
+# ---------------------------------------------------------- prediction
+def _decision_build():
+    import tpusvm.solver.predict  # noqa: F401
+
+    jitted, _ = _registered("predict.decision_function")
+    fn = functools.partial(jitted, gamma=0.5, block=M, kernel="rbf")
+    return fn, (_s((M, D)), _s((N_SV, D)), _s((N_SV,)), _s(())), {}
+
+
+def _decision_flat_build():
+    import tpusvm.solver.predict  # noqa: F401
+
+    jitted, _ = _registered("predict.decision_function_flat")
+    fn = functools.partial(jitted, gamma=0.5, kernel="rbf")
+    return fn, (_s((M, D)), _s((N_SV, D)), _s((N_SV,)), _s(())), {}
+
+
+def _ovr_build():
+    import tpusvm.models.ovr  # noqa: F401
+
+    jitted, _ = _registered("predict.ovr_scores")
+    fn = functools.partial(jitted, kernel="rbf", degree=3)
+    # gamma/coef0 arrive as 0-d device arrays in production (the serving
+    # worker materialises them per model), hence abstract here
+    return fn, (_s((M, D)), _s((N_SV, D)), _s((N_CLS, N_SV)),
+                _s((N_CLS,)), _s(()), _s(())), {}
+
+
+# -------------------------------------------------------------- serving
+def _serve_bucket_binary_build():
+    import tpusvm.solver.predict  # noqa: F401
+
+    jitted, _ = _registered("predict.decision_function")
+    # mirrors serve.buckets.CompileCache._lower for kind="binary"/"svr":
+    # the scan block is capped at the bucket, kernel params are static
+    # model config — the exact program the bucket cache AOT-compiles
+    fn = functools.partial(jitted, gamma=0.5, block=BUCKET, kernel="rbf",
+                           degree=3, coef0=0.0)
+    return fn, (_s((BUCKET, D)), _s((N_SV, D)), _s((N_SV,)), _s(())), {}
+
+
+def _serve_bucket_ovr_build():
+    import tpusvm.models.ovr  # noqa: F401
+
+    jitted, _ = _registered("predict.ovr_scores")
+    fn = functools.partial(jitted, kernel="rbf", degree=3)
+    return fn, (_s((BUCKET, D)), _s((N_SV, D)), _s((N_CLS, N_SV)),
+                _s((N_CLS,)), _s(()), _s(())), {}
+
+
+# -------------------------------------------- kernel-dispatch contractions
+def _kernels_build(family: str):
+    def build(gamma=0.5, coef0=1.0):
+        from tpusvm import kernels
+
+        if family == "rbf":
+            def fn(X, XB, coef, g):
+                return kernels.cross_matvec("rbf", X, XB, coef, gamma=g)
+            return fn, (_s((N, D)), _s((Q, D)), _s((Q,)), gamma), {}
+        if family == "linear":
+            def fn(X, XB, coef):
+                return kernels.cross_matvec("linear", X, XB, coef,
+                                            gamma=0.0)
+            return fn, (_s((N, D)), _s((Q, D)), _s((Q,))), {}
+
+        def fn(X, XB, coef, g, c0):
+            return kernels.cross_matvec("poly", X, XB, coef, gamma=g,
+                                        coef0=c0, degree=3)
+        return fn, (_s((N, D)), _s((Q, D)), _s((Q,)), gamma, coef0), {}
+
+    return build
+
+
+# ------------------------------------------------------ cascade round fn
+def _cascade_round_build():
+    if not hasattr(jax, "shard_map"):
+        raise SkipTrace("jax.shard_map unavailable in this jax "
+                        f"({jax.__version__}); the cascade round "
+                        "executable cannot be built")
+    try:
+        from tpusvm.config import SVMConfig
+        from tpusvm.parallel.cascade import _build_round_fn
+        from tpusvm.parallel.mesh import make_mesh
+        from tpusvm.parallel.svbuffer import SVBuffer
+
+        train_cap, sv_cap = 256, 128
+        mesh = make_mesh(1)
+        fn = _build_round_fn(mesh, "tree", 1, train_cap, None, sv_cap,
+                             SVMConfig(), None, "blocked", {})
+
+        def buf(cap):
+            return SVBuffer(X=_s((cap, D)), Y=_s((cap,), jnp.int32),
+                            alpha=_s((cap,)), ids=_s((cap,), jnp.int32),
+                            valid=_s((cap,), jnp.bool_))
+
+        return fn, (buf(train_cap), buf(sv_cap)), {}
+    except SkipTrace:
+        raise
+    except Exception as e:  # pragma: no cover — topology-dependent
+        raise SkipTrace(f"cascade round executable not traceable here: "
+                        f"{type(e).__name__}: {e}")
+
+
+# ------------------------------------------------------------- registry
+def default_entrypoints():
+    """The audited entry points, in stable registry order."""
+    sweep_cg = {"C": (10.0, 3.0), "gamma": (0.5, 0.125)}
+    return [
+        IREntryPoint(
+            name="solver.blocked_smo_solve",
+            build=_blocked_builder({}),
+            sweep=dict(sweep_cg),
+            description="blocked SMO, rbf, f32 trust anchor",
+        ),
+        IREntryPoint(
+            name="solver.blocked_smo_solve[bf16_f32]",
+            build=_blocked_builder({"matmul_precision": "bf16_f32",
+                                    "shrink_stable": 3}),
+            sweep=dict(sweep_cg),
+            precision="bf16_f32",
+            description="blocked SMO on the bf16_f32 ladder rung "
+                        "(rounded operands, f32 accumulation)",
+        ),
+        IREntryPoint(
+            name="solver.blocked_smo_solve[linear]",
+            build=_blocked_builder({"kernel": "linear"}),
+            sweep={"C": (10.0, 3.0)},
+            description="blocked SMO, linear primal fast path",
+        ),
+        IREntryPoint(
+            name="solver.blocked_smo_solve[krow_cache]",
+            build=_blocked_builder({"krow_cache": Q}),
+            sweep=dict(sweep_cg),
+            description="blocked SMO with the K-row LRU cache paths",
+        ),
+        IREntryPoint(
+            name="solver.shrink_segment",
+            build=_blocked_builder({"shrink_stable": 3,
+                                    "return_state": True},
+                                   with_pause=True),
+            sweep=dict(sweep_cg),
+            description="one shrinking-driver segment (stability "
+                        "counters + pause/return_state surface)",
+        ),
+        IREntryPoint(
+            name="solver.blocked_smo_solve[fused]",
+            build=_blocked_builder({"fused_fupdate": True}),
+            sweep=dict(sweep_cg),
+            description="blocked SMO with the fused Pallas f-update "
+                        "kernel (the pallas_call body is walked too)",
+        ),
+        IREntryPoint(
+            name="solver.smo_solve",
+            build=_smo_build,
+            sweep=dict(sweep_cg),
+            description="flat single-pair SMO solver",
+        ),
+        IREntryPoint(
+            name="predict.decision_function",
+            build=_decision_build,
+            description="blocked batched scorer (kernel params static "
+                        "by the serving contract — no sweep)",
+        ),
+        IREntryPoint(
+            name="predict.decision_function_flat",
+            build=_decision_flat_build,
+            description="flat mesh-sharded scorer",
+        ),
+        IREntryPoint(
+            name="predict.ovr_scores",
+            build=_ovr_build,
+            description="one-vs-rest class-score gemm",
+        ),
+        IREntryPoint(
+            name="serve.bucket[binary]",
+            build=_serve_bucket_binary_build,
+            description="serve compile-cache bucket executable, "
+                        "binary/svr kind",
+        ),
+        IREntryPoint(
+            name="serve.bucket[ovr]",
+            build=_serve_bucket_ovr_build,
+            description="serve compile-cache bucket executable, ovr kind",
+        ),
+        IREntryPoint(
+            name="kernels.cross_matvec[rbf]",
+            build=_kernels_build("rbf"),
+            sweep={"gamma": (0.5, 0.125)},
+            description="kernel-dispatch blocked f-update contraction, "
+                        "rbf family",
+        ),
+        IREntryPoint(
+            name="kernels.cross_matvec[linear]",
+            build=_kernels_build("linear"),
+            description="kernel-dispatch contraction, linear primal",
+        ),
+        IREntryPoint(
+            name="kernels.cross_matvec[poly]",
+            build=_kernels_build("poly"),
+            sweep={"gamma": (0.5, 0.125), "coef0": (1.0, 0.25)},
+            description="kernel-dispatch contraction, poly family",
+        ),
+        IREntryPoint(
+            name="cascade.round_fn",
+            build=_cascade_round_build,
+            description="distributed cascade round executable "
+                        "(shard_map; skipped where jax lacks it)",
+        ),
+    ]
+
+
+def entrypoint_names():
+    return [e.name for e in default_entrypoints()]

@@ -1,0 +1,367 @@
+"""The JXIR rule set: semantic checks over traced jaxprs.
+
+Where the AST rules (JX001-JX010) police what the source text says, these
+rules machine-check what the compiler was actually asked to solve. Each
+rule is a function over one `TraceAudit` (an entry point plus its traced
+jaxpr(s)) yielding `Finding`s whose `path` is the pseudo-path
+``jaxpr://<entry-name>`` — line/col carry no meaning at IR level (always
+1:1), and fingerprints hash the rule + entry + a stable equation
+descriptor, so the shared baseline mechanism (analysis/baseline.py) works
+unchanged.
+
+  JXIR101  unrouted contraction precision: every dot_general must carry
+           an explicit precision consistent with the entry's resolved
+           matmul rung; jax's None/DEFAULT (raw single-pass bf16 on TPU
+           MXUs) is the footgun config.resolve_matmul_precision exists
+           to close, now checked at the IR where it bites. The bf16_f32
+           rung's signature is ROUNDED bf16 operands + f32 accumulation
+           (preferred_element_type), which is only legal on bf16-rung
+           entries.
+  JXIR102  dtype provenance: no float64/complex aval anywhere in the
+           graph (unless the entry declares allow_f64 — the x64
+           accumulator mode), and no weak-typed ARRAY aval (a
+           Python-scalar-derived array whose dtype was decided by
+           promotion accident; as a carry or output it also forces
+           jax's weak-type fixpoint re-trace). Weak 0-d scalars are the
+           healthy jit hyperparameter pattern and exempt.
+  JXIR103  loop-carry stability: while/scan carries must have
+           structurally identical in/out avals (shape, dtype, weak
+           type) and no weak-typed carry at all — the shrink
+           compaction and checkpoint-resume paths hand carries across
+           segment boundaries and depend on this exactly.
+  JXIR104  TPU tile alignment: dot_general operands whose trailing two
+           dims are not multiples of the dtype's min tile
+           (config.TPU_TILE_SHAPES) are padded by the compiler; the
+           finding reports the estimated padding-waste %. Canonical
+           shapes follow the serve/shrink power-of-two buckets, so any
+           finding is a real mis-sized operand.
+  JXIR105  host callback reachable from a loop body at IR level — the
+           semantic closure of JX009's syntactic check (a callback
+           smuggled through a helper the AST walker cannot see still
+           shows up as a debug_callback/io_callback equation inside
+           the while/scan body jaxpr).
+  JXIR106  recompile hazard: the entry is traced twice with different
+           values for its swept weak scalars; any difference between
+           the two jaxprs means a hyperparameter's VALUE leaked into
+           the trace (a closure capture or host-side arithmetic), i.e.
+           every sweep point pays a fresh compile.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Dict, Iterable, List, Optional
+
+from tpusvm.analysis.core import Finding
+from tpusvm.analysis.ir.tracing import (
+    aval_of,
+    in_loop_body,
+    iter_eqns,
+)
+
+#: rule id -> one-line summary; importable without jax (the analysis CLI
+#: lists IR rules next to the AST ones in its no-accelerator lint job)
+IR_RULE_SUMMARIES = {
+    "JXIR101": ("dot_general without an explicit precision consistent "
+                "with the entry's resolved matmul rung (jax's default = "
+                "raw single-pass bf16 on TPU MXUs)"),
+    "JXIR102": ("float64 or weak-typed array aval in a traced graph "
+                "(dtype provenance: Python-scalar promotion that "
+                "recompiles or drifts)"),
+    "JXIR103": ("while/scan carry in/out avals differ or carry is "
+                "weak-typed (carry instability breaks shrink compaction "
+                "and checkpoint-resume re-entry)"),
+    "JXIR104": ("dot_general operand not aligned to the TPU min tile "
+                "for its dtype — compiler pads, wasting HBM/MXU cycles"),
+    "JXIR105": ("host callback reachable from a while/scan body at IR "
+                "level (a device->host round trip per iteration)"),
+    "JXIR106": ("entry-point trace varies with the concrete value of a "
+                "weak scalar argument (recompile per hyperparameter "
+                "value)"),
+}
+
+_CALLBACK_PRIMS = {
+    "debug_callback", "io_callback", "pure_callback", "callback",
+    "outside_call", "infeed", "outfeed", "host_callback_call",
+}
+
+
+@dataclasses.dataclass
+class TraceAudit:
+    """One entry point's traced artifacts, as handed to every rule."""
+
+    entry: object                       # IREntryPoint
+    jaxpr: object                       # ClosedJaxpr (sweep-first values)
+    jaxpr_alt_str: Optional[str] = None  # str(jaxpr) at second values
+    jaxpr_str: Optional[str] = None      # str(jaxpr) at first values
+
+    @property
+    def path(self) -> str:
+        return f"jaxpr://{self.entry.name}"
+
+
+def _finding(audit: TraceAudit, rule: str, message: str,
+             snippet: str) -> Finding:
+    return Finding(rule=rule, path=audit.path, line=1, col=1,
+                   message=message, snippet=snippet)
+
+
+def _eqn_snippet(eqn, path) -> str:
+    """Stable, human-readable equation descriptor for fingerprints: the
+    primitive, its operand shapes/dtypes, and where it sits."""
+    ops = ",".join(
+        f"{aval_of(v).dtype}{list(aval_of(v).shape)}" for v in eqn.invars
+    )
+    where = "/".join(path) or "top"
+    return f"{eqn.primitive.name}({ops}) @ {where}"
+
+
+# ----------------------------------------------------------------- JXIR101
+def check_jxir101(audit: TraceAudit) -> Iterable[Finding]:
+    import jax
+
+    Precision = jax.lax.Precision
+    rung = audit.entry.precision
+    bf16_rung = rung in ("bf16_f32", "bf16_f32c")
+    for eqn, path in iter_eqns(audit.jaxpr):
+        if eqn.primitive.name != "dot_general":
+            continue
+        dtypes = [str(aval_of(v).dtype) for v in eqn.invars]
+        bf16_ops = all(dt == "bfloat16" for dt in dtypes)
+        prec = eqn.params.get("precision")
+        pref = eqn.params.get("preferred_element_type")
+        snippet = _eqn_snippet(eqn, path)
+        if bf16_ops:
+            if not bf16_rung:
+                yield _finding(
+                    audit, "JXIR101",
+                    f"bfloat16-operand contraction in a {rung!r}-rung "
+                    "entry: operands were rounded to bf16 outside the "
+                    "bf16_f32 ladder rungs", snippet)
+            elif str(pref) != "float32":
+                yield _finding(
+                    audit, "JXIR101",
+                    "bf16 operands without f32 accumulation "
+                    f"(preferred_element_type={pref}): the bf16_f32 rung "
+                    "requires exact f32 adds via "
+                    "preferred_element_type=float32 (ops.rbf.matmul_p)",
+                    snippet)
+            continue
+        vals = prec if isinstance(prec, (tuple, list)) else (prec,)
+        if prec is None or any(p is None or p == Precision.DEFAULT
+                               for p in vals):
+            yield _finding(
+                audit, "JXIR101",
+                f"dot_general with precision={prec!r}: jax's default "
+                "requests RAW single-pass bf16 on TPU MXUs (~1e-2 Gram "
+                "error, breaks SV-set parity); route the contraction "
+                "through ops.rbf.matmul_p / ops.rbf.coef_matvec so the "
+                f"resolved {rung!r} rung reaches the IR", snippet)
+
+
+# ----------------------------------------------------------------- JXIR102
+def check_jxir102(audit: TraceAudit) -> Iterable[Finding]:
+    if audit.entry.allow_f64:
+        return
+    seen = set()
+    jaxpr = audit.jaxpr.jaxpr
+
+    def hazards(var, where):
+        aval = aval_of(var)
+        dt = str(getattr(aval, "dtype", ""))
+        weak = bool(getattr(aval, "weak_type", False))
+        ndim = len(getattr(aval, "shape", ()))
+        if dt in ("float64", "complex128") and not (weak and ndim == 0):
+            return (f"float64 aval {dt}{list(aval.shape)} {where} — the "
+                    "canonical f32 signature promoted to double "
+                    "somewhere (a Python float in an x64 context, or an "
+                    "explicit f64 cast outside the accumulator mode)")
+        if weak and ndim >= 1:
+            return (f"weak-typed array aval {dt}{list(aval.shape)} "
+                    f"{where} — a Python-scalar-derived array whose "
+                    "dtype follows promotion accidents; give it an "
+                    "explicit dtype at construction")
+        return None
+
+    for var in jaxpr.invars:
+        msg = hazards(var, "at an entry input")
+        if msg and ("invar", id(var)) not in seen:
+            seen.add(("invar", id(var)))
+            yield _finding(audit, "JXIR102", msg, "entry invars")
+    for var in jaxpr.constvars:
+        msg = hazards(var, "in a closed-over constant")
+        if msg:
+            yield _finding(audit, "JXIR102", msg, "entry constvars")
+    for eqn, path in iter_eqns(audit.jaxpr):
+        for var in eqn.outvars:
+            msg = hazards(var, f"from `{eqn.primitive.name}`")
+            if msg:
+                yield _finding(audit, "JXIR102", msg,
+                               _eqn_snippet(eqn, path))
+
+
+# ----------------------------------------------------------------- JXIR103
+def _carry_pairs(eqn):
+    """(in_aval, out_aval) pairs of a loop carry, or [] for non-loops."""
+    name = eqn.primitive.name
+    if name == "while":
+        body = eqn.params["body_jaxpr"].jaxpr
+        nc = eqn.params.get("body_nconsts", 0)
+        return list(zip(body.invars[nc:], body.outvars))
+    if name == "scan":
+        body = eqn.params["jaxpr"].jaxpr
+        nc = eqn.params.get("num_consts", 0)
+        ncar = eqn.params.get("num_carry", 0)
+        return list(zip(body.invars[nc:nc + ncar], body.outvars[:ncar]))
+    return []
+
+
+def check_jxir103(audit: TraceAudit) -> Iterable[Finding]:
+    for eqn, path in iter_eqns(audit.jaxpr):
+        pairs = _carry_pairs(eqn)
+        for slot, (vin, vout) in enumerate(pairs):
+            a, b = aval_of(vin), aval_of(vout)
+            a_sig = (tuple(a.shape), str(a.dtype), bool(a.weak_type))
+            b_sig = (tuple(b.shape), str(b.dtype), bool(b.weak_type))
+            snippet = (f"{eqn.primitive.name} carry[{slot}] @ "
+                       f"{'/'.join(path) or 'top'}")
+            if a_sig != b_sig:
+                yield _finding(
+                    audit, "JXIR103",
+                    f"loop carry slot {slot} changes aval across one "
+                    f"iteration: in {a_sig} vs out {b_sig} — resume/"
+                    "compaction re-entry would rebuild a different "
+                    "program", snippet)
+            elif a.weak_type:
+                yield _finding(
+                    audit, "JXIR103",
+                    f"weak-typed loop carry slot {slot} "
+                    f"({a.dtype}{list(a.shape)}): jax re-traces the body "
+                    "for the weak-type fixpoint and the carry dtype is "
+                    "promotion-determined; initialise the carry with an "
+                    "explicit dtype (jnp.int32(0), jnp.zeros(..., "
+                    "dtype=...))", snippet)
+
+
+# ----------------------------------------------------------------- JXIR104
+def check_jxir104(audit: TraceAudit) -> Iterable[Finding]:
+    """Tile alignment of dot_general CONTRACTING dims.
+
+    Scope decision: only contracted dimensions are checked. They are the
+    dims the repo's sizing disciplines control (q, the scan block, the
+    serve buckets, shrink's compaction capacities, sv buffers), their
+    padding cost is multiplicative (paid once per OUTPUT tile, every
+    iteration of the contraction loop), and a drift off the tile grid
+    there is always a fixable regression. Small NON-contracting dims are
+    problem shape, not sizing bugs — the OVR class count, the flat
+    solver's two selected K-rows — and flagging them would force a
+    baseline entry for every legitimately small model axis.
+    """
+    from tpusvm.config import tpu_tile_for
+
+    for eqn, path in iter_eqns(audit.jaxpr):
+        if eqn.primitive.name != "dot_general":
+            continue
+        (lhs_c, rhs_c), _batch = eqn.params["dimension_numbers"]
+        for opi, (var, contract) in enumerate(
+                zip(eqn.invars, (lhs_c, rhs_c))):
+            aval = aval_of(var)
+            shape = tuple(getattr(aval, "shape", ()))
+            if len(shape) < 2:
+                continue  # vectors/scalars are not MXU-tiled operands
+            tile = tpu_tile_for(str(aval.dtype))
+            for cd in contract:
+                # position decides the constraint: last dim sits on the
+                # 128-lane axis, second-to-last on the sublane axis;
+                # leading dims are untiled
+                axis_from_end = len(shape) - 1 - cd
+                if axis_from_end > 1:
+                    continue
+                size = shape[cd]
+                req = tile[1] if axis_from_end == 0 else tile[0]
+                padded = -(-size // req) * req
+                if padded == size:
+                    continue
+                waste = 100.0 * (1.0 - size / padded)
+                yield _finding(
+                    audit, "JXIR104",
+                    f"dot_general operand {opi} "
+                    f"{aval.dtype}{list(shape)}: contracting dim {cd} "
+                    f"(size {size}) is not a multiple of its TPU tile "
+                    f"extent {req} — the compiler pads it to {padded}, "
+                    f"an estimated {waste:.1f}% padding waste on every "
+                    "output tile; size it on the power-of-two bucket "
+                    "grid (serve buckets / shrink compaction "
+                    "discipline)",
+                    f"operand{opi}:{_eqn_snippet(eqn, path)}")
+
+
+# ----------------------------------------------------------------- JXIR105
+def check_jxir105(audit: TraceAudit) -> Iterable[Finding]:
+    for eqn, path in iter_eqns(audit.jaxpr):
+        if eqn.primitive.name in _CALLBACK_PRIMS and in_loop_body(path):
+            yield _finding(
+                audit, "JXIR105",
+                f"`{eqn.primitive.name}` reachable from a loop body "
+                f"({'/'.join(path)}): one device->host round trip per "
+                "iteration of the compiled loop — JX009's hazard, here "
+                "proven at IR level through whatever helpers hid it from "
+                "the AST; carry telemetry through the loop state instead",
+                _eqn_snippet(eqn, path))
+
+
+# ----------------------------------------------------------------- JXIR106
+def check_jxir106(audit: TraceAudit) -> Iterable[Finding]:
+    if not audit.entry.sweep or audit.jaxpr_alt_str is None:
+        return
+    a, b = audit.jaxpr_str, audit.jaxpr_alt_str
+    if a == b:
+        return
+    # first differing line, for the message only (fingerprint stays on
+    # the stable entry-level snippet)
+    diff_line = ""
+    for la, lb in zip(a.splitlines(), b.splitlines()):
+        if la != lb:
+            diff_line = la.strip()
+            break
+    names = ", ".join(sorted(audit.entry.sweep))
+    yield _finding(
+        audit, "JXIR106",
+        f"re-tracing with different values of weak scalar(s) [{names}] "
+        "produced a DIFFERENT jaxpr (first divergence: "
+        f"`{diff_line[:120]}`): a hyperparameter's concrete value is "
+        "baked into the trace — every sweep point recompiles; pass the "
+        "scalar as a traced argument, not a closure constant",
+        "sweep-divergence")
+
+
+@dataclasses.dataclass(frozen=True)
+class IRRule:
+    id: str
+    summary: str
+    check: Callable[[TraceAudit], Iterable[Finding]]
+
+
+def all_ir_rules() -> Dict[str, IRRule]:
+    checks = {
+        "JXIR101": check_jxir101,
+        "JXIR102": check_jxir102,
+        "JXIR103": check_jxir103,
+        "JXIR104": check_jxir104,
+        "JXIR105": check_jxir105,
+        "JXIR106": check_jxir106,
+    }
+    assert set(checks) == set(IR_RULE_SUMMARIES)
+    return {rid: IRRule(rid, IR_RULE_SUMMARIES[rid], fn)
+            for rid, fn in sorted(checks.items())}
+
+
+def select_ir_rules(select=None, ignore=None) -> List[IRRule]:
+    rules = all_ir_rules()
+    unknown = (set(select or ()) | set(ignore or ())) - set(rules)
+    if unknown:
+        raise ValueError(f"unknown IR rule id(s): {sorted(unknown)}; "
+                         f"known: {sorted(rules)}")
+    return [r for rid, r in rules.items()
+            if (not select or rid in select)
+            and (not ignore or rid not in ignore)]

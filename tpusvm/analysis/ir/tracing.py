@@ -1,0 +1,110 @@
+"""Jaxpr acquisition and traversal for the IR auditor.
+
+The AST linter (JX001-JX010) checks what the source *says*; this module
+feeds the JXIR rules what the compiler actually *solves*: the closed
+jaxpr of each registered entry point, traced from canonical abstract
+signatures (tpusvm.analysis.ir.entrypoints). Tracing goes through the
+very jit objects the repo ships — `jax.make_jaxpr` applied to the jit
+wrapper yields a top-level `pjit` equation whose params carry the real
+inner jaxpr, with static_argnames resolved exactly as a production call
+would resolve them — so the audited graph IS the compiled graph, not a
+re-derivation of it.
+
+`iter_eqns` walks a closed jaxpr recursively: any equation parameter
+holding a Jaxpr/ClosedJaxpr (pjit bodies, `while` cond/body, `scan`
+bodies, `cond`/`switch` branches, custom_jvp/vjp call jaxprs, and
+pallas_call kernel bodies where the primitive exposes them) is descended
+into, with a human-readable path like
+``pjit.jaxpr/while.body_jaxpr/cond.branches`` attached to every yielded
+equation so findings can say *where inside the program* a hazard sits.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Iterator, List, Tuple
+
+# NOTE: jax is imported lazily inside the functions that need it —
+# tpusvm.analysis.ir.rules re-exports rule SUMMARIES through this package
+# into the no-accelerator lint CI job, which must import without jax.
+
+
+class SkipTrace(Exception):
+    """Raised by an entry-point builder when the entry cannot be traced
+    in this environment (missing jax feature, missing device topology).
+    The audit records the entry as skipped-with-reason instead of
+    failing; the ≥-min-entries smoke gate keeps "skipped" honest."""
+
+
+def trace_entry(fn, args: tuple, kwargs: dict):
+    """Closed jaxpr of `fn(*args, **kwargs)`.
+
+    Arrays are passed as jax.ShapeDtypeStruct (pure abstract — nothing
+    is allocated); sweep scalars arrive as concrete Python floats, which
+    `make_jaxpr` abstractifies to weak-typed 0-d avals — the same avals
+    jit's cache keys on, so weak-type behaviour is audited faithfully.
+    """
+    import jax
+
+    return jax.make_jaxpr(fn)(*args, **kwargs)
+
+
+def _subjaxprs(value: Any) -> List:
+    """Jaxpr/ClosedJaxpr instances inside one eqn param value."""
+    import jax
+
+    out = []
+    vals = value if isinstance(value, (list, tuple)) else [value]
+    for v in vals:
+        if isinstance(v, (jax.core.Jaxpr, jax.core.ClosedJaxpr)):
+            out.append(v)
+    return out
+
+
+def iter_eqns(closed_jaxpr) -> Iterator[Tuple[Any, Tuple[str, ...]]]:
+    """Yield (eqn, path) over a closed jaxpr and every nested sub-jaxpr.
+
+    `path` is a tuple of "primitive.param" hops from the top level down
+    to the sub-jaxpr owning the equation; () means top level.
+    """
+    jaxpr = getattr(closed_jaxpr, "jaxpr", closed_jaxpr)
+
+    def walk(jx, path):
+        for eqn in jx.eqns:
+            yield eqn, path
+            for pname, pval in eqn.params.items():
+                for sub in _subjaxprs(pval):
+                    inner = getattr(sub, "jaxpr", sub)
+                    hop = f"{eqn.primitive.name}.{pname}"
+                    yield from walk(inner, path + (hop,))
+
+    yield from walk(jaxpr, ())
+
+
+def in_loop_body(path: Tuple[str, ...]) -> bool:
+    """True when `path` descends through a loop body (re-executed per
+    iteration): a `while` cond/body or a `scan` body. `cond`/`switch`
+    branches execute once per call and do not count."""
+    return any(hop.startswith(("while.", "scan.")) for hop in path)
+
+
+def eqn_stats(closed_jaxpr) -> dict:
+    """Structural counts for the audit artifact (sorted, deterministic)."""
+    n_eqns = n_dots = n_while = n_scan = n_pallas = 0
+    for eqn, _ in iter_eqns(closed_jaxpr):
+        n_eqns += 1
+        name = eqn.primitive.name
+        if name == "dot_general":
+            n_dots += 1
+        elif name == "while":
+            n_while += 1
+        elif name == "scan":
+            n_scan += 1
+        elif name.startswith("pallas"):
+            n_pallas += 1
+    return {"eqns": n_eqns, "dot_generals": n_dots, "while_loops": n_while,
+            "scans": n_scan, "pallas_calls": n_pallas}
+
+
+def aval_of(var):
+    """Aval of a jaxpr Var or Literal (both carry .aval in this jax)."""
+    return var.aval

@@ -11,4 +11,5 @@ from tpusvm.analysis.rules import (  # noqa: F401
     jx007_debug_leftover,
     jx008_pallas_flags,
     jx009_loop_callback,
+    jx010_raw_contraction,
 )

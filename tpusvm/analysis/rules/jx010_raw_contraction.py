@@ -1,0 +1,92 @@
+"""JX010 — raw contraction outside the kernel modules (the AST half of
+the IR auditor's JXIR101).
+
+Every matmul-shaped operation in this repo must route through
+tpusvm/ops/ or tpusvm/kernels/ (matmul_p / coef_matvec / the dispatch
+layer), where tpusvm.config.resolve_matmul_precision attaches an
+explicit precision to the emitted dot_general. A bare `K @ coef`,
+`jnp.dot`, `jnp.einsum` or `lax.dot_general` elsewhere carries jax's
+DEFAULT precision — raw single-pass bf16 on TPU MXUs, ~1e-2 absolute
+error on unit-scale Gram entries, enough to break SV-set parity with
+the f64 oracle. JXIR101 catches the hazard in the traced jaxpr at audit
+time; this rule catches it in review, before the trace exists.
+
+Scope: `jnp.*`/`lax.*` contraction CALLS are flagged anywhere in a
+non-exempt file (they are unambiguously JAX); the `@` OPERATOR is
+flagged only inside traced functions, where operands are tracers —
+host-side NumPy linear algebra (the f64 oracle, dataset synthesis,
+bench assertions) legitimately uses `@` and is none of this rule's
+business.
+"""
+
+from __future__ import annotations
+
+import ast
+from pathlib import Path
+
+from tpusvm.analysis.core import Finding, snippet_at
+from tpusvm.analysis.registry import Rule, register
+
+# the modules allowed to emit contractions: they own the precision
+# routing. NOT core.KERNEL_PATH_PARTS — tpusvm/solver is a kernel path
+# for dtype/debug rules but must still route its matmuls through ops.
+_CONTRACTION_HOME_PARTS = ("tpusvm/ops", "tpusvm/kernels")
+
+_CONTRACTION_CALLS = {
+    "jax.numpy.dot",
+    "jax.numpy.matmul",
+    "jax.numpy.einsum",
+    "jax.numpy.vdot",
+    "jax.numpy.inner",
+    "jax.numpy.tensordot",
+    "jax.lax.dot",
+    "jax.lax.dot_general",
+    "jax.lax.batch_matmul",
+}
+
+_ADVICE = ("route it through tpusvm.kernels dispatch or "
+           "tpusvm.ops.rbf.matmul_p/coef_matvec so the resolved "
+           "precision rung reaches the emitted dot_general (jax's "
+           "default = raw single-pass bf16 on TPU MXUs)")
+
+
+def _is_exempt(path: str) -> bool:
+    posix = Path(path).as_posix()
+    return any(part in posix for part in _CONTRACTION_HOME_PARTS)
+
+
+@register
+class RawContraction(Rule):
+    id = "JX010"
+    summary = ("raw @ / jnp.dot / jnp.einsum / lax.dot_general outside "
+               "tpusvm/ops and tpusvm/kernels (contraction precision "
+               "never resolved — raw bf16 on TPU)")
+
+    def check(self, ctx):
+        if _is_exempt(ctx.path):
+            return
+        # matmul CALLS: unambiguous jax namespaces, flagged module-wide
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Call):
+                resolved = ctx.resolve_call(node)
+                if resolved in _CONTRACTION_CALLS:
+                    yield Finding(
+                        rule=self.id, path=ctx.path, line=node.lineno,
+                        col=node.col_offset + 1,
+                        message=(f"`{resolved}` outside the contraction "
+                                 f"home modules — {_ADVICE}"),
+                        snippet=snippet_at(ctx.lines, node.lineno),
+                    )
+        # the @ operator: only where operands are traced arrays
+        for tf in ctx.traced_functions:
+            for node in tf.own_nodes:
+                if (isinstance(node, ast.BinOp)
+                        and isinstance(node.op, ast.MatMult)):
+                    yield Finding(
+                        rule=self.id, path=ctx.path, line=node.lineno,
+                        col=node.col_offset + 1,
+                        message=(f"raw `@` matmul inside traced "
+                                 f"{tf.name!r} ({tf.reason}) — "
+                                 f"{_ADVICE}"),
+                        snippet=snippet_at(ctx.lines, node.lineno),
+                    )

@@ -324,6 +324,29 @@ def pallas_flag_errors(inner, wss, flags: dict, fused=None) -> list:
     return errors
 
 
+# TPU minimum tile shapes (sublane x lane) per operand dtype: an MXU/VMEM
+# operand whose trailing two dims are not multiples of its tile is padded
+# up to it by the compiler, silently burning HBM bandwidth and MXU cycles
+# on zeros. The lane dim is always 128; the sublane dim shrinks as the
+# dtype widens. Single source of truth shared by the IR auditor's
+# JXIR104 tile-alignment rule (tpusvm.analysis.ir.rules), the serve/
+# shrink power-of-two bucket invariants (which exist precisely so padded
+# shapes land ON these tiles), and the Pallas kernels' shape validation.
+TPU_TILE_SHAPES = {
+    "float32": (8, 128),
+    "bfloat16": (16, 128),
+    "int8": (32, 128),
+    "float8_e4m3fn": (32, 128),
+    "float8_e5m2": (32, 128),
+}
+
+
+def tpu_tile_for(dtype_name: str):
+    """Min (sublane, lane) tile for a dtype name; f32's for unlisted
+    dtypes (i32/f64 tile like f32 — 4-byte lanes)."""
+    return TPU_TILE_SHAPES.get(dtype_name, TPU_TILE_SHAPES["float32"])
+
+
 # Named dataset presets mirroring the reference's edit-in-place dataset switch
 # (main3.cpp:308-313): each maps to (C, gamma).
 DATASET_PRESETS = {

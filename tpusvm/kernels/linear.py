@@ -23,7 +23,7 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-from tpusvm.ops.rbf import _prec, matmul_p
+from tpusvm.ops.rbf import _prec, coef_matvec, matmul_p
 
 
 def linear_row(X: jax.Array, x: jax.Array, precision=None) -> jax.Array:
@@ -74,7 +74,7 @@ def linear_cross_matvec(X: jax.Array, XB: jax.Array, coef: jax.Array, *,
         zero = jnp.zeros((), start.dtype)
         Xblk = jax.lax.dynamic_slice(X, (start, zero), (block, d))
         K = matmul_p(Xblk, XB.T, precision)
-        return None, K @ coef
+        return None, coef_matvec(K, coef, precision)
 
     starts = jnp.minimum(
         jnp.arange(nb, dtype=jnp.int32) * block, max(n - block, 0)

@@ -15,7 +15,7 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-from tpusvm.ops.rbf import _prec, matmul_p
+from tpusvm.ops.rbf import _prec, coef_matvec, matmul_p
 
 
 def _epilogue(dots: jax.Array, gamma, coef0, degree: int) -> jax.Array:
@@ -65,7 +65,8 @@ def poly_cross_matvec(X: jax.Array, XB: jax.Array, coef: jax.Array, gamma,
         zero = jnp.zeros((), start.dtype)
         Xblk = jax.lax.dynamic_slice(X, (start, zero), (block, d))
         dots = matmul_p(Xblk, XB.T, precision)
-        return None, _epilogue(dots, gamma, coef0, degree) @ coef
+        return None, coef_matvec(_epilogue(dots, gamma, coef0, degree),
+                                 coef, precision)
 
     starts = jnp.minimum(
         jnp.arange(nb, dtype=jnp.int32) * block, max(n - block, 0)

@@ -49,7 +49,7 @@ from tpusvm.config import SVMConfig, resolve_accum_dtype
 from tpusvm.data.scaler import MinMaxScaler
 from tpusvm.models.serialization import load_model, save_model
 from tpusvm.obs import prof
-from tpusvm.ops.rbf import sq_norms
+from tpusvm.ops.rbf import coef_matvec, sq_norms
 from tpusvm.solver.smo import smo_solve
 from tpusvm.status import Status
 
@@ -366,7 +366,7 @@ def _ovr_scores_jit(Xq, X_sv, coef, b, gamma, coef0=0.0, *, kernel="rbf",
     snB = sq_norms(X_sv) if kernels.needs_norms(kernel) else None
     K = kernels.cross(kernel, Xq, X_sv, gamma=gamma, coef0=coef0,
                       degree=degree, snB=snB)  # (m, n_sv)
-    return K @ coef.T - b[None, :]
+    return coef_matvec(K, coef.T) - b[None, :]
 
 
 # compile-observatory wrapper (tpusvm.obs.prof); serve's bucket cache

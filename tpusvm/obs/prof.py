@@ -189,6 +189,19 @@ def _has_tracer(args: tuple, kwargs: dict) -> bool:
                for x in jax.tree_util.tree_leaves((args, kwargs)))
 
 
+# ------------------------------------------------- jit entry-point registry
+# Every profiled_jit wrap records (jitted, static argnames) here, keyed by
+# its observatory name. This is the abstract-signature registry the IR
+# auditor (tpusvm.analysis.ir.entrypoints) enumerates: the auditor pairs
+# each registered jit object with a canonical set of abstract input
+# shapes/dtypes and walks the traced jaxpr, so "every jit entry point is
+# audited" stays true by construction — wrapping a new entry point with
+# profiled_jit is the same act that registers it for auditing. The static
+# tables themselves (_BLOCKED_STATIC / _SMO_STATIC / the predict statics)
+# stay deduplicated at their definition sites and flow through `static`.
+JIT_ENTRY_POINTS: Dict[str, Tuple[Any, tuple]] = {}
+
+
 # -------------------------------------------------------------- public API
 def profiled_call(name: str, fn, *args, static: tuple = (), **kwargs):
     """Call jit-compiled `fn`; route through the observatory when on.
@@ -217,6 +230,8 @@ def profiled_jit(name: str, jitted, static: tuple = ()):
     wrapper.lower = jitted.lower
     wrapper._profiled_name = name
     wrapper._jitted = jitted
+    # last definition wins, like the jit objects themselves on re-import
+    JIT_ENTRY_POINTS[name] = (jitted, tuple(static))
     return wrapper
 
 

@@ -98,6 +98,24 @@ def matmul_p(A: jax.Array, B: jax.Array, precision=None) -> jax.Array:
     return jnp.matmul(A, B, precision=_prec(p))
 
 
+def coef_matvec(K: jax.Array, coef: jax.Array, precision=None) -> jax.Array:
+    """K @ coef at the trust tier — the coefficient epilogue of every
+    kernel contraction (f updates, prediction scores, warm-start sums).
+
+    The ladder rungs apply to the STREAMED distance/dot contraction
+    (matmul_p): that is where the FLOPs and HBM traffic live. The
+    coefficient matvec that follows is O(rows * q) — noise next to the
+    O(rows * d * q) main contraction — so rounding it buys nothing and
+    costs accuracy; it runs at full f32 on every rung except an explicit
+    RAW_BF16 request. Routing it here (instead of a bare `K @ coef`,
+    whose dot_general carries jax's DEFAULT precision = raw single-pass
+    bf16 on TPU MXUs) is what the JXIR101 IR audit and the JX010 lint
+    rule enforce: no contraction reaches the MXU without an explicit
+    precision.
+    """
+    return jnp.matmul(K, coef, precision=_prec(_norm_prec(precision)))
+
+
 def _norm_prec(precision):
     """Precision for the row-norm prologues of a laddered contraction:
     the bf16 rungs keep their norms at the trust anchor (norms feed the
@@ -204,7 +222,7 @@ def rbf_cross_matvec(
         d2 = (snblk[:, None] + snB[None, :]
               - 2.0 * matmul_p(Xblk, XB.T, precision))
         d2 = jnp.maximum(d2, 0.0)
-        return None, jnp.exp(-gamma * d2) @ coef
+        return None, coef_matvec(jnp.exp(-gamma * d2), coef, precision)
 
     starts = jnp.minimum(
         jnp.arange(nb, dtype=jnp.int32) * block, max(n - block, 0)
@@ -244,7 +262,7 @@ def rbf_matvec(X: jax.Array, coef: jax.Array, gamma, block: int = 1024,
         d2 = (sn[:, None] + snj[None, :]
               - 2.0 * jnp.matmul(X, Xj.T, precision=_prec(precision)))
         d2 = jnp.maximum(d2, 0.0)
-        return acc + jnp.exp(-gamma * d2) @ cj, None
+        return acc + coef_matvec(jnp.exp(-gamma * d2), cj, precision), None
 
     acc0 = jnp.zeros((n,), X.dtype)
     acc, _ = jax.lax.scan(step, acc0, (Xb, cb, snb))

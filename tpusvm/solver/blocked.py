@@ -47,7 +47,7 @@ from tpusvm import kernels
 from tpusvm.config import RAW_BF16, pallas_flag_errors
 from tpusvm.obs import prof
 from tpusvm.obs.convergence import ConvergenceTelemetry
-from tpusvm.ops.rbf import sq_norms
+from tpusvm.ops.rbf import coef_matvec, sq_norms
 from tpusvm.ops.selection import i_high_mask, i_low_mask
 from tpusvm.solver.analytic import pair_update
 from tpusvm.solver.smo import SMOResult
@@ -1059,7 +1059,8 @@ def _blocked_smo_solve_jit(
 
                 def from_cache(cache, keys, age):
                     rows = cache[slot_of]  # (q, n) gather, no X stream
-                    df = (rows.T @ dc32_cached).astype(adt)
+                    df = coef_matvec(rows.T, dc32_cached,
+                                     ops_precision).astype(adt)
                     age = (age + 1).at[jnp.where(hit, slot_of, 0)].min(
                         jnp.where(hit, 0, jnp.int32(2 ** 30)))
                     return (df, cache, keys, age,
@@ -1070,7 +1071,8 @@ def _blocked_smo_solve_jit(
                         kernel, X, B, gamma=gamma, coef0=coef0,
                         degree=degree, sn=sn, precision=ops_precision,
                     ).astype(jnp.float32)
-                    df = (rows.T @ dc32).astype(adt)
+                    df = coef_matvec(rows.T, dc32,
+                                     ops_precision).astype(adt)
                     # evict empty-first, then oldest: top_k picks q
                     # DISTINCT slots, so the q-row insert cannot collide
                     score = jnp.where(keys < 0, jnp.int32(2 ** 30), age)

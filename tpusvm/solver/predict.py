@@ -21,7 +21,7 @@ import jax.numpy as jnp
 
 from tpusvm import kernels
 from tpusvm.obs import prof
-from tpusvm.ops.rbf import sq_norms
+from tpusvm.ops.rbf import coef_matvec, sq_norms
 
 
 _DECISION_STATIC = ("gamma", "block", "kernel", "degree", "coef0")
@@ -57,7 +57,7 @@ def _decision_function_jit(
     def step(_, Xb):
         K = kernels.cross(kernel, Xb, X_train, gamma=gamma, coef0=coef0,
                           degree=degree, snB=sn_train)
-        return None, K @ coef
+        return None, coef_matvec(K, coef)
 
     _, scores = jax.lax.scan(step, None, Xp.reshape(nb, block, d))
     return scores.reshape(-1)[:m] - b
@@ -93,7 +93,7 @@ def _decision_function_flat_jit(
     snB = sq_norms(X_train) if kernels.needs_norms(kernel) else None
     K = kernels.cross(kernel, X_test, X_train, gamma=gamma, coef0=coef0,
                       degree=degree, snB=snB)
-    return K @ coef - b
+    return coef_matvec(K, coef) - b
 
 
 # compile-observatory wrappers (tpusvm.obs.prof): the jit call when

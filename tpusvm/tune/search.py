@@ -42,7 +42,7 @@ import numpy as np
 from tpusvm import kernels as _kernels
 from tpusvm.config import SVMConfig, resolve_accum_dtype
 from tpusvm.data.scaler import MinMaxScaler
-from tpusvm.ops.rbf import sq_norms
+from tpusvm.ops.rbf import coef_matvec, sq_norms
 from tpusvm.solver.blocked import blocked_smo_solve
 from tpusvm.status import Status, TuneStatus
 from tpusvm.tune.folds import Fold, stratified_kfold
@@ -301,7 +301,7 @@ def tune(
                     snB=c.sn[:m] if rbf else None,
                 )
                 scores = np.asarray(
-                    K_val @ coef - jnp.asarray(res.b, dtype)
+                    coef_matvec(K_val, coef) - jnp.asarray(res.b, dtype)
                 )
                 pred = np.where(scores > 0, 1, -1)
                 accs.append(float((pred == c.Yval).mean()))
