@@ -1,0 +1,45 @@
+"""Known-good concurrency corpus: a thread-owning class doing everything
+the JXC rules demand — guarded shared writes, one global lock order,
+no blocking under locks, daemon worker + join ownership on close, timed
+waits with checked results, Condition.wait in a predicate loop."""
+
+import queue
+import threading
+
+
+class GoodWorker:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._q = queue.Queue(maxsize=8)
+        self._done = threading.Event()
+        self.count = 0
+        self.items = []
+        self._t = threading.Thread(target=self._run, daemon=True)
+        self._t.start()
+
+    def _run(self):
+        while not self._done.is_set():
+            try:
+                item = self._q.get(timeout=0.05)
+            except queue.Empty:
+                continue
+            with self._lock:
+                self.count += 1
+                self.items.append(item)
+
+    def submit(self, item, timeout=1.0):
+        self._q.put(item, timeout=timeout)
+
+    def wait_quiet(self, n, timeout=1.0):
+        with self._cond:
+            while self.count < n:
+                if not self._cond.wait(timeout):
+                    return False
+        return True
+
+    def close(self, timeout=1.0):
+        self._done.set()
+        self._t.join(timeout=timeout)
+        if not self._done.wait(timeout):
+            raise RuntimeError("worker did not acknowledge shutdown")

@@ -1,0 +1,17 @@
+"""JXC201 corpus: shared attribute written outside any lock in a
+thread-spawning class. The worker mutates `self.count` with no guard
+while clients can read/write it concurrently."""
+
+import threading
+
+
+class Worker:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.count = 0
+        self._t = threading.Thread(target=self._run, daemon=True)
+        self._t.start()
+
+    def _run(self):
+        for _ in range(100):
+            self.count += 1  # BAD: unguarded write to shared state

@@ -1,0 +1,22 @@
+"""JXC202 corpus: two methods acquire the same pair of locks in
+opposite orders — two threads on the opposing paths deadlock."""
+
+import threading
+
+
+class Transfer:
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+        self.x = 0
+        self.y = 0
+
+    def a_then_b(self):
+        with self._a:
+            with self._b:  # BAD: A -> B here ...
+                self.x += 1
+
+    def b_then_a(self):
+        with self._b:
+            with self._a:  # BAD: ... B -> A there
+                self.y += 1

@@ -1,0 +1,16 @@
+"""JXC203 corpus: a blocking call (time.sleep) inside a guarded region
+— every thread contending for the lock stalls behind the sleeper."""
+
+import threading
+import time
+
+
+class Poller:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.last = 0.0
+
+    def tick(self):
+        with self._lock:
+            time.sleep(0.1)  # BAD: blocks while holding the lock
+            self.last = time.monotonic()

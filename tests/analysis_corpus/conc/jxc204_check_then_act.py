@@ -1,0 +1,19 @@
+"""JXC204 corpus: non-atomic check-then-act. The predicate is read
+under the lock, the decision is taken OUTSIDE it, and the write happens
+under a fresh acquisition — the state may have changed in between."""
+
+import threading
+
+
+class Budget:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.remaining = 10
+
+    def take(self):
+        with self._lock:
+            ok = self.remaining > 0
+        if ok:
+            with self._lock:  # BAD: reacquired; `remaining` may be 0 now
+                self.remaining -= 1
+        return ok

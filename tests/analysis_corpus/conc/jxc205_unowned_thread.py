@@ -1,0 +1,10 @@
+"""JXC205 corpus: thread created without daemon= and never joined — it
+outlives interpreter shutdown intent and leaks past test teardown."""
+
+import threading
+
+
+def launch(fn):
+    t = threading.Thread(target=fn)  # BAD: no daemon=, no join ownership
+    t.start()
+    return t

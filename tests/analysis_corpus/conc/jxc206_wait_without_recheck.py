@@ -1,0 +1,16 @@
+"""JXC206 corpus: timed Event.wait with the result discarded — on
+timeout the event is NOT set, but execution proceeds as if it were."""
+
+import threading
+
+
+class Gate:
+    def __init__(self):
+        self._ready = threading.Event()
+
+    def open(self):
+        self._ready.set()
+
+    def wait_ready(self):
+        self._ready.wait(1.0)  # BAD: timeout result ignored
+        return True

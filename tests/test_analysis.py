@@ -330,12 +330,23 @@ def test_ci_lint_sweep_covers_all_roots():
         encoding="utf-8")
     lint_lines = [ln for ln in ci.splitlines()
                   if "python -m tpusvm.analysis" in ln
-                  and "ir-audit" not in ln]
+                  and "ir-audit" not in ln
+                  and "analysis conc" not in ln]
     assert lint_lines, "CI has no tpusvm-lint invocation"
     sweep = " ".join(lint_lines)
     for root in ("tpusvm/", "benchmarks/", "scripts/", "bench.py"):
         assert root in sweep, (
             f"CI lint sweep is missing the {root} root: {sweep!r}")
+    # the concurrency linter (tpusvm/analysis/conc) sweeps the SAME
+    # roots — a root added to one sweep but not the other would let
+    # threading hazards land unlinted (test_conc.py pins the rest of
+    # the conc CI wiring)
+    conc_lines = [ln for ln in ci.splitlines()
+                  if "tpusvm.analysis conc " in ln]
+    conc_sweep = " ".join(conc_lines)
+    for root in ("tpusvm/", "benchmarks/", "scripts/", "bench.py"):
+        assert root in conc_sweep, (
+            f"CI conc sweep is missing the {root} root: {conc_sweep!r}")
 
 
 def test_ci_self_corpus_expects_every_rule():

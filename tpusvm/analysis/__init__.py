@@ -35,6 +35,15 @@ provenance, loop-carry stability, TPU tile alignment, loop-body host
 callbacks, and weak-scalar recompile hazards at the IR the compiler
 actually solves. That subcommand DOES need jax; everything else here
 stays accelerator-free.
+
+`python -m tpusvm.analysis conc` runs the lock-discipline linter
+(tpusvm.analysis.conc, rules JXC201-206) over the host-side threading
+layer — unguarded shared writes, lock-order cycles, blocking calls
+under locks, check-then-act reacquisition, unowned threads, unchecked
+waits — with its own empty-committed baseline; `conc-stress` is its
+dynamic arm, a seeded schedule-perturbation race harness over the real
+threaded objects (needs numpy/jax; any violation reports the
+reproducing seed).
 """
 
 from tpusvm.analysis.core import Finding  # noqa: F401

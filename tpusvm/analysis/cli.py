@@ -7,6 +7,11 @@ text — so the CI lint job runs without accelerator deps installed.
 `python -m tpusvm.analysis ir-audit [...]` dispatches to the jaxpr-level
 semantic auditor (tpusvm.analysis.ir — rules JXIR101-106), which DOES
 need jax and runs in the CI test job on JAX_PLATFORMS=cpu.
+
+`python -m tpusvm.analysis conc [...]` dispatches to the lock-discipline
+linter (tpusvm.analysis.conc — rules JXC201-206, stdlib-only like this
+one); `conc-stress [...]` runs its seeded schedule-perturbation race
+harness against the real threaded objects (test-job, needs numpy/jax).
 """
 
 from __future__ import annotations
@@ -66,6 +71,21 @@ def main(argv=None) -> int:
         from tpusvm.analysis.ir.cli import main as ir_main
 
         return ir_main(argv[1:])
+    if argv and argv[0] == "conc":
+        # the lock-discipline linter (rules JXC201-206) — separate
+        # subcommand with its own baseline (.tpusvm-conc-baseline.json);
+        # pure stdlib like this linter, so it also runs in the no-jax
+        # lint job
+        from tpusvm.analysis.conc.cli import main as conc_main
+
+        return conc_main(argv[1:])
+    if argv and argv[0] == "conc-stress":
+        # the dynamic arm: seeded schedule-perturbation suites over the
+        # real threaded objects (imports serve/stream/obs/faults, which
+        # pull numpy + jax — test-job territory, like ir-audit)
+        from tpusvm.analysis.conc.cli import stress_main
+
+        return stress_main(argv[1:])
 
     args = build_parser().parse_args(argv)
 
@@ -78,6 +98,11 @@ def main(argv=None) -> int:
 
         for rid, summary in sorted(IR_RULE_SUMMARIES.items()):
             print(f"{rid}  {summary}  [ir-audit]")
+        # likewise the lock-discipline rules (the `conc` subcommand)
+        from tpusvm.analysis.conc.rules import CONC_RULE_SUMMARIES
+
+        for rid, summary in sorted(CONC_RULE_SUMMARIES.items()):
+            print(f"{rid}  {summary}  [conc]")
         return 0
 
     select = _parse_rule_list(args.select) or None

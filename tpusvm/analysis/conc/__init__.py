@@ -1,0 +1,35 @@
+"""tpusvm.analysis.conc — the two-armed concurrency auditor.
+
+Static arm (``python -m tpusvm.analysis conc``): an AST pass that builds
+a per-class concurrency model — attributes assigned in ``__init__``,
+lock/semaphore/condition/event/queue fields, ``with self._lock:``
+guarded regions, methods reachable from ``threading.Thread`` targets —
+and reports the lock-discipline rules JXC201-206 with the shared Finding
+type, reporters and fingerprinted baseline
+(``.tpusvm-conc-baseline.json``, committed EMPTY). Pure stdlib, no jax.
+
+Dynamic arm (``python -m tpusvm.analysis conc-stress``): a deterministic
+schedule-perturbation harness — seeded lock/queue/semaphore wrappers
+inject yields and micro-sleeps at acquire/release/handoff points —
+driven against the four real hot objects (obs MetricsRegistry, serve
+MicroBatcher, stream ShardReader, faults CircuitBreaker) with their own
+invariants asserted; any violation reports the reproducing seed.
+"""
+
+from tpusvm.analysis.conc.lint import (  # noqa: F401
+    conc_lint_file,
+    conc_lint_paths,
+    conc_lint_source,
+)
+from tpusvm.analysis.conc.rules import (  # noqa: F401
+    CONC_RULE_SUMMARIES,
+    all_conc_rules,
+)
+
+__all__ = [
+    "CONC_RULE_SUMMARIES",
+    "all_conc_rules",
+    "conc_lint_file",
+    "conc_lint_paths",
+    "conc_lint_source",
+]

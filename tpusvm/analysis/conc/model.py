@@ -1,0 +1,284 @@
+"""Per-class concurrency model extracted from one module's AST.
+
+The JXC rules reason about a small, explicit vocabulary that this module
+computes once per file (reusing the alias resolution of the tracing
+linter's ModuleContext so `import threading as T` and
+`from threading import Lock` both resolve):
+
+  * which attributes a class initialises in ``__init__`` (its shared
+    state — anything a spawned thread can reach through ``self``);
+  * which of those attributes are synchronisation primitives
+    (Lock/RLock/Semaphore/Condition/Event), queues, or Thread objects;
+  * which source regions hold which locks (``with self._lock:`` blocks,
+    including nesting — the input to the lock-order graph);
+  * which methods run on a spawned thread (``threading.Thread(
+    target=self.x)`` targets, closed over the ``self.y()`` call graph,
+    so a helper called only from the worker is worker-side too).
+
+The model is a lexical approximation in the same spirit as the tracing
+taint model: it does not follow values across classes or modules, and a
+lock acquired via explicit ``.acquire()``/``.release()`` pairs (rather
+than ``with``) is not credited as a guard — both documented limits that
+keep the false-positive rate workable on this repo.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Dict, List, Optional, Set, Tuple
+
+from tpusvm.analysis.context import ModuleContext
+
+# factory call -> primitive kind; resolved through the module's aliases
+SYNC_FACTORIES = {
+    "threading.Lock": "lock",
+    "threading.RLock": "lock",
+    "threading.Semaphore": "semaphore",
+    "threading.BoundedSemaphore": "semaphore",
+    "threading.Condition": "condition",
+    "threading.Event": "event",
+}
+
+QUEUE_FACTORIES = {
+    "queue.Queue",
+    "queue.LifoQueue",
+    "queue.PriorityQueue",
+    "queue.SimpleQueue",
+}
+
+THREAD_FACTORY = "threading.Thread"
+
+
+def _self_attr(node: ast.AST) -> Optional[str]:
+    """'x' when node is `self.x`, else None."""
+    if isinstance(node, ast.Attribute) and \
+            isinstance(node.value, ast.Name) and node.value.id == "self":
+        return node.attr
+    return None
+
+
+@dataclasses.dataclass
+class LockEdge:
+    """`with self.outer:` lexically encloses `with self.inner:`."""
+
+    outer: str
+    inner: str
+    node: ast.With
+
+
+@dataclasses.dataclass
+class ClassConcModel:
+    """Everything the JXC rules need to know about one class."""
+
+    node: ast.ClassDef
+    name: str
+    init_attrs: Dict[str, int]          # attr -> lineno first set in __init__
+    sync_fields: Dict[str, str]         # attr -> lock|semaphore|condition|event
+    queue_fields: Set[str]
+    thread_fields: Set[str]             # attrs assigned from threading.Thread
+    thread_targets: Set[str]            # method names passed as Thread target=
+    spawns_threads: bool
+    methods: Dict[str, ast.FunctionDef]
+    # id(ast node) -> frozenset of lock-field names held at that node
+    locks_held: Dict[int, frozenset]
+    lock_edges: List[LockEdge]
+    worker_methods: Set[str]            # thread targets + their self-call closure
+
+    def attr_kind(self, attr: str) -> Optional[str]:
+        if attr in self.sync_fields:
+            return self.sync_fields[attr]
+        if attr in self.queue_fields:
+            return "queue"
+        if attr in self.thread_fields:
+            return "thread"
+        return None
+
+
+class ConcModel:
+    """Module-level concurrency model: one ClassConcModel per class, plus
+    the module-wide attr-name -> primitive-kind map that lets rules type
+    `req.event.wait(...)` when `event` is an Event field of ANOTHER class
+    in the same file (the batcher's per-request events are the motivating
+    case)."""
+
+    def __init__(self, ctx: ModuleContext):
+        self.ctx = ctx
+        self.parents: Dict[int, ast.AST] = {}
+        for parent in ast.walk(ctx.tree):
+            for child in ast.iter_child_nodes(parent):
+                self.parents[id(child)] = parent
+        self.classes: List[ClassConcModel] = []
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.ClassDef):
+                self.classes.append(self._build_class(node))
+        # attr name -> kind, across every class in the module (collisions
+        # keep the first kind seen; names are overwhelmingly consistent)
+        self.module_attr_kinds: Dict[str, str] = {}
+        for cm in self.classes:
+            for attr, kind in cm.sync_fields.items():
+                self.module_attr_kinds.setdefault(attr, kind)
+
+    # ------------------------------------------------------------- helpers
+    def parent_chain(self, node: ast.AST):
+        cur = self.parents.get(id(node))
+        while cur is not None:
+            yield cur
+            cur = self.parents.get(id(cur))
+
+    def enclosing_function(self, node: ast.AST) -> Optional[ast.AST]:
+        for p in self.parent_chain(node):
+            if isinstance(p, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.Lambda)):
+                return p
+        return None
+
+    def is_statement_expr(self, call: ast.Call) -> bool:
+        """True when the call's value is discarded (a bare Expr stmt)."""
+        parent = self.parents.get(id(call))
+        return isinstance(parent, ast.Expr)
+
+    def in_while_loop(self, node: ast.AST) -> bool:
+        fn = self.enclosing_function(node)
+        for p in self.parent_chain(node):
+            if p is fn:
+                return False
+            if isinstance(p, (ast.While, ast.For)):
+                return True
+        return False
+
+    # -------------------------------------------------------- class model
+    def _build_class(self, cls: ast.ClassDef) -> ClassConcModel:
+        ctx = self.ctx
+        methods: Dict[str, ast.FunctionDef] = {}
+        for stmt in cls.body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                methods[stmt.name] = stmt
+
+        init_attrs: Dict[str, int] = {}
+        sync_fields: Dict[str, str] = {}
+        queue_fields: Set[str] = set()
+        thread_fields: Set[str] = set()
+        init = methods.get("__init__")
+        if init is not None:
+            for node in ast.walk(init):
+                if isinstance(node, ast.Assign):
+                    targets = node.targets
+                elif isinstance(node, (ast.AnnAssign, ast.AugAssign)):
+                    targets = [node.target]
+                else:
+                    continue
+                for t in targets:
+                    attr = _self_attr(t)
+                    if attr is None:
+                        continue
+                    init_attrs.setdefault(attr, node.lineno)
+                    if isinstance(node, ast.Assign) or \
+                            (isinstance(node, ast.AnnAssign) and node.value):
+                        value = node.value
+                        resolved = (ctx.resolve_call(value)
+                                    if isinstance(value, ast.Call) else None)
+                        if resolved in SYNC_FACTORIES:
+                            sync_fields[attr] = SYNC_FACTORIES[resolved]
+                        elif resolved in QUEUE_FACTORIES:
+                            queue_fields.add(attr)
+                        elif resolved == THREAD_FACTORY:
+                            thread_fields.add(attr)
+
+        spawns = False
+        thread_targets: Set[str] = set()
+        for node in ast.walk(cls):
+            if isinstance(node, ast.Call) and \
+                    ctx.resolve_call(node) == THREAD_FACTORY:
+                spawns = True
+                for kw in node.keywords:
+                    if kw.arg == "target":
+                        target = _self_attr(kw.value)
+                        if target is not None:
+                            thread_targets.add(target)
+
+        locks_held: Dict[int, frozenset] = {}
+        lock_edges: List[LockEdge] = []
+        for m in methods.values():
+            self._walk_guards(m, frozenset(), locks_held, lock_edges)
+
+        # worker closure: thread targets + every method reachable from one
+        # through self.<method>() calls
+        worker = set(thread_targets)
+        frontier = list(worker)
+        while frontier:
+            name = frontier.pop()
+            m = methods.get(name)
+            if m is None:
+                continue
+            for node in ast.walk(m):
+                if isinstance(node, ast.Call):
+                    callee = _self_attr(node.func)
+                    if callee in methods and callee not in worker:
+                        worker.add(callee)
+                        frontier.append(callee)
+
+        return ClassConcModel(
+            node=cls, name=cls.name, init_attrs=init_attrs,
+            sync_fields=sync_fields, queue_fields=queue_fields,
+            thread_fields=thread_fields, thread_targets=thread_targets,
+            spawns_threads=spawns, methods=methods,
+            locks_held=locks_held, lock_edges=lock_edges,
+            worker_methods=worker,
+        )
+
+    def _walk_guards(self, node: ast.AST, held: frozenset,
+                     locks_held: Dict[int, frozenset],
+                     edges: List[LockEdge]) -> None:
+        """Record the set of `with self.X:`-held locks at every node."""
+        locks_held[id(node)] = held
+        children_held = held
+        if isinstance(node, ast.With):
+            acquired = []
+            for item in node.items:
+                attr = _self_attr(item.context_expr)
+                if attr is not None:
+                    acquired.append(attr)
+            if acquired:
+                for outer in held:
+                    for inner in acquired:
+                        if inner != outer:
+                            edges.append(LockEdge(outer, inner, node))
+                children_held = held | frozenset(acquired)
+        for child in ast.iter_child_nodes(node):
+            # nested defs start lock-free: a closure runs when called,
+            # not where it is defined
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Lambda)):
+                self._walk_guards(child, frozenset(), locks_held, edges)
+            else:
+                self._walk_guards(child, children_held, locks_held, edges)
+
+
+def attr_writes(fn: ast.AST) -> List[Tuple[str, ast.AST]]:
+    """(attr, assignment-node) for every `self.attr = ...` /
+    `self.attr op= ...` in `fn` (nested defs included — they still touch
+    the same object)."""
+    out: List[Tuple[str, ast.AST]] = []
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Assign):
+            targets = node.targets
+        elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+            targets = [node.target]
+        else:
+            continue
+        for t in targets:
+            attr = _self_attr(t)
+            if attr is not None:
+                out.append((attr, node))
+    return out
+
+
+def attr_reads(root: ast.AST) -> Set[str]:
+    """Attr names of every `self.attr` LOAD under `root`."""
+    out: Set[str] = set()
+    for node in ast.walk(root):
+        attr = _self_attr(node)
+        if attr is not None and isinstance(node.ctx, ast.Load):
+            out.add(attr)
+    return out

@@ -1,0 +1,377 @@
+"""JXC201-206 — the lock-discipline rules over the per-class model.
+
+The host-side threading layer (serve's micro-batcher + HTTP workers,
+stream's prefetch producer, tune's fold pool, the circuit breaker, the
+obs registry/tracer) is hand-rolled ``threading`` plumbing; these rules
+machine-check the disciplines that code relies on, the way JX001-010
+check tracing discipline and JXIR101-106 check the emitted IR:
+
+  JXC201  shared mutable attribute written outside any lock in a
+          thread-spawning class
+  JXC202  lock-acquisition-order cycle across methods (potential
+          deadlock)
+  JXC203  blocking call while holding a lock (queue get/put, join,
+          Semaphore.acquire, Event.wait, time.sleep, HTTP, device
+          block_until_ready)
+  JXC204  non-atomic check-then-act: read under a lock, decide, write
+          under a REACQUIRED lock
+  JXC205  thread created without daemon= and without join ownership
+  JXC206  Event/Condition wait without a predicate re-check
+
+Suppression: the shared ``# tpusvm: disable=JXC20x`` comments work, but
+the idiomatic form is ``# tpusvm: guarded-by=<invariant>`` — it
+suppresses the JXC finding on its line AND forces the author to name the
+invariant that makes the code safe (single-writer confinement, one-way
+latch, GIL-atomic store, ...). An empty invariant is not a suppression.
+
+These rules live in their own registry (``all_conc_rules``) and run
+under ``python -m tpusvm.analysis conc`` with their own baseline
+(``.tpusvm-conc-baseline.json``) — the tracing linter's default sweep is
+unchanged. Like the AST linter, this module is pure stdlib and imports
+no JAX; the no-jax CI lint job lists and runs it.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set
+
+from tpusvm.analysis.conc.model import (
+    ConcModel,
+    _self_attr,
+    attr_reads,
+    attr_writes,
+)
+from tpusvm.analysis.core import Finding, snippet_at
+from tpusvm.analysis.registry import Rule
+
+CONC_RULES: Dict[str, Rule] = {}
+
+
+def conc_register(cls):
+    inst = cls()
+    if not inst.id:
+        raise ValueError(f"conc rule {cls.__name__} has no id")
+    if inst.id in CONC_RULES:
+        raise ValueError(f"duplicate conc rule id {inst.id}")
+    CONC_RULES[inst.id] = inst
+    return cls
+
+
+def all_conc_rules() -> Dict[str, Rule]:
+    return dict(sorted(CONC_RULES.items()))
+
+
+CONC_RULE_SUMMARIES = {
+    "JXC201": ("shared mutable attribute written outside any lock in a "
+               "thread-spawning class"),
+    "JXC202": ("lock-acquisition-order cycle across methods — two code "
+               "paths take the same locks in opposite orders (potential "
+               "deadlock)"),
+    "JXC203": ("blocking call (queue get/put, join, Semaphore.acquire, "
+               "Event.wait, time.sleep, HTTP, block_until_ready) while "
+               "holding a lock"),
+    "JXC204": ("non-atomic check-then-act: state read under a lock, "
+               "decision taken, then written under a REACQUIRED lock"),
+    "JXC205": ("thread created without daemon= and without join "
+               "ownership (leaks past interpreter exit / test teardown)"),
+    "JXC206": ("Event/Condition wait without a predicate re-check "
+               "(unchecked timed-wait result, or Condition.wait outside "
+               "a while loop)"),
+}
+
+
+def _finding(rule_id: str, ctx, node: ast.AST, message: str) -> Finding:
+    return Finding(
+        rule=rule_id, path=ctx.path, line=node.lineno,
+        col=node.col_offset + 1, message=message,
+        snippet=snippet_at(ctx.lines, node.lineno),
+    )
+
+
+# --------------------------------------------------------------- JXC201
+@conc_register
+class UnguardedSharedWrite(Rule):
+    id = "JXC201"
+    summary = CONC_RULE_SUMMARIES["JXC201"]
+
+    def check_model(self, model: ConcModel):
+        ctx = model.ctx
+        for cm in model.classes:
+            if not cm.spawns_threads:
+                continue
+            for name, method in cm.methods.items():
+                if name == "__init__":
+                    # construction happens-before the spawned thread's
+                    # first read (Thread.start is a fence)
+                    continue
+                for attr, node in attr_writes(method):
+                    if attr not in cm.init_attrs:
+                        continue
+                    if cm.attr_kind(attr) is not None:
+                        continue  # the primitive itself, not guarded state
+                    if cm.locks_held.get(id(node)):
+                        continue
+                    side = ("worker" if name in cm.worker_methods
+                            else "client")
+                    yield _finding(
+                        self.id, ctx, node,
+                        f"shared attribute {attr!r} (initialised in "
+                        f"__init__) is written without holding a lock in "
+                        f"{cm.name}.{name} ({side}-side) while the class "
+                        f"spawns threads (targets: "
+                        f"{sorted(cm.thread_targets) or '?'}); guard the "
+                        "write or annotate the invariant with "
+                        "`# tpusvm: guarded-by=...`",
+                    )
+
+
+# --------------------------------------------------------------- JXC202
+@conc_register
+class LockOrderCycle(Rule):
+    id = "JXC202"
+    summary = CONC_RULE_SUMMARIES["JXC202"]
+
+    def check_model(self, model: ConcModel):
+        ctx = model.ctx
+        for cm in model.classes:
+            adj: Dict[str, Set[str]] = {}
+            for e in cm.lock_edges:
+                adj.setdefault(e.outer, set()).add(e.inner)
+
+            def reaches(src: str, dst: str) -> bool:
+                seen, stack = set(), [src]
+                while stack:
+                    cur = stack.pop()
+                    if cur == dst:
+                        return True
+                    if cur in seen:
+                        continue
+                    seen.add(cur)
+                    stack.extend(adj.get(cur, ()))
+                return False
+
+            reported = set()
+            for e in cm.lock_edges:
+                if (e.outer, e.inner) in reported:
+                    continue
+                if reaches(e.inner, e.outer):
+                    reported.add((e.outer, e.inner))
+                    yield _finding(
+                        self.id, ctx, e.node,
+                        f"{cm.name} acquires {e.inner!r} while holding "
+                        f"{e.outer!r}, but another path acquires them in "
+                        "the opposite order — two threads on the "
+                        "opposing paths deadlock; pick one global "
+                        "acquisition order",
+                    )
+
+
+# --------------------------------------------------------------- JXC203
+_SLEEP_CALLS = {"time.sleep"}
+_HTTP_CALLS = {
+    "urllib.request.urlopen",
+    "requests.get", "requests.post", "requests.put", "requests.request",
+    "http.client.HTTPConnection", "socket.create_connection",
+}
+
+
+@conc_register
+class BlockingUnderLock(Rule):
+    id = "JXC203"
+    summary = CONC_RULE_SUMMARIES["JXC203"]
+
+    def _blocking_reason(self, cm, ctx, node: ast.Call,
+                         held: frozenset) -> Optional[str]:
+        resolved = ctx.resolve_call(node)
+        if resolved in _SLEEP_CALLS:
+            return "time.sleep blocks the holder"
+        if resolved in _HTTP_CALLS:
+            return f"{resolved} does network I/O"
+        if not isinstance(node.func, ast.Attribute):
+            return None
+        meth = node.func.attr
+        recv_attr = _self_attr(node.func.value)
+        if meth == "block_until_ready":
+            return "device sync (block_until_ready) stalls on the accelerator"
+        if recv_attr is None:
+            return None
+        kind = cm.attr_kind(recv_attr)
+        if meth in ("get", "put") and kind == "queue":
+            # block=False is the non-blocking spelling of get/put
+            if any(kw.arg == "block" and isinstance(kw.value, ast.Constant)
+                   and kw.value.value is False for kw in node.keywords):
+                return None
+            return (f"queue.{meth} on self.{recv_attr} can block "
+                    "indefinitely")
+        if meth == "acquire" and kind in ("lock", "semaphore", "condition"):
+            return (f"{kind}.acquire on self.{recv_attr} blocks while a "
+                    "lock is held")
+        if meth == "join" and kind == "thread":
+            return f"joining self.{recv_attr} blocks on another thread"
+        if meth == "wait" and kind == "event":
+            return (f"Event.wait on self.{recv_attr} blocks; unlike "
+                    "Condition.wait it does NOT release the held lock")
+        if meth == "wait" and kind == "condition" and recv_attr not in held:
+            # waiting on a DIFFERENT condition than the held lock keeps
+            # the held lock across the sleep; cond.wait on the held
+            # condition is the correct pattern (it releases)
+            return (f"Condition.wait on self.{recv_attr} while holding a "
+                    "different lock")
+        return None
+
+    def check_model(self, model: ConcModel):
+        ctx = model.ctx
+        for cm in model.classes:
+            for method in cm.methods.values():
+                for node in ast.walk(method):
+                    if not isinstance(node, ast.Call):
+                        continue
+                    held = cm.locks_held.get(id(node)) or frozenset()
+                    if not held:
+                        continue
+                    reason = self._blocking_reason(cm, ctx, node, held)
+                    if reason:
+                        yield _finding(
+                            self.id, ctx, node,
+                            f"blocking call while holding "
+                            f"{sorted(held)}: {reason} — every other "
+                            "thread contending for the lock stalls "
+                            "behind it; move the blocking call outside "
+                            "the guarded region",
+                        )
+
+
+# --------------------------------------------------------------- JXC204
+@conc_register
+class CheckThenActReacquire(Rule):
+    id = "JXC204"
+    summary = CONC_RULE_SUMMARIES["JXC204"]
+
+    def check_model(self, model: ConcModel):
+        ctx = model.ctx
+        for cm in model.classes:
+            for method in cm.methods.values():
+                # with-blocks in source order, per lock field
+                blocks: Dict[str, List[ast.With]] = {}
+                for node in ast.walk(method):
+                    if not isinstance(node, ast.With):
+                        continue
+                    for item in node.items:
+                        attr = _self_attr(item.context_expr)
+                        if attr is not None:
+                            blocks.setdefault(attr, []).append(node)
+                for lock, withs in blocks.items():
+                    if len(withs) < 2:
+                        continue
+                    withs.sort(key=lambda w: w.lineno)
+                    for i, early in enumerate(withs):
+                        read = attr_reads(early)
+                        read -= set(cm.sync_fields) | cm.queue_fields
+                        if not read:
+                            continue
+                        for late in withs[i + 1:]:
+                            writes = {a for a, _ in attr_writes(late)}
+                            stale = read & writes
+                            if not stale:
+                                continue
+                            if self._rechecks(late, stale):
+                                continue
+                            yield _finding(
+                                self.id, ctx, late,
+                                f"check-then-act across reacquisition of "
+                                f"self.{lock}: {sorted(stale)} read under "
+                                "the lock above, decided on, then "
+                                "written here under a fresh acquisition "
+                                "— the state may have changed in "
+                                "between; re-check the predicate under "
+                                "THIS lock or hold it across the "
+                                "decision",
+                            )
+
+    @staticmethod
+    def _rechecks(block: ast.With, attrs: Set[str]) -> bool:
+        """A test inside the later block that re-reads the attr is the
+        correct double-checked pattern — not a finding."""
+        for node in ast.walk(block):
+            if isinstance(node, (ast.If, ast.While)) and \
+                    attr_reads(node.test) & attrs:
+                return True
+        return False
+
+
+# --------------------------------------------------------------- JXC205
+@conc_register
+class UnownedThread(Rule):
+    id = "JXC205"
+    summary = CONC_RULE_SUMMARIES["JXC205"]
+
+    def check_model(self, model: ConcModel):
+        ctx = model.ctx
+        for node in ast.walk(ctx.tree):
+            if not (isinstance(node, ast.Call)
+                    and ctx.resolve_call(node) == "threading.Thread"):
+                continue
+            if any(kw.arg == "daemon" for kw in node.keywords):
+                continue
+            scope = model.enclosing_function(node) or ctx.tree
+            if self._scope_joins(scope):
+                continue
+            yield _finding(
+                self.id, ctx, node,
+                "thread created without daemon= and never joined in its "
+                "owning scope — it outlives interpreter shutdown intent "
+                "and leaks past test teardown; pass daemon=True or own "
+                "its lifetime with join()",
+            )
+
+    @staticmethod
+    def _scope_joins(scope: ast.AST) -> bool:
+        """Any `<name>.join(...)` in the scope counts as join ownership
+        (str.join on literals does not)."""
+        for node in ast.walk(scope):
+            if isinstance(node, ast.Call) and \
+                    isinstance(node.func, ast.Attribute) and \
+                    node.func.attr == "join" and \
+                    not isinstance(node.func.value,
+                                   (ast.Constant, ast.JoinedStr)):
+                return True
+        return False
+
+
+# --------------------------------------------------------------- JXC206
+@conc_register
+class WaitWithoutRecheck(Rule):
+    id = "JXC206"
+    summary = CONC_RULE_SUMMARIES["JXC206"]
+
+    def check_model(self, model: ConcModel):
+        ctx = model.ctx
+        for node in ast.walk(ctx.tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "wait"):
+                continue
+            recv = node.func.value
+            attr = recv.attr if isinstance(recv, ast.Attribute) else None
+            if attr is None:
+                continue
+            kind = model.module_attr_kinds.get(attr)
+            if kind == "condition":
+                if not model.in_while_loop(node):
+                    yield _finding(
+                        self.id, ctx, node,
+                        f"Condition.wait on {attr!r} outside a while "
+                        "loop — wakeups are advisory (spurious wakeup / "
+                        "stolen predicate); loop on the predicate: "
+                        "`while not pred: cond.wait()`",
+                    )
+            elif kind == "event":
+                if node.args and model.is_statement_expr(node):
+                    yield _finding(
+                        self.id, ctx, node,
+                        f"timed Event.wait on {attr!r} with the result "
+                        "discarded — on timeout the event is NOT set and "
+                        "execution proceeds as if it were; branch on the "
+                        "return value or re-check the predicate",
+                    )

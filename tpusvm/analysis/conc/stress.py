@@ -1,0 +1,645 @@
+"""Seeded schedule-perturbation race harness (`conc-stress`).
+
+The dynamic arm of the concurrency auditor: the static rules (JXC201-206)
+prove lock DISCIPLINE; this harness hunts the races discipline cannot
+express, by amplifying thread interleavings deterministically-by-seed.
+
+How it works — the fault-injection design (tpusvm.faults) applied to
+scheduling:
+
+  * every perturbation SITE (lock acquire/release, queue handoff,
+    scoring callback, ...) owns an independent decision stream; decision
+    k at site s is a pure function of (seed, s, k) via crc32, exactly
+    the per-rule rng derivation FaultPlan uses. The expanded plan — the
+    SCHEDULE LOG — is therefore byte-identical for a given seed on every
+    platform, which is what `--seed S` reproduces;
+  * decisions are none / yield (sleep(0): release the GIL at the site) /
+    micro-sleep (1-500us: hold the site open long enough for another
+    thread to interleave). A plain test crosses a racy window once in
+    ten thousand runs; a perturbed schedule parks a thread INSIDE the
+    window, so the race fires in a handful of iterations;
+  * the harness wraps the REAL objects' private locks/queues/semaphores
+    with perturbing delegates (white-box injection — the objects'
+    production code is untouched) and drives them from multiple threads
+    while checking the objects' own advertised invariants.
+
+Suites (run all: `python -m tpusvm.analysis conc-stress`):
+
+  registry  obs.registry concurrent counter/histogram/gauge writes:
+            final totals exact, every mid-write snapshot internally
+            consistent AND mergeable (the asserted merge algebra), values
+            monotone across snapshots;
+  batcher   serve MicroBatcher submit vs drain vs close under load:
+            every submitted future resolves with a legal status — never
+            dropped, never None (the close-under-load test, perturbed);
+  reader    stream ShardReader: residency NEVER exceeds the
+            prefetch_depth + 1 permit bound, and every shard arrives
+            exactly once, in order;
+  breaker   faults CircuitBreaker hammered from many threads: the
+            emitted transition sequence is legal for the three-state
+            machine (closed -tripped-> open -half_open-> half_open
+            -recovered/reopened-> ...), and trip/recovery counters match
+            the event log;
+  racy      a DELIBERATELY broken fixture (read-modify-write with no
+            lock) the harness must catch — the self-test proving the
+            perturber actually amplifies races (`--self-test`).
+
+Any violation report carries the seed that reproduces it.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+import zlib
+from typing import Callable, Dict, List, Optional, Sequence
+
+DEFAULT_SEED = 0
+
+# canonical perturbation sites per suite: the schedule log expands these
+SUITE_SITES = {
+    "registry": ("registry.lock.acquire", "registry.lock.release",
+                 "registry.scrape"),
+    "batcher": ("batcher.q.put", "batcher.q.get", "batcher.score",
+                "batcher.submit", "batcher.lifecycle"),
+    "reader": ("reader.permits.acquire", "reader.permits.release",
+               "reader.q.put", "reader.q.get", "reader.load",
+               "reader.consume"),
+    "breaker": ("breaker.step",),
+    "racy": ("racy.rmw",),
+}
+
+
+class SchedulePerturber:
+    """Deterministic-by-seed scheduling noise.
+
+    perturb(site) consumes the next decision of `site`'s stream; the
+    decision is a pure function of (seed, site, k) so the expanded plan
+    (`plan_lines`) is byte-identical across runs and platforms — the
+    reproducibility contract behind "report the seed"."""
+
+    def __init__(self, seed: int = DEFAULT_SEED, p_sleep: float = 0.20,
+                 p_yield: float = 0.30, max_sleep_us: int = 400):
+        self.seed = int(seed)
+        self.p_sleep = p_sleep
+        self.p_yield = p_yield
+        self.max_sleep_us = max_sleep_us
+        self._lock = threading.Lock()
+        self._counts: Dict[str, int] = {}
+
+    def decide(self, site: str, k: int):
+        """(action, sleep_us) for event k at `site` — pure, no state."""
+        h = zlib.crc32(f"{self.seed}:{site}:{k}".encode()) & 0xFFFFFFFF
+        r = h / 2**32
+        if r < self.p_sleep:
+            return "sleep", 1 + h % self.max_sleep_us
+        if r < self.p_sleep + self.p_yield:
+            return "yield", 0
+        return "none", 0
+
+    def perturb(self, site: str) -> None:
+        with self._lock:
+            k = self._counts.get(site, 0)
+            self._counts[site] = k + 1
+        action, us = self.decide(site, k)
+        if action == "sleep":
+            time.sleep(us * 1e-6)
+        elif action == "yield":
+            time.sleep(0)  # release the GIL at the site
+
+    def consumed(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(sorted(self._counts.items()))
+
+    def plan_lines(self, sites: Sequence[str], n: int) -> List[str]:
+        """The deterministic schedule log: the first `n` decisions of
+        each site, independent of the interleaving that consumed them."""
+        lines = []
+        for site in sorted(sites):
+            for k in range(n):
+                action, us = self.decide(site, k)
+                lines.append(f"{site} {k} {action} {us}")
+        return lines
+
+
+# ------------------------------------------------------------- wrappers
+class PerturbLock:
+    """Lock delegate perturbing at acquire/release. Drop-in for the
+    threading.Lock the obs registry shares across its metric wrappers."""
+
+    def __init__(self, perturber: SchedulePerturber, site: str,
+                 inner=None):
+        self._inner = inner if inner is not None else threading.Lock()
+        self._p = perturber
+        self._site = site
+
+    def acquire(self, *args, **kwargs):
+        self._p.perturb(self._site + ".acquire")
+        return self._inner.acquire(*args, **kwargs)
+
+    def release(self):
+        self._inner.release()
+        self._p.perturb(self._site + ".release")
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+
+class PerturbSemaphore:
+    """Semaphore delegate perturbing at the permit handoff points."""
+
+    def __init__(self, inner, perturber: SchedulePerturber, site: str):
+        self._inner = inner
+        self._p = perturber
+        self._site = site
+
+    def acquire(self, *args, **kwargs):
+        self._p.perturb(self._site + ".acquire")
+        return self._inner.acquire(*args, **kwargs)
+
+    def release(self, *args, **kwargs):
+        self._inner.release(*args, **kwargs)
+        self._p.perturb(self._site + ".release")
+
+
+class PerturbQueue:
+    """Queue delegate perturbing before/after every handoff. Wraps the
+    object's existing queue INSTANCE so a worker thread already blocked
+    on the inner queue still observes wrapped puts."""
+
+    def __init__(self, inner, perturber: SchedulePerturber, site: str):
+        self._inner = inner
+        self._p = perturber
+        self._site = site
+
+    def put(self, item, *args, **kwargs):
+        self._p.perturb(self._site + ".put")
+        self._inner.put(item, *args, **kwargs)
+
+    def put_nowait(self, item):
+        self._p.perturb(self._site + ".put")
+        self._inner.put_nowait(item)
+
+    def get(self, *args, **kwargs):
+        item = self._inner.get(*args, **kwargs)
+        self._p.perturb(self._site + ".get")
+        return item
+
+    def get_nowait(self):
+        item = self._inner.get_nowait()
+        self._p.perturb(self._site + ".get")
+        return item
+
+    def qsize(self):
+        return self._inner.qsize()
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+
+# --------------------------------------------------------------- report
+@dataclasses.dataclass
+class StressReport:
+    """Outcome of one suite run; `schedule` is the deterministic seeded
+    plan (same seed => byte-identical), `events` the consumed counts."""
+
+    suite: str
+    seed: int
+    violations: List[str]
+    events: Dict[str, int]
+    schedule: List[str]
+    elapsed_s: float
+
+    @property
+    def ok(self) -> bool:
+        return not self.violations
+
+    def render(self) -> str:
+        head = (f"conc-stress {self.suite}: "
+                f"{'ok' if self.ok else 'VIOLATION'} seed={self.seed} "
+                f"events={sum(self.events.values())} "
+                f"elapsed={self.elapsed_s:.2f}s")
+        lines = [head]
+        for v in self.violations:
+            lines.append(f"  {self.suite}: {v}")
+        if self.violations:
+            lines.append(
+                f"  reproduce: python -m tpusvm.analysis conc-stress "
+                f"--suite {self.suite} --seed {self.seed}")
+        return "\n".join(lines)
+
+
+def _run_threads(fns: List[Callable[[], None]],
+                 timeout_s: float = 60.0) -> List[str]:
+    """Run the thunks on owned (joined) threads; worker exceptions come
+    back as violations instead of dying silently on a daemon thread."""
+    errors: List[str] = []
+    elock = threading.Lock()
+
+    def wrap(fn):
+        def run():
+            try:
+                fn()
+            except BaseException as e:  # noqa: BLE001 — reported, not lost
+                with elock:
+                    errors.append(f"worker raised {type(e).__name__}: {e}")
+        return run
+
+    threads = [threading.Thread(target=wrap(fn), daemon=True)
+               for fn in fns]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=timeout_s)
+        if t.is_alive():
+            errors.append("worker thread failed to finish in "
+                          f"{timeout_s}s (possible deadlock)")
+    return errors
+
+
+def _report(suite: str, perturber: SchedulePerturber,
+            violations: List[str], t0: float,
+            plan_events: int = 32) -> StressReport:
+    return StressReport(
+        suite=suite, seed=perturber.seed, violations=violations,
+        events=perturber.consumed(),
+        schedule=perturber.plan_lines(SUITE_SITES[suite], plan_events),
+        elapsed_s=time.perf_counter() - t0,
+    )
+
+
+# ---------------------------------------------------------------- suites
+def stress_registry(seed: int = DEFAULT_SEED, iters: int = 300,
+                    threads: int = 4) -> StressReport:
+    """obs.registry under concurrent writes + mid-write snapshots.
+
+    Invariants: exact final totals (counter adds are never lost), every
+    snapshot — including ones taken mid-write — is internally consistent
+    (histogram bucket counts sum to its count) and satisfies the merge
+    algebra (commutative, self-merge well-formed), and counter values
+    are monotone across the snapshot sequence."""
+    from tpusvm.obs.registry import MetricsRegistry, merge_snapshots
+
+    p = SchedulePerturber(seed)
+    t0 = time.perf_counter()
+    reg = MetricsRegistry()
+    # wrap BEFORE the first metric is created: every wrapper stores this
+    # (now perturbing) shared lock
+    reg._lock = PerturbLock(p, "registry.lock", inner=reg._lock)
+    c = reg.counter("conc.hits")
+    h = reg.histogram("conc.lat", bounds=(0.5, 1.5))
+    g = reg.gauge("conc.depth")
+    violations: List[str] = []
+    stop = threading.Event()
+    snaps: List[dict] = []
+
+    def writer(t):
+        def run():
+            for i in range(iters):
+                c.inc()
+                h.observe((t + i) % 3)
+                g.set_max(t * iters + i)
+        return run
+
+    def scraper():
+        while not stop.is_set():
+            snaps.append(reg.snapshot())
+            p.perturb("registry.scrape")
+
+    sthread = threading.Thread(target=scraper, daemon=True)
+    sthread.start()
+    violations += _run_threads([writer(t) for t in range(threads)])
+    stop.set()
+    sthread.join(timeout=30.0)
+    snaps.append(reg.snapshot())
+
+    def entry(snap, name):
+        for e in snap["metrics"]:
+            if e["name"] == name:
+                return e
+        return None
+
+    total = threads * iters
+    final = snaps[-1]
+    ce = entry(final, "conc.hits")
+    if ce is None or ce["value"] != total:
+        violations.append(
+            f"counter lost updates: {ce and ce['value']} != {total}")
+    he = entry(final, "conc.lat")
+    if he is None or he["count"] != total or sum(he["counts"]) != total:
+        violations.append(
+            f"histogram lost observations: count={he and he['count']} "
+            f"buckets={he and sum(he['counts'])} != {total}")
+    ge = entry(final, "conc.depth")
+    if ge is None or ge["value"] != (threads - 1) * iters + iters - 1:
+        violations.append(
+            f"gauge high-water wrong: {ge and ge['value']}")
+    prev = -1
+    for i, s in enumerate(snaps):
+        hed = entry(s, "conc.lat")
+        if hed is not None and sum(hed["counts"]) != hed["count"]:
+            violations.append(
+                f"snapshot {i} torn mid-write: histogram bucket sum "
+                f"{sum(hed['counts'])} != count {hed['count']}")
+        ced = entry(s, "conc.hits")
+        if ced is not None:
+            if ced["value"] < prev:
+                violations.append(
+                    f"snapshot {i} counter went backwards: "
+                    f"{ced['value']} < {prev}")
+            prev = ced["value"]
+        try:
+            merge_snapshots(s)  # mid-write snapshots must stay mergeable
+        except ValueError as e:
+            violations.append(f"snapshot {i} unmergeable: {e}")
+    if len(snaps) >= 2:
+        a, b = snaps[len(snaps) // 2], snaps[-1]
+        if merge_snapshots(a, b) != merge_snapshots(b, a):
+            violations.append("merge algebra not commutative on "
+                              "mid-run snapshots")
+    return _report("registry", p, violations, t0)
+
+
+def stress_batcher(seed: int = DEFAULT_SEED, iters: int = 30,
+                   threads: int = 4) -> StressReport:
+    """MicroBatcher submit vs drain vs close under perturbed handoffs.
+
+    Invariant: no dropped futures — every submit resolves to a
+    ServeResult with a legal status, even while drain() and close() race
+    the clients; after close the queue is swept empty."""
+    import numpy as np
+
+    from tpusvm.serve.batcher import MicroBatcher
+    from tpusvm.status import ServeStatus
+
+    p = SchedulePerturber(seed)
+    t0 = time.perf_counter()
+
+    def run_batch(X):
+        p.perturb("batcher.score")
+        s = X.sum(axis=1)
+        return s, np.where(s > 0, 1, -1)
+
+    b = MicroBatcher(run_batch, max_batch=8, max_delay_s=0.001,
+                     queue_size=64, timeout_s=10.0)
+    b._q = PerturbQueue(b._q, p, "batcher.q")
+    results: List[List[object]] = [[] for _ in range(threads)]
+
+    def client(t):
+        def run():
+            for _ in range(iters):
+                p.perturb("batcher.submit")
+                results[t].append(b.submit(np.ones(4) * (t + 1)))
+        return run
+
+    def done() -> int:
+        return sum(len(r) for r in results)
+
+    def lifecycle():
+        # let real batches flow, then race drain/close against the
+        # remaining clients (the perturber decides the exact lag)
+        deadline = time.monotonic() + 10.0
+        while done() < (threads * iters) // 2 and \
+                time.monotonic() < deadline:
+            p.perturb("batcher.lifecycle")
+            time.sleep(0.0005)
+        b.drain(timeout_s=10.0)
+        for _ in range(3):
+            p.perturb("batcher.lifecycle")
+        b.close()
+
+    violations = _run_threads([client(t) for t in range(threads)]
+                              + [lifecycle])
+    b.close()  # idempotent
+    got = sum(len(r) for r in results)
+    if got != threads * iters:
+        violations.append(
+            f"dropped futures: {got} results for {threads * iters} "
+            "submits")
+    legal = set(ServeStatus)
+    for t, rs in enumerate(results):
+        for r in rs:
+            if r is None:
+                violations.append(f"client {t} got a None result")
+            elif ServeStatus(r.status) not in legal:
+                violations.append(
+                    f"client {t} got illegal status {r.status!r}")
+    if b._q.qsize() != 0:
+        violations.append(
+            f"queue not swept after close: {b._q.qsize()} items remain")
+    return _report("batcher", p, violations, t0)
+
+
+class _StubShardInfo:
+    def __init__(self, i):
+        self.filename = f"shard_{i:05d}.npz"
+
+
+class _StubManifest:
+    def __init__(self, n):
+        self.shards = [_StubShardInfo(i) for i in range(n)]
+
+
+class _StubDataset:
+    """Duck-typed stand-in for stream.format.ShardedDataset: in-memory
+    shards, perturbed loads — the reader's residency accounting is what
+    is under test, not the file format."""
+
+    def __init__(self, n_shards: int, rows: int, d: int, perturb):
+        import numpy as np
+
+        self.n_shards = n_shards
+        self.manifest = _StubManifest(n_shards)
+        self._perturb = perturb
+        self._shards = [
+            (np.full((rows, d), float(i)), np.full(rows, i % 2 * 2 - 1))
+            for i in range(n_shards)
+        ]
+
+    def load_shard(self, i: int, verify: bool = False):
+        self._perturb("reader.load")
+        return self._shards[i]
+
+
+def stress_reader(seed: int = DEFAULT_SEED, n_shards: int = 12,
+                  depth: int = 2) -> StressReport:
+    """ShardReader residency bound under perturbed permits and handoffs.
+
+    Invariant: live shards never exceed prefetch_depth + 1 (sampled
+    concurrently AND via the reader's own high-water mark), every shard
+    arrives exactly once in manifest order."""
+    from tpusvm.obs.registry import MetricsRegistry
+    from tpusvm.stream.reader import ShardReader
+
+    p = SchedulePerturber(seed)
+    t0 = time.perf_counter()
+    ds = _StubDataset(n_shards, rows=8, d=4, perturb=p.perturb)
+    reader = ShardReader(ds, prefetch_depth=depth,
+                         metrics=MetricsRegistry())
+    # worker starts on first iteration, so the swaps below are safe
+    reader._permits = PerturbSemaphore(reader._permits, p,
+                                      "reader.permits")
+    reader._q = PerturbQueue(reader._q, p, "reader.q")
+    violations: List[str] = []
+    stop = threading.Event()
+    sampled_max = [0]
+
+    def sampler():
+        while not stop.is_set():
+            sampled_max[0] = max(sampled_max[0], reader.live_shards)
+            p.perturb("reader.consume")
+
+    sthread = threading.Thread(target=sampler, daemon=True)
+    sthread.start()
+    seen = []
+    for X, Y in reader:
+        seen.append(int(X[0, 0]))
+        p.perturb("reader.consume")
+    stop.set()
+    sthread.join(timeout=30.0)
+    bound = depth + 1
+    if reader.max_live_shards > bound:
+        violations.append(
+            f"residency bound broken: max_live_shards="
+            f"{reader.max_live_shards} > prefetch_depth+1={bound}")
+    if sampled_max[0] > bound:
+        violations.append(
+            f"sampled residency {sampled_max[0]} > bound {bound}")
+    if seen != list(range(n_shards)):
+        violations.append(
+            f"shard order/coverage broken: {seen} != "
+            f"{list(range(n_shards))}")
+    return _report("reader", p, violations, t0)
+
+
+def stress_breaker(seed: int = DEFAULT_SEED, iters: int = 150,
+                   threads: int = 4) -> StressReport:
+    """CircuitBreaker transition legality under concurrent drivers.
+
+    The listener runs under the breaker's own lock, so the event log IS
+    the true serialized transition order; replaying it through the
+    three-state machine catches any illegal emission. Counters must
+    match the log exactly."""
+    from tpusvm.faults.breaker import CircuitBreaker
+
+    p = SchedulePerturber(seed)
+    t0 = time.perf_counter()
+    clock_lock = threading.Lock()
+    now = [0.0]
+
+    def clock():
+        with clock_lock:
+            now[0] += 0.01
+            return now[0]
+
+    events: List[str] = []
+
+    def listener(event):
+        # called under the breaker lock: append order is transition order
+        events.append(event)
+
+    br = CircuitBreaker(threshold=3, cooldown_s=0.05, clock=clock,
+                        listener=listener, name="stress")
+
+    def driver(t):
+        def run():
+            for i in range(iters):
+                p.perturb("breaker.step")
+                h = zlib.crc32(f"{seed}:drv{t}:{i}".encode())
+                if br.allow():
+                    if h % 5 < 2:
+                        br.record_failure()
+                    else:
+                        br.record_success()
+        return run
+
+    violations = _run_threads([driver(t) for t in range(threads)])
+    legal = {"closed": {"tripped"},
+             "open": {"half_open"},
+             "half_open": {"recovered", "reopened"}}
+    nxt = {"tripped": "open", "half_open": "half_open",
+           "recovered": "closed", "reopened": "open"}
+    state = "closed"
+    for i, ev in enumerate(events):
+        if ev not in legal[state]:
+            violations.append(
+                f"illegal transition event[{i}]={ev!r} from state "
+                f"{state!r} (log: {events[max(0, i - 3):i + 1]})")
+            break
+        state = nxt[ev]
+    d = br.describe()
+    if d["trips"] != events.count("tripped"):
+        violations.append(
+            f"trip counter {d['trips']} != tripped events "
+            f"{events.count('tripped')}")
+    if d["recoveries"] != events.count("recovered"):
+        violations.append(
+            f"recovery counter {d['recoveries']} != recovered events "
+            f"{events.count('recovered')}")
+    return _report("breaker", p, violations, t0)
+
+
+# ----------------------------------------------------------- self-test
+class RacyTally:
+    """DELIBERATELY racy: classic read-modify-write with no lock. The
+    perturbation point sits inside the race window, so a seeded schedule
+    parks one thread between the read and the write and another thread's
+    update is lost — the fixture the harness must provably catch."""
+
+    def __init__(self):
+        self.total = 0
+
+    def add(self, perturb) -> None:
+        v = self.total
+        perturb("racy.rmw")
+        self.total = v + 1
+
+
+def stress_racy(seed: int = DEFAULT_SEED, iters: int = 60,
+                threads: int = 4) -> StressReport:
+    """The known-bad fixture: MUST report a violation under perturbation
+    (asserted by tests and `conc-stress --self-test`)."""
+    p = SchedulePerturber(seed)
+    t0 = time.perf_counter()
+    tally = RacyTally()
+
+    def worker():
+        for _ in range(iters):
+            tally.add(p.perturb)
+
+    violations = _run_threads([worker for _ in range(threads)])
+    expected = threads * iters
+    if tally.total != expected:
+        violations.append(
+            f"lost {expected - tally.total} of {expected} updates "
+            "(unguarded read-modify-write)")
+    return _report("racy", p, violations, t0)
+
+
+SUITES: Dict[str, Callable[..., StressReport]] = {
+    "registry": stress_registry,
+    "batcher": stress_batcher,
+    "reader": stress_reader,
+    "breaker": stress_breaker,
+    "racy": stress_racy,
+}
+
+# the real-object suites --smoke runs (racy is the self-test, expected
+# to FAIL — it proves the harness catches what it exists to catch)
+REAL_SUITES = ("registry", "batcher", "reader", "breaker")
+
+
+def self_test(seeds: Sequence[int] = range(8)) -> Optional[StressReport]:
+    """First seed whose schedule makes the racy fixture lose updates
+    (None if no seed catches it — a harness regression)."""
+    for s in seeds:
+        rep = stress_racy(seed=s)
+        if not rep.ok:
+            return rep
+    return None

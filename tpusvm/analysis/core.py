@@ -15,6 +15,13 @@ Comment conventions (documented in README "Static analysis"):
   # tpusvm: disable-file=JX002       suppress a rule for the whole file
   # tpusvm: kernel-path              treat this file as a kernel path
                                      (ops/solver) for path-scoped rules
+  # tpusvm: guarded-by=<invariant>   concurrency-linter suppression that
+                                     DOCUMENTS the guarding invariant
+                                     (e.g. "one-way latch; bool store is
+                                     GIL-atomic") — suppresses JXC rules
+                                     on the line (or the line below when
+                                     the comment stands alone); empty
+                                     invariant text is rejected
 """
 
 from __future__ import annotations
@@ -38,6 +45,7 @@ DEFAULT_EXCLUDE_DIRS = frozenset(
 )
 
 _DISABLE_RE = re.compile(r"#\s*tpusvm:\s*disable=([A-Za-z0-9_,\s]+)")
+_GUARDED_BY_RE = re.compile(r"#\s*tpusvm:\s*guarded-by=(.*)$")
 _DISABLE_FILE_RE = re.compile(r"#\s*tpusvm:\s*disable-file=([A-Za-z0-9_,\s]+)")
 _KERNEL_PRAGMA_RE = re.compile(r"#\s*tpusvm:\s*kernel-path\b")
 _COMMENT_ONLY_RE = re.compile(r"^\s*#")
@@ -124,6 +132,25 @@ def is_suppressed(finding: Finding, lines: List[str],
         file_rules = file_suppressions(lines)
     active = file_rules | line_suppressions(lines, finding.line)
     return finding.rule in active or "ALL" in active
+
+
+def guarded_by_annotation(lines: List[str], lineno: int) -> Optional[str]:
+    """The `# tpusvm: guarded-by=<invariant>` text covering 1-based line
+    `lineno`, or None. Placement rules mirror line_suppressions: a
+    trailing comment on the line itself, or a comment-only line directly
+    above. The invariant text is mandatory — an empty annotation returns
+    None, so the finding it meant to suppress stays active (the
+    concurrency linter's suppressions must NAME the invariant they rely
+    on)."""
+    for idx in (lineno - 1, lineno - 2):
+        if not (0 <= idx < len(lines)):
+            continue
+        m = _GUARDED_BY_RE.search(lines[idx])
+        if m and (idx == lineno - 1 or _COMMENT_ONLY_RE.match(lines[idx])):
+            text = m.group(1).strip()
+            if text:
+                return text
+    return None
 
 
 def has_kernel_pragma(source: str) -> bool:

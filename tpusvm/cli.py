@@ -1405,6 +1405,9 @@ def _cmd_serve(args) -> int:
     from tpusvm.serve.http import make_http_server
 
     httpd = make_http_server(server, host=args.host, port=args.port)
+    # close() now owns the HTTP teardown: shutdown + server_close (the
+    # bound port is released) + thread join — no leaked listener
+    server.attach_http(httpd)
     host, port = httpd.server_address[:2]
     print(f"serving on http://{host}:{port} "
           f"(POST /v1/models/<name>:predict, GET /metrics)")
@@ -1414,7 +1417,6 @@ def _cmd_serve(args) -> int:
     except KeyboardInterrupt:
         pass
     finally:
-        httpd.shutdown()
         print(server.metrics_text(), end="")
         print(json.dumps(server.status()))
         _trace_final_metrics()

@@ -63,6 +63,13 @@ class Counter:
         with self._lock:
             return self._v
 
+    def read_locked(self) -> int:
+        """Raw value. CALLER holds the shared registry lock — the
+        snapshot path acquires it exactly once for all metrics (the
+        lock is shared and non-reentrant, so reacquiring per metric
+        would deadlock; reading without it would tear)."""
+        return self._v
+
 
 class Gauge:
     """Last-set value with a high-water mark. Merge rule: max.
@@ -87,6 +94,11 @@ class Gauge:
     def value(self) -> float:
         with self._lock:
             return self._v
+
+    def read_locked(self) -> float:
+        """Raw value; caller holds the shared registry lock (see
+        Counter.read_locked)."""
+        return self._v
 
 
 class Histogram:
@@ -117,6 +129,14 @@ class Histogram:
     def count(self) -> int:
         with self._lock:
             return self._n
+
+    def read_locked(self) -> dict:
+        """(bounds, counts, sum, count) copies; caller holds the shared
+        registry lock (see Counter.read_locked) — one acquisition covers
+        the whole histogram, so counts/sum/count are mutually consistent
+        even when the snapshot races a writer."""
+        return {"bounds": list(self.bounds), "counts": list(self._counts),
+                "sum": self._sum, "count": self._n}
 
 
 class MetricsRegistry:
@@ -162,18 +182,25 @@ class MetricsRegistry:
 
     # ------------------------------------------------------------ export
     def snapshot(self) -> dict:
-        """One consistent, JSON-able, MERGEABLE view of every metric."""
+        """One consistent, JSON-able, MERGEABLE view of every metric.
+
+        The shared registry lock is taken EXACTLY ONCE for the whole
+        snapshot (every metric wrapper holds the same lock, so a
+        per-metric value() loop would deadlock on the non-reentrant
+        lock — and releasing between metrics would let a scrape observe
+        metric A after a compound update and metric B before it). The
+        wrappers' read_locked() accessors make that contract explicit;
+        conc-stress asserts a snapshot taken mid-write is still
+        internally consistent and mergeable."""
         out: List[dict] = []
         with self._lock:
             items = sorted(self._metrics.items())
             for (name, lkey), (kind, m) in items:
                 entry = {"name": name, "type": kind, "labels": dict(lkey)}
                 if kind in ("counter", "gauge"):
-                    entry["value"] = m._v
+                    entry["value"] = m.read_locked()
                 else:
-                    entry.update(bounds=list(m.bounds),
-                                 counts=list(m._counts),
-                                 sum=m._sum, count=m._n)
+                    entry.update(m.read_locked())
                 out.append(entry)
         return {"v": SNAPSHOT_VERSION, "metrics": out}
 

@@ -242,6 +242,9 @@ class MicroBatcher:
         Idempotent; safe to close() afterwards."""
         if self._closed:
             return True
+        # readers tolerate staleness: a racing submit either drains or
+        # lands before the in-queue barrier, which serializes the rest
+        # tpusvm: guarded-by=one-way latch; bool store is GIL-atomic
         self._draining = True
         bar = _DrainBarrier()
         try:
@@ -253,6 +256,10 @@ class MicroBatcher:
     def close(self, timeout_s: float = 5.0) -> None:
         if self._closed:
             return
+        # requests that race past the stale read are resolved by the
+        # post-join queue sweep below (the no-dropped-futures contract
+        # conc-stress exercises)
+        # tpusvm: guarded-by=one-way latch; bool store is GIL-atomic
         self._closed = True
         self._q.put(_SENTINEL)
         self._worker.join(timeout=timeout_s)

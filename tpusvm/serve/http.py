@@ -41,6 +41,7 @@ from __future__ import annotations
 import json
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
 
 import numpy as np
 
@@ -178,8 +179,28 @@ def make_http_server(server, host: str = "127.0.0.1", port: int = 8471,
 
 
 def start_http_thread(httpd: ThreadingHTTPServer) -> threading.Thread:
-    """Run an HTTP server on a daemon thread (in-process tests / CLI)."""
+    """Run an HTTP server on a daemon thread (in-process tests / CLI).
+
+    Pair with stop_http_server (directly, or via Server.attach_http +
+    Server.close) — daemon=True alone keeps interpreter exit unblocked
+    but LEAKS the listening socket for the life of the process, which is
+    exactly how back-to-back CI smokes hit EADDRINUSE."""
     t = threading.Thread(target=httpd.serve_forever, daemon=True,
                          name="tpusvm-serve-http")
     t.start()
     return t
+
+
+def stop_http_server(httpd: ThreadingHTTPServer,
+                     thread: Optional[threading.Thread] = None,
+                     timeout_s: float = 5.0) -> None:
+    """Shut down the serve loop, CLOSE the listening socket, and join
+    the serving thread. Idempotent; safe after a manual shutdown().
+
+    shutdown() only stops serve_forever — without server_close() the
+    bound port stays held, and without the join a still-draining handler
+    can race interpreter teardown."""
+    httpd.shutdown()
+    httpd.server_close()
+    if thread is not None:
+        thread.join(timeout=timeout_s)

@@ -208,12 +208,29 @@ class Metrics:
 
     def snapshot(self) -> dict:
         """One consistent JSON-able view of every counter and derived stat
-        (schema unchanged across the registry migration)."""
-        counts = {k: c.value for k, c in self._counts.items()}
-        batches: Dict[int, int] = {b: c.value
-                                   for b, c in self._bucket_batches.items()}
-        rows: Dict[int, int] = {b: c.value
-                                for b, c in self._bucket_rows.items()}
+        (schema unchanged across the registry migration).
+
+        All counter values come from ONE registry.snapshot() call — a
+        single acquisition of the shared registry lock — instead of a
+        per-metric .value loop: N reacquisitions would cost N lock
+        round-trips under scrape load AND let a concurrent batch be
+        half-visible between two reads (ok incremented, its bucket row
+        counts not yet), which breaks the occupancy arithmetic below."""
+        reg = self.registry.snapshot()
+        counts = {k: 0 for k in _COUNTERS}
+        batches: Dict[int, int] = {b: 0 for b in self._bucket_batches}
+        rows: Dict[int, int] = {b: 0 for b in self._bucket_rows}
+        for e in reg["metrics"]:
+            if e["type"] != "counter":
+                continue
+            if e["name"] == "serve.bucket_batches":
+                batches[int(e["labels"]["bucket"])] = e["value"]
+            elif e["name"] == "serve.bucket_rows":
+                rows[int(e["labels"]["bucket"])] = e["value"]
+            elif e["name"].startswith("serve."):
+                key = e["name"][len("serve."):]
+                if key in counts:
+                    counts[key] = e["value"]
         with self._lock:
             lat = sorted(self._lat)
         total_rows = sum(rows.values())

@@ -232,6 +232,8 @@ class Server:
         self._lock = threading.Lock()
         self._closed = False
         self._draining = False
+        self._httpd = None
+        self._http_thread = None
 
     # ----------------------------------------------------------- hosting
     def _install(self, entry: ModelEntry) -> ModelEntry:
@@ -375,12 +377,30 @@ class Server:
         faults.emit("serve.drained", complete=ok)
         return ok
 
+    def attach_http(self, httpd, thread=None) -> None:
+        """Register the HTTP frontend serving this Server so close()
+        owns its shutdown: stop the serve loop, CLOSE the listener
+        socket, join the serving thread. Without this the daemon HTTP
+        thread leaks the bound port past close() — the CI-smoke
+        EADDRINUSE trap the concurrency audit flagged."""
+        with self._lock:
+            self._httpd = httpd
+            self._http_thread = thread
+
     def close(self) -> None:
         with self._lock:
             if self._closed:
                 return
             self._closed = True
             workers = list(self._workers.values())
+            httpd, http_thread = self._httpd, self._http_thread
+            self._httpd = self._http_thread = None
+        if httpd is not None:
+            # outside the lock: shutdown blocks on the serve loop, and a
+            # handler thread mid-request may call back into this Server
+            from tpusvm.serve.http import stop_http_server
+
+            stop_http_server(httpd, http_thread)
         for w in workers:
             w.close()
 

@@ -175,6 +175,7 @@ class ShardReader:
                 "ShardReader is single-pass; construct a new reader to "
                 "re-read (same seed = same order)"
             )
+        # tpusvm: guarded-by=written on the consumer thread before the producer exists (Thread.start is the fence)
         self._started = True
         self._worker.start()
         try:
@@ -192,6 +193,7 @@ class ShardReader:
                     raise item
                 if self._consumer_holds:
                     self._release()  # moving past the previous block
+                # tpusvm: guarded-by=consumer-thread confined (only the single consumer and its finally-close touch it)
                 self._consumer_holds = True
                 yield item
                 # NOTE: the yielded block's permit is released when the
@@ -242,5 +244,6 @@ class ShardReader:
                                                             BaseException):
                     self._release()
             if self._consumer_holds:
+                # tpusvm: guarded-by=consumer-thread confined (close runs on the consumer's __iter__ finally, or after it exits)
                 self._consumer_holds = False
                 self._release()
