@@ -1,0 +1,381 @@
+"""Fleet (batched many-model) solver: masking, padding, consumer parity.
+
+The parity discipline mirrors what XLA actually guarantees:
+
+  * WITHIN one bucket program, a problem's result is BITWISE independent
+    of its lane position and of which companions (real, dummy, fast,
+    slow) ride along — that is what per-problem convergence masking in
+    the batched carry means, and it is asserted to the byte.
+  * ACROSS programs (fleet vs a separately-compiled solo solve), bitwise
+    equality is not a property any XLA rewrite preserves (the batched
+    program gets different fma/fusion decisions), so fleet-vs-loop
+    parity is gated at the solution level: EXACT SV-identity sets,
+    exact statuses, b/alpha within the cross-engine band the repo's
+    other solver-parity suites use.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpusvm.config import SVMConfig
+from tpusvm.data import MinMaxScaler, blobs, rings
+from tpusvm.fleet import (
+    bucket_for,
+    fleet_opt_errors,
+    fleet_smo_solve,
+    fleet_train,
+    pack_problems,
+    unpack_results,
+)
+from tpusvm.fleet.results import fleet_convergence_summary
+from tpusvm.oracle import get_sv_indices
+from tpusvm.solver import blocked_smo_solve
+from tpusvm.status import Status
+
+
+def _data(gen, **kw):
+    X, Y = gen(**kw)
+    return MinMaxScaler().fit_transform(X), Y
+
+
+@pytest.fixture(scope="module")
+def rings_problem():
+    Xs, Y = _data(rings, n=256, seed=5)
+    return jnp.asarray(Xs, jnp.float32), np.asarray(Y)
+
+
+KW = dict(q=64, accum_dtype=jnp.float64)
+
+
+# ------------------------------------------------------------- bucketing
+def test_bucket_for_powers_of_two():
+    assert [bucket_for(b) for b in (1, 2, 3, 5, 8, 9, 16, 17)] == \
+        [1, 2, 4, 8, 8, 16, 16, 32]
+    with pytest.raises(ValueError):
+        bucket_for(0)
+
+
+def test_pack_validation_errors(rings_problem):
+    _, Y = rings_problem
+    with pytest.raises(ValueError, match="empty problem list"):
+        pack_problems([], [], [])
+    with pytest.raises(ValueError, match="C values"):
+        pack_problems([Y], [1.0, 2.0], [1.0])
+    with pytest.raises(ValueError, match="positive finite"):
+        pack_problems([Y], [-1.0], [1.0])
+    with pytest.raises(ValueError, match="outside"):
+        pack_problems([np.full_like(Y, 2)], [1.0], [1.0])
+    with pytest.raises(ValueError, match="zero labels on live rows"):
+        y0 = Y.copy()
+        y0[3] = 0
+        pack_problems([y0], [1.0], [1.0])
+    # zero labels on MASKED rows are the padding idiom and pack fine
+    valid = np.ones(len(Y), bool)
+    valid[3] = False
+    y0 = Y.copy()
+    y0[3] = 0
+    batch = pack_problems([y0], [1.0], [1.0], valids=[valid])
+    assert batch.bucket == 1 and batch.n_problems == 1
+    with pytest.raises(ValueError, match="power of two"):
+        pack_problems([Y, Y, Y], [1.0] * 3, [1.0] * 3, bucket=3)
+
+
+def test_unsupported_fleet_opts_rejected(rings_problem):
+    X, Y = rings_problem
+    for bad in (dict(krow_cache=64), dict(inner="pallas"),
+                dict(shrink_stable=3), dict(fused_fupdate=True)):
+        with pytest.raises(ValueError, match="not fleet-compatible"):
+            fleet_train(X, [Y], [10.0], [10.0], **bad, **KW)
+    # the same knobs at their inert defaults pass through silently
+    assert fleet_opt_errors(dict(inner="xla", krow_cache=0)) == []
+
+
+# ------------------------------------- masking / padding / lane invariance
+def test_companion_and_lane_invariance_bitwise(rings_problem):
+    """A problem's lane is bit-identical no matter who shares the bucket:
+    the hard no-crosstalk gate per-problem convergence masking implies.
+    The FAST problems (the flipped/loose ones) freeze lanes while the
+    slow problem keeps iterating — their carries must not move."""
+    X, Y = rings_problem
+    P, Q = Y, -Y
+    D = np.zeros_like(Y)  # inert dummy
+    r1 = fleet_smo_solve(X, jnp.asarray(np.stack([P, Q])),
+                         Cs=jnp.asarray([10.0, 1.0]),
+                         gammas=jnp.asarray([10.0, 5.0]), **KW)
+    r2 = fleet_smo_solve(X, jnp.asarray(np.stack([P, D])),
+                         Cs=jnp.asarray([10.0, 1.0]),
+                         gammas=jnp.asarray([10.0, 5.0]), **KW)
+    r3 = fleet_smo_solve(X, jnp.asarray(np.stack([Q, P])),
+                         Cs=jnp.asarray([1.0, 10.0]),
+                         gammas=jnp.asarray([5.0, 10.0]), **KW)
+    a = np.asarray(r1.alpha[0])
+    assert np.array_equal(a, np.asarray(r2.alpha[0]))      # companions
+    assert np.array_equal(a, np.asarray(r3.alpha[1]))      # lane position
+    assert float(r1.b[0]) == float(r2.b[0]) == float(r3.b[1])
+    assert int(r1.n_outer[0]) == int(r3.n_outer[1])
+
+
+def test_padding_lanes_provably_inert(rings_problem):
+    """Dummy zero-y problems: converged-at-entry masks (both Keerthi
+    index sets empty), NO_WORKING_SET after one masked iteration, alpha
+    identically zero — and the real problems bitwise unaffected."""
+    X, Y = rings_problem
+    res = fleet_train(X, [Y, -Y, Y], [10.0, 1.0, 5.0], [10.0, 5.0, 2.0],
+                      **KW)  # B=3 -> bucket 4, one dummy lane
+    raw = fleet_smo_solve(
+        X, jnp.asarray(np.stack([Y, -Y, Y, np.zeros_like(Y)])),
+        Cs=jnp.asarray([10.0, 1.0, 5.0, 1.0]),
+        gammas=jnp.asarray([10.0, 5.0, 2.0, 1.0]), **KW)
+    # the dummy lane is inert
+    assert int(raw.status[3]) == Status.NO_WORKING_SET
+    assert int(raw.n_iter[3]) == 1
+    assert (np.asarray(raw.alpha[3]) == 0).all()
+    # and fleet_train's unpacking returns the real lanes bitwise
+    for i, r in enumerate(unpack_results(raw, 3)):
+        assert np.array_equal(np.asarray(r.alpha),
+                              np.asarray(res[i].alpha))
+
+
+def test_fast_problem_frozen_next_to_slow_matches_solo(rings_problem):
+    """The satellite's masking gate: an easy problem that converges in
+    round 1 rides with a slow one; its lane must equal (bitwise) the
+    same problem next to an inert dummy, and its SOLUTION must equal its
+    solo blocked solve (exact SV ids, oracle-band b/alpha)."""
+    X, Y = rings_problem
+    # the warm-started easy lane: its own solved alphas — converges at
+    # the first global check while the cold hard lane keeps running
+    solo = blocked_smo_solve(X, jnp.asarray(Y), C=10.0, gamma=10.0, **KW)
+    seed = np.asarray(solo.alpha)
+    fast_slow = fleet_train(
+        X, [Y, -Y], [10.0, 1.0], [10.0, 5.0],
+        alpha0s=[seed, None], **KW)
+    fast_dummy = fleet_smo_solve(
+        X, jnp.asarray(np.stack([Y, np.zeros_like(Y)])),
+        jnp.ones((2, len(Y)), bool),
+        jnp.asarray(np.stack([seed, np.zeros_like(seed)])),
+        Cs=jnp.asarray([10.0, 1.0]), gammas=jnp.asarray([10.0, 5.0]),
+        warm_start=True, **KW)
+    fast = fast_slow[0]
+    assert int(fast.status) == Status.CONVERGED
+    assert int(fast.n_iter) == 1  # converged at the first global check
+    assert np.array_equal(np.asarray(fast.alpha),
+                          np.asarray(fast_dummy.alpha[0]))
+    np.testing.assert_array_equal(
+        get_sv_indices(np.asarray(fast.alpha)), get_sv_indices(seed))
+    np.testing.assert_allclose(np.asarray(fast.alpha), seed, atol=1e-10)
+
+
+def test_fleet_vs_solo_solution_parity(rings_problem):
+    """Cross-program parity: exact SV-identity sets and statuses, the
+    cross-engine tolerance band on b/alpha (bitwise is a same-program
+    property — see module docstring)."""
+    X, Y = rings_problem
+    problems = [(Y, 10.0, 10.0), (-Y, 1.0, 5.0), (Y, 5.0, 2.0)]
+    fl = fleet_train(X, [p[0] for p in problems],
+                     [p[1] for p in problems], [p[2] for p in problems],
+                     **KW)
+    for (y, C, g), r in zip(problems, fl):
+        solo = blocked_smo_solve(X, jnp.asarray(y), C=C, gamma=g, **KW)
+        assert int(r.status) == int(solo.status) == Status.CONVERGED
+        np.testing.assert_array_equal(
+            get_sv_indices(np.asarray(r.alpha)),
+            get_sv_indices(np.asarray(solo.alpha)))
+        np.testing.assert_allclose(float(r.b), float(solo.b), atol=1e-4)
+        np.testing.assert_allclose(np.asarray(r.alpha),
+                                   np.asarray(solo.alpha), atol=1e-3)
+
+
+def test_compaction_is_solution_exact(rings_problem):
+    """The segment driver (compact_every > 0) harvests converged lanes
+    and re-buckets survivors; every problem's solution must match the
+    monolithic launch at the solution level and the loop's SV sets
+    exactly."""
+    X, Y = rings_problem
+    rng = np.random.default_rng(0)
+    B = 6
+    Cs = [float(c) for c in rng.choice([0.5, 1.0, 5.0, 10.0], B)]
+    gs = [float(g) for g in rng.choice([2.0, 5.0, 10.0], B)]
+    mono = fleet_train(X, [Y] * B, Cs, gs, **KW)
+    comp = fleet_train(X, [Y] * B, Cs, gs, compact_every=3, **KW)
+    for m, c in zip(mono, comp):
+        assert int(m.status) == int(c.status) == Status.CONVERGED
+        np.testing.assert_array_equal(
+            get_sv_indices(np.asarray(m.alpha)),
+            get_sv_indices(np.asarray(c.alpha)))
+        np.testing.assert_allclose(float(m.b), float(c.b), atol=1e-4)
+
+
+def test_valid_mask_padding_rows(rings_problem):
+    """Per-problem valid masks ride the problem axis: rows masked out of
+    one lane can stay live in another."""
+    X, Y = rings_problem
+    n = len(Y)
+    valid = np.ones(n, bool)
+    valid[200:] = False
+    y_masked = Y.copy()
+    y_masked[200:] = 0
+    res = fleet_train(X, [y_masked, Y], [10.0, 10.0], [10.0, 10.0],
+                      valids=[valid, None], **KW)
+    assert (np.asarray(res[0].alpha)[200:] == 0).all()
+    solo = blocked_smo_solve(X[:200], jnp.asarray(Y[:200]), C=10.0,
+                             gamma=10.0, **KW)
+    np.testing.assert_array_equal(
+        get_sv_indices(np.asarray(res[0].alpha)[:200]),
+        get_sv_indices(np.asarray(solo.alpha)))
+
+
+# ----------------------------------------------------- telemetry + results
+def test_per_problem_telemetry_and_summary(rings_problem):
+    X, Y = rings_problem
+    res = fleet_train(X, [Y, -Y], [10.0, 1.0], [10.0, 5.0],
+                      telemetry=8, **KW)
+    for r in res:
+        assert r.telemetry is not None
+        assert int(r.telemetry.count) == int(r.n_outer) + 1
+    summary = fleet_convergence_summary(res)
+    assert summary["problems"] == 2
+    assert summary["converged"] == 2
+    assert summary["statuses"] == ["CONVERGED", "CONVERGED"]
+    assert summary["telemetry_rounds"] == [int(r.telemetry.count)
+                                           for r in res]
+
+
+def test_one_compile_per_bucket_across_cg_sweep(rings_problem):
+    """The launch-economics acceptance gate, CPU-checkable: per-problem
+    (C, gamma) are arrays, so a whole sweep at one bucket is ONE compile
+    (prof recompile counter stays 0 after warmup)."""
+    from tpusvm.obs import prof
+    from tpusvm.obs.registry import MetricsRegistry
+
+    X, Y = rings_problem
+    Ys = jnp.asarray(np.stack([Y, -Y]))
+    with prof.profiling(registry=MetricsRegistry()) as obs:
+        for (c, g) in [(10.0, 10.0), (3.0, 5.0), (1.0, 2.0)]:
+            res = fleet_smo_solve(X, Ys, Cs=jnp.asarray([c, c]),
+                                  gammas=jnp.asarray([g, g]), **KW)
+            np.asarray(res.alpha)
+        compiles = [r for r in obs.records
+                    if r["executable"] == "solver.fleet_smo_solve"]
+    assert len(compiles) == 1
+
+
+# --------------------------------------------------------- OvR consumer
+def test_ovr_fleet_vs_loop_parity_fuzz():
+    """The OvR consumer gate on a small fuzz corpus: solver='fleet'
+    reproduces solver='blocked' head for head — exact SV-ID sets, equal
+    statuses and held-out accuracy, b within the cross-engine band."""
+    from tpusvm.data.synthetic import (
+        BENCH_NOISE_MULTICLASS,
+        mnist_like_multiclass,
+    )
+    from tpusvm.models import OneVsRestSVC
+
+    for seed in (3, 11):
+        X, labels = mnist_like_multiclass(
+            n=460, d=32, noise=BENCH_NOISE_MULTICLASS, seed=seed)
+        Xtr, ytr = X[:400], labels[:400]
+        Xte, yte = X[400:], labels[400:]
+        cfg = SVMConfig(C=10.0, gamma=1.0 / 32)
+        opts = dict(q=64)
+        import warnings
+
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore", RuntimeWarning)
+            loop = OneVsRestSVC(config=cfg, solver="blocked",
+                                solver_opts=opts).fit(Xtr, ytr)
+            fleet = OneVsRestSVC(config=cfg, solver="fleet",
+                                 solver_opts=opts).fit(Xtr, ytr)
+        assert (loop.statuses_ == fleet.statuses_).all()
+        # identical SV unions AND identical per-head coefficients'
+        # support pattern = exact per-head SV-ID parity
+        assert np.array_equal(loop.X_sv_, fleet.X_sv_)
+        assert np.array_equal(loop.coef_ != 0, fleet.coef_ != 0)
+        np.testing.assert_allclose(loop.b_, fleet.b_, atol=1e-3)
+        assert loop.score(Xte, yte) == fleet.score(Xte, yte)
+
+
+def test_ovr_blocked_loop_shares_hoisted_norms():
+    """The satellite fix: the blocked host loop passes one shared sn=
+    into every head's solve (asserted by spying the solver call)."""
+    import tpusvm.models.ovr as ovr_mod
+
+    Xs, Y = _data(blobs, n=120, d=6, seed=7)
+    labels = np.where(Y > 0, 1, 0)
+    seen = []
+    import tpusvm.solver.blocked as blocked_mod
+
+    orig = blocked_mod.blocked_smo_solve
+
+    def spy(X, y, *a, **kw):
+        seen.append(kw.get("sn"))
+        return orig(X, y, *a, **kw)
+
+    from unittest import mock
+
+    from tpusvm.models import OneVsRestSVC
+
+    with mock.patch.object(blocked_mod, "blocked_smo_solve", spy):
+        # ovr imports the symbol inside fit, so patch the module it
+        # imports FROM
+        OneVsRestSVC(config=SVMConfig(C=1.0, gamma=0.5),
+                     solver="blocked",
+                     solver_opts=dict(q=32)).fit(Xs, labels)
+    assert len(seen) == 2  # one call per class
+    assert all(s is not None for s in seen)
+    assert all(s is seen[0] for s in seen)  # ONE shared array
+    del ovr_mod
+
+
+# --------------------------------------------------------- tune consumer
+def test_tune_fleet_vs_sequential_identical_winner_and_table():
+    """The tune consumer gate: fleet dispatch reproduces the sequential
+    path's winner AND the whole CV table (cold fits, so both paths
+    solve identical problems)."""
+    from tpusvm.tune import TuneConfig, make_grid, tune
+
+    Xs, Y = _data(rings, n=240, seed=5)
+    grid = make_grid([1.0, 8.0], [1.0, 8.0])
+    for schedule in ("grid", "halving"):
+        seq = tune(Xs, Y, grid,
+                   TuneConfig(folds=2, schedule=schedule, min_rung=64,
+                              warm_start=False),
+                   base=SVMConfig())
+        fl = tune(Xs, Y, grid,
+                  TuneConfig(folds=2, schedule=schedule, min_rung=64,
+                             warm_start=False, fleet=True),
+                  base=SVMConfig())
+        assert fl.winner == seq.winner
+        assert fl.fleet and not seq.fleet
+        for a, b in zip(seq.points, fl.points):
+            assert a["status"] == b["status"]
+            assert a["cv_accuracy"] == b["cv_accuracy"]
+            assert a["fold_accuracy"] == b["fold_accuracy"]
+            assert a["sv_count"] == b["sv_count"]
+
+
+def test_tune_fleet_rejects_patience():
+    from tpusvm.tune import TuneConfig
+
+    with pytest.raises(ValueError, match="patience"):
+        TuneConfig(fleet=True, patience=2)
+
+
+def test_tune_fleet_warm_halving_runs():
+    """Warm fleet halving: previous-rung seeds feed each lane; the run
+    completes with a sane winner (trajectory differs from sequential
+    warm by design — no same-rung neighbour seeding)."""
+    from tpusvm.tune import TuneConfig, make_grid, tune
+
+    Xs, Y = _data(rings, n=240, seed=5)
+    grid = make_grid([1.0, 8.0], [1.0, 8.0])
+    res = tune(Xs, Y, grid,
+               TuneConfig(folds=2, schedule="halving", min_rung=64,
+                          warm_start=True, fleet=True, fleet_compact=4),
+               base=SVMConfig())
+    assert res.winner["cv_accuracy"] > 0.9
+    final = [p for p in res.points if p["status"] == "EVALUATED"]
+    assert final  # the last rung evaluated its survivors
+    # rung > 0 fits found previous-rung seeds
+    assert any(p["warm_seeded"] > 0 for p in res.points
+               if p["rung"] > 0)

@@ -94,6 +94,24 @@ def _blocked_builder(sweep_statics: dict, with_pause: bool = False):
     return build
 
 
+def _fleet_build():
+    import tpusvm.fleet.solve  # noqa: F401 — registers the entry
+
+    jitted, _ = _registered("solver.fleet_smo_solve")
+    # a bucket-of-4 fleet at the canonical solver shape; the per-problem
+    # hyperparameters are ARRAYS by the fleet's launch-economics
+    # contract, so their values cannot leak into the trace by
+    # construction — no sweep needed (the dual-trace check would compare
+    # identical jaxprs trivially). Canonical face is all-f32 like the
+    # blocked entry (production f64 accum runs are out of audit scope,
+    # exactly as for the solo solver's accum_dtype=float64 calls)
+    B = 4
+    fn = functools.partial(jitted, q=Q, telemetry=0)
+    args = (_s((N, D)), _s((B, N)))
+    kwargs = dict(Cs=_s((B,)), gammas=_s((B,)))
+    return fn, args, kwargs
+
+
 def _smo_build(C=10.0, gamma=0.5):
     import tpusvm.solver.smo  # noqa: F401
 
@@ -251,6 +269,13 @@ def default_entrypoints():
             sweep=dict(sweep_cg),
             description="blocked SMO with the fused Pallas f-update "
                         "kernel (the pallas_call body is walked too)",
+        ),
+        IREntryPoint(
+            name="solver.fleet_smo_solve",
+            build=_fleet_build,
+            description="batched many-model fleet launch (vmapped "
+                        "blocked core; per-problem C/gamma arrive as "
+                        "arrays, so no scalar can bake into the trace)",
         ),
         IREntryPoint(
             name="solver.smo_solve",

@@ -152,13 +152,23 @@ def _build_parser() -> argparse.ArgumentParser:
         "--solver-opt matmul_precision=default --solver-opt refine=4096); "
         "integer values are auto-converted")
     mode.add_argument(
-        "--solver", choices=["blocked", "pair"], default=None,
+        "--solver", choices=["blocked", "pair", "fleet"], default=None,
         help="on-device solver for --mode single, each cascade shard, and "
         "each --multiclass class: blocked working-set (TPU-first, default "
-        "for single/cascade) or pair (reference-faithful "
+        "for single/cascade), pair (reference-faithful "
         "one-pair-per-iteration; vmapped over classes with --multiclass, "
-        "its default there)",
+        "its default there), or fleet (--multiclass only: every "
+        "one-vs-rest head in ONE batched blocked-solver launch, "
+        "tpusvm.fleet — the --fleet flag is shorthand)",
     )
+    mode.add_argument("--fleet", action="store_true",
+                      help="with --multiclass/--task ovr: train all "
+                      "one-vs-rest heads as one batched fleet program "
+                      "(shorthand for --solver fleet)")
+    mode.add_argument("--fleet-compact", type=int, default=0, metavar="R",
+                      help="fleet: compact converged problems out of the "
+                      "batch every R outer rounds (power-of-two problem "
+                      "buckets; 0 = one monolithic launch)")
     mode.add_argument("--topology", choices=["tree", "star"], default="tree",
                       help="cascade merge topology (tree = mpi_svm_main3, "
                       "star = mpi_svm_main2)")
@@ -225,11 +235,12 @@ def _build_parser() -> argparse.ArgumentParser:
                     help="polynomial degree (--kernel poly)")
     kt.add_argument("--coef0", type=float, default=0.0,
                     help="polynomial additive term (--kernel poly)")
-    kt.add_argument("--task", choices=["svc", "svr"], default="svc",
+    kt.add_argument("--task", choices=["svc", "svr", "ovr"], default="svc",
                     help="svc = classification (default); svr = "
                     "epsilon-insensitive regression over the doubled "
                     "variable set (CSV/synthetic labels are then "
-                    "CONTINUOUS targets)")
+                    "CONTINUOUS targets); ovr = one-vs-rest multiclass "
+                    "classification (synonym for --multiclass)")
     kt.add_argument("--epsilon", type=float, default=0.1,
                     help="SVR tube half-width (--task svr)")
     kt.add_argument("--calibrate", type=int, default=0, metavar="K",
@@ -483,6 +494,20 @@ def _build_parser() -> argparse.ArgumentParser:
     sched.add_argument("--plateau-tol", type=float, default=0.0,
                        help="minimum CV-accuracy gain that resets "
                        "--patience")
+    sched.add_argument("--fleet", action="store_true", dest="fleet",
+                       help="dispatch each rung's point population as "
+                       "ONE batched fleet launch per fold (tpusvm.fleet) "
+                       "— the points share the fold's scaled rows and "
+                       "norms and differ only in (C, gamma)")
+    sched.add_argument("--no-fleet", action="store_false", dest="fleet",
+                       help="per-point sequential dispatch (the default; "
+                       "explicit form of not passing --fleet)")
+    sched.set_defaults(fleet=False)
+    sched.add_argument("--fleet-compact", type=int, default=0,
+                       metavar="R",
+                       help="--fleet: compact converged points out of "
+                       "the batch every R outer rounds (0 = monolithic "
+                       "launch per fold x rung)")
 
     hp2 = tu.add_argument_group("numerics (defaults = reference constants)")
     hp2.add_argument("--tau", type=float, default=1e-5)
@@ -698,6 +723,29 @@ def _cmd_train(args) -> int:
     from tpusvm.models import BinarySVC, OneVsRestSVC
     from tpusvm.utils import PhaseTimer, RunLogger, trace
 
+    # --task ovr is the one-vs-rest synonym for --multiclass (the fleet's
+    # natural task name); normalise BEFORE the smoke shape is chosen
+    if args.task == "ovr":
+        args.multiclass = True
+    if args.fleet:
+        if not args.multiclass:
+            raise SystemExit("--fleet trains one-vs-rest heads as one "
+                             "batched program; it requires "
+                             "--multiclass/--task ovr")
+        if args.solver not in (None, "fleet"):
+            raise SystemExit(f"--fleet and --solver {args.solver} "
+                             "conflict (--fleet means --solver fleet)")
+        args.solver = "fleet"
+    if args.solver == "fleet" and not args.multiclass:
+        raise SystemExit("--solver fleet requires --multiclass/--task "
+                         "ovr (the fleet batches the one-vs-rest heads)")
+    if args.fleet_compact:
+        if args.fleet_compact < 0:
+            raise SystemExit("--fleet-compact must be >= 0")
+        if args.solver != "fleet":
+            raise SystemExit("--fleet-compact needs --fleet/--solver "
+                             "fleet")
+
     if args.smoke:
         # the CI gate shape: tiny, CPU-friendly, deterministic, with the
         # convergence ring ON so the trace carries a real gap trajectory.
@@ -705,7 +753,19 @@ def _cmd_train(args) -> int:
         # NEED the RBF kernel (linear fails on them by construction), so
         # linear/poly smoke runs separable blobs, and --task svr runs the
         # sine regression problem with an R^2 gate.
-        if args.task == "svr":
+        if args.task == "ovr":
+            # the multiclass cell: a 10-class mnist-shaped problem small
+            # enough for CI, accuracy-gated against chance (0.1); the
+            # binary branches below force multiclass OFF, so this one
+            # keeps its own shape and skips the binary-only ring gate
+            args.synthetic, args.d = "mnist-like", 64
+            args.C, args.gamma = 10.0, 1.0 / 64
+            args.train = args.data = None
+            args.test = None
+            args.n, args.n_test, args.n_limit = 1024, 256, None
+            args.mode = "single"
+            args.solver = args.solver or "blocked"
+        elif args.task == "svr":
             args.synthetic, args.d = "sine", 2
             args.C, args.gamma, args.epsilon = 10.0, 20.0, 0.1
         elif args.kernel == "rbf":
@@ -716,13 +776,16 @@ def _cmd_train(args) -> int:
             args.C, args.gamma = 1.0, 1.0
             if args.kernel == "poly" and args.coef0 == 0.0:
                 args.coef0 = 1.0  # odd-degree poly needs the affine term
-        args.train = args.data = None
-        args.test = None
-        args.n, args.n_test, args.n_limit = 240, 60, None
-        args.mode, args.multiclass = "single", False
-        args.solver = args.solver or "blocked"
-        if args.convergence == 0:
-            args.convergence = 32
+        if args.task != "ovr":
+            args.train = args.data = None
+            args.test = None
+            args.n, args.n_test, args.n_limit = 240, 60, None
+            args.mode, args.multiclass = "single", False
+            args.solver = args.solver or "blocked"
+            if args.convergence == 0:
+                # the ring is a binary blocked-solver surface; the ovr
+                # smoke gates statuses/accuracy instead
+                args.convergence = 32
 
     # "float64" (the default) = the library's "auto" resolution: f64
     # accumulators + x64 enabled — one source of truth for that rule. The
@@ -753,6 +816,16 @@ def _cmd_train(args) -> int:
                         **kernel_kw)
 
     solver_opts = _parse_solver_opts(args.solver_opt)
+
+    if args.fleet_compact:
+        if "compact_every" in solver_opts:
+            raise SystemExit("--fleet-compact and --solver-opt "
+                             "compact_every= are the same knob; pass one")
+        solver_opts["compact_every"] = args.fleet_compact
+    if args.smoke and args.task == "ovr":
+        # CI-sized working set for the 10 small heads (q=1024 would
+        # clamp to the whole smoke training set)
+        solver_opts.setdefault("q", 128)
 
     # dedicated ladder flags fold into the same solver_opts the models
     # consume; passing both spellings is a conflict, not a silent override
@@ -788,12 +861,20 @@ def _cmd_train(args) -> int:
         from tpusvm.solver.shrink import shrinking_blocked_solve
 
         solver_name = args.solver or ("pair" if args.multiclass else "blocked")
-        fn = blocked_smo_solve if solver_name == "blocked" else smo_solve
+        if solver_name == "fleet":
+            from tpusvm.fleet.solve import fleet_smo_solve
+            fn = fleet_smo_solve
+        else:
+            fn = blocked_smo_solve if solver_name == "blocked" else smo_solve
         # arrays and the hyperparameters with dedicated CLI flags are not
         # --solver-opt material (passing them twice would TypeError in fit)
         flagged = {"C", "gamma", "eps", "tau", "max_iter", "accum_dtype",
                    "kernel", "degree", "coef0"}
         reserved = {"X", "Y", "valid", "alpha0", "sn", "targets",
+                    # the fleet launch's batched surface (driven by
+                    # fleet_train, not --solver-opt)
+                    "Ys", "Cs", "gammas", "valids", "alpha0s",
+                    "resume_states",
                     # the checkpoint driver's internal resume surface
                     "resume_state", "pause_at", "return_state",
                     # the shrink driver's internal surfaces
@@ -804,6 +885,9 @@ def _cmd_train(args) -> int:
             # knobs (models route to solver/shrink.py on shrink_every)
             known |= set(inspect.signature(
                 shrinking_blocked_solve).parameters) - reserved
+        elif solver_name == "fleet":
+            # the packing/compaction knobs of the fleet driver
+            known |= {"bucket", "compact_every"}
         bad = sorted(set(solver_opts) - known)
         if bad:
             hint = [k for k in bad if k in flagged]
@@ -960,10 +1044,11 @@ def _cmd_train(args) -> int:
     elif args.multiclass:
         if args.mode != "single":
             raise SystemExit("--multiclass currently supports --mode single")
-        if args.class_parallel and args.solver == "blocked":
+        if args.class_parallel and args.solver in ("blocked", "fleet"):
             raise SystemExit(
                 "--class-parallel shards the vmapped pair solver over the "
-                "mesh; --solver blocked trains classes sequentially instead"
+                "mesh; --solver blocked trains classes sequentially and "
+                "--solver fleet is already one batched launch"
             )
         model = OneVsRestSVC(config=cfg, dtype=dtype, scale=not args.no_scale,
                              accum_dtype=accum_dtype,
@@ -1070,6 +1155,32 @@ def _cmd_train(args) -> int:
     log.event("timing", **timer.asdict())
     log.close()
     _close_tracer(tracer)
+
+    if args.smoke and args.task == "ovr":
+        # the multiclass cell's gates: every head terminated CONVERGED,
+        # and the 10-class argmax beats chance (0.1) with margin — the
+        # fleet and loop paths share these gates, so `--fleet` smoke
+        # failing while the loop passes is a fleet regression
+        from tpusvm.status import Status as _Status
+
+        failures = []
+        bad = [
+            (int(c), _Status(int(s)).name)
+            for c, s in zip(model.classes_, model.statuses_)
+            if int(s) != _Status.CONVERGED
+        ]
+        if bad:
+            failures.append(f"heads did not converge: {bad}")
+        if acc is None or acc <= 0.25:
+            failures.append(f"held-out accuracy gate failed ({acc!r})")
+        if failures:
+            for f in failures:
+                print(f"TRAIN SMOKE FAILED: {f}")
+            return 1
+        print(f"train smoke ok [ovr/{args.solver}]: "
+              f"{len(model.classes_)} heads, SV union "
+              f"{model.X_sv_.shape[0]}, accuracy {acc:.4f}")
+        return 0
 
     if args.smoke:
         gate_name = "r2" if args.task == "svr" else "accuracy"
@@ -1519,12 +1630,16 @@ def _cmd_tune(args) -> int:
                      coef0=args.coef0)
     kernel_specs = (None if not args.kernels
                     else [k.strip() for k in args.kernels.split(",")])
-    config = TuneConfig(
-        folds=args.folds, seed=args.fold_seed, schedule=args.schedule,
-        eta=args.eta, min_rung=args.min_rung,
-        warm_start=not args.no_warm_start, patience=args.patience,
-        plateau_tol=args.plateau_tol,
-    )
+    try:
+        config = TuneConfig(
+            folds=args.folds, seed=args.fold_seed, schedule=args.schedule,
+            eta=args.eta, min_rung=args.min_rung,
+            warm_start=not args.no_warm_start, patience=args.patience,
+            plateau_tol=args.plateau_tol, fleet=args.fleet,
+            fleet_compact=args.fleet_compact,
+        )
+    except ValueError as e:
+        raise SystemExit(f"tune: {e}")
 
     import warnings
 
@@ -1627,6 +1742,12 @@ def _cmd_tune(args) -> int:
         warm_ok = True
         for fam in {r["kernel"] for r in evaluated}:
             fam_rows = [r for r in evaluated if r["kernel"] == fam]
+            if args.fleet:
+                # a fleet grid schedule fits the whole population in one
+                # concurrent launch — there is no already-solved
+                # neighbour to seed from, so the warm gate is vacuous
+                # (halving fleets warm across rungs instead)
+                continue
             warm_ok &= all(r["warm_seeded"] == args.folds
                            for r in fam_rows[1:])
         acc_ok = all(r["cv_accuracy"] is not None

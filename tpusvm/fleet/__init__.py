@@ -1,0 +1,28 @@
+"""tpusvm.fleet — batched many-model SMO training (one XLA program).
+
+Public surface:
+  fleet_smo_solve  — the batched jit entry (X shared, (B,)-axis y/C/gamma)
+  fleet_train      — pack -> one launch -> per-problem SMOResults
+  pack_problems / FleetBatch / bucket_for — problem packing + bucketing
+  unpack_results / fleet_convergence_summary — result unpacking
+"""
+
+from tpusvm.fleet.batch import (
+    FleetBatch,
+    bucket_for,
+    fleet_opt_errors,
+    pack_problems,
+)
+from tpusvm.fleet.results import fleet_convergence_summary, unpack_results
+from tpusvm.fleet.solve import fleet_smo_solve, fleet_train
+
+__all__ = [
+    "FleetBatch",
+    "bucket_for",
+    "fleet_opt_errors",
+    "pack_problems",
+    "fleet_convergence_summary",
+    "unpack_results",
+    "fleet_smo_solve",
+    "fleet_train",
+]

@@ -1,0 +1,84 @@
+"""Unpacking a batched fleet result into per-problem SMOResults.
+
+The fleet launch returns ONE SMOResult whose every field carries the
+leading problem axis (padding lanes included). Consumers — models.ovr's
+head loop replacement, tune's rung scoring, the CLI — want the same
+per-problem surface the host loop gave them: this module slices the
+batch back apart, drops the inert padding lanes, and re-wraps each
+problem's telemetry ring slice as its own ConvergenceTelemetry, so a
+fleet-trained problem's downstream handling is indistinguishable from a
+loop-trained one.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from tpusvm.solver.smo import SMOResult
+from tpusvm.status import Status
+
+__all__ = ["lane_result", "unpack_results", "fleet_convergence_summary"]
+
+
+def lane_result(res: SMOResult, i: int) -> SMOResult:
+    """One lane of a batched SMOResult as a per-problem SMOResult.
+
+    Pure slicing: lane i's alpha/b/status/counters come back bitwise as
+    the batched program computed them. The telemetry ring (when the
+    launch carried telemetry=T) is sliced and re-wrapped so
+    obs.convergence consumers (gap tables, trace events) work per head.
+    """
+    tele = None
+    if res.telemetry is not None:
+        t = res.telemetry
+        tele = type(t)(gap=t.gap[i], n_upd=t.n_upd[i],
+                       status=t.status[i], count=t.count[i],
+                       active=t.active[i])
+    return SMOResult(
+        alpha=res.alpha[i],
+        b=res.b[i],
+        b_high=res.b_high[i],
+        b_low=res.b_low[i],
+        n_iter=res.n_iter[i],
+        status=res.status[i],
+        n_outer=None if res.n_outer is None else res.n_outer[i],
+        n_refines=(None if res.n_refines is None
+                   else res.n_refines[i]),
+        telemetry=tele,
+        cache_hits=(None if res.cache_hits is None
+                    else res.cache_hits[i]),
+        cache_misses=(None if res.cache_misses is None
+                      else res.cache_misses[i]),
+    )
+
+
+def unpack_results(res: SMOResult, n_problems: int) -> List[SMOResult]:
+    """Batched SMOResult -> per-problem SMOResults (padding dropped)."""
+    B = res.alpha.shape[0]
+    if n_problems > B:
+        raise ValueError(
+            f"unpack_results: {n_problems} problems from a {B}-lane "
+            "batch"
+        )
+    return [lane_result(res, i) for i in range(n_problems)]
+
+
+def fleet_convergence_summary(results: List[SMOResult]) -> dict:
+    """Per-problem convergence telemetry, aggregated for logs/benches.
+
+    One host materialisation pass over the unpacked lanes: per-problem
+    statuses/updates/rounds plus the fleet-level counts a log line or
+    bench row wants. Works with telemetry on or off (the ring only adds
+    per-problem recorded-round counts)."""
+    statuses = [Status(int(r.status)) for r in results]
+    summary = {
+        "problems": len(results),
+        "converged": sum(s == Status.CONVERGED for s in statuses),
+        "statuses": [s.name for s in statuses],
+        "updates": [int(r.n_iter) - 1 for r in results],
+        "outer_rounds": [int(r.n_outer) for r in results],
+    }
+    if results and results[0].telemetry is not None:
+        summary["telemetry_rounds"] = [int(r.telemetry.count)
+                                       for r in results]
+    return summary

@@ -1,0 +1,310 @@
+"""Batched many-model SMO: train a fleet of SVMs as ONE XLA program.
+
+The cascade parallelises one problem across workers; this module
+parallelises PROBLEMS across one device program. B optimisation problems
+sharing X but with distinct (y, C, gamma) — the 10 OvR heads, a tune
+rung's (C, gamma) population, per-tenant heads — vmap over the blocked
+solver's core (solver/blocked.py `blocked_smo_core`, the "Fleet vmap
+contract" refactor): one jit launch, one X residency, every problem's
+FLOPs batched into the same MXU contractions. Problems individually too
+small to saturate the hardware ride together.
+
+Per-problem convergence masking is structural, not bolted on: the core's
+ENTIRE solve state lives in its while-loop carry, so JAX's while/cond
+batching rules turn the batched stop into "loop while any problem still
+RUNNING" and freeze a terminated problem's carry with a per-lane select.
+A converged problem no-ops its alpha/f updates; the Keerthi stop is the
+batched all-problems reduction; the per-problem update/round counters and
+the telemetry ring simply gain the leading problem axis. A problem's
+result is therefore BIT-IDENTICAL no matter which companions share its
+bucket program (tests/test_fleet.py pins this bitwise — the hard
+no-crosstalk gate). Against a separately-compiled solo program the
+convergence point matches at the solution level (identical SV sets, b
+within the cross-engine band): XLA emits different fma/fusion patterns
+for batched vs unbatched programs, so cross-PROGRAM bitwise equality is
+not a property any XLA rewrite preserves — parity gates compare SV
+identity and accuracy exactly, b/alpha at the oracle band, and reserve
+bitwise assertions for same-program lane invariance.
+
+Launch economics: power-of-two problem buckets (fleet/batch.py) bound
+jit signatures, and C/gamma enter as ARRAYS — their values cannot bake
+into the trace, so a whole (C, gamma) sweep at one bucket is ONE compile
+(the weak-scalar discipline obs/prof.py keys caches by, here enforced by
+construction; benchmarks/fleet_train.py gates recompiles == 0 across a
+sweep). One kernel-family bucket per launch: the family and every other
+jit-static knob are shared by the whole fleet — per-problem statics are
+a contradiction in terms, validated at the boundary
+(batch.fleet_opt_errors).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tpusvm import kernels
+from tpusvm.fleet.batch import bucket_for, fleet_opt_errors, pack_problems
+from tpusvm.fleet.results import lane_result, unpack_results
+from tpusvm.obs import prof
+from tpusvm.ops.rbf import sq_norms
+from tpusvm.solver.blocked import blocked_smo_core
+from tpusvm.solver.smo import SMOResult
+from tpusvm.status import Status
+
+# the fleet launch's static surface: the vmap-clean subset of
+# _BLOCKED_STATIC (solver/blocked.py) — everything Pallas/host-segmented
+# is pinned off inside the vmapped call and rejected at the boundary
+_FLEET_STATIC = (
+    "q", "max_outer", "max_inner", "warm_start", "accum_dtype",
+    "wss", "selection", "refine", "max_refines", "matmul_precision",
+    "telemetry", "kernel", "degree", "kernel_fast", "return_state",
+)
+
+
+@functools.partial(jax.jit, static_argnames=_FLEET_STATIC)
+def _fleet_smo_solve_jit(
+    X: jax.Array,
+    Ys: jax.Array,
+    valids: Optional[jax.Array] = None,
+    alpha0s: Optional[jax.Array] = None,
+    *,
+    Cs: jax.Array,
+    gammas: jax.Array,
+    sn: Optional[jax.Array] = None,
+    eps: float = 1e-12,
+    tau: float = 1e-5,
+    max_iter: int = 100000,
+    q: int = 1024,
+    max_outer: int = 5000,
+    max_inner: int = 1024,
+    warm_start: bool = False,
+    accum_dtype=None,
+    wss: int = 1,
+    selection: str = "auto",
+    refine: int = 0,
+    max_refines: int = 2,
+    matmul_precision: Optional[str] = None,
+    telemetry: int = 0,
+    kernel: str = "rbf",
+    degree: int = 3,
+    coef0: float = 0.0,
+    kernel_fast: bool = True,
+    resume_states=None,
+    pause_at: Optional[jax.Array] = None,
+    return_state: bool = False,
+) -> SMOResult:
+    """Solve B problems sharing X as one batched program.
+
+    Ys is (B, n) with per-problem +/-1 labels (0 = inert padding lane,
+    fleet/batch.py); Cs/gammas are (B,) per-problem hyperparameters —
+    ARRAYS, so a sweep over their values reuses one executable. valids
+    (B, n) and alpha0s (B, n) are optional per-problem row masks and
+    warm seeds. Every static knob is shared by the launch; the result
+    is a batched SMOResult — every field (alpha, b, status, n_iter,
+    n_outer, telemetry ring...) carries the leading problem axis.
+
+    sn: optional precomputed sq_norms(X) — shared by every problem (X
+    is shared), computed once here when omitted; rbf only.
+
+    resume_states / pause_at / return_state: the problem-axis
+    compaction surface (fleet_train's segment driver, mirroring the
+    checkpoint/shrink segmenters): pause_at stops every lane once ITS
+    n_outer reaches the bound (running lanes advance in lockstep, so
+    this is a segment boundary), return_state=True also returns the
+    batched carry, and resume_states re-enters from a carry whose
+    problem axis the driver may have SLICED down to a smaller bucket —
+    each lane's carry is independent, so dropping finished lanes and
+    re-entering is exact per surviving lane.
+    """
+    if Ys.ndim != 2:
+        raise ValueError(
+            f"fleet_smo_solve wants Ys of shape (B, n), got {Ys.shape}; "
+            "for a single problem use blocked_smo_solve"
+        )
+    B, n = Ys.shape
+    if X.shape[0] != n:
+        raise ValueError(
+            f"fleet problems carry {n} rows but X has {X.shape[0]}"
+        )
+    for name, arr in (("Cs", Cs), ("gammas", gammas)):
+        arr = jnp.asarray(arr)
+        if arr.shape != (B,):
+            raise ValueError(
+                f"{name} must be one value per problem, shape ({B},), "
+                f"got {arr.shape}"
+            )
+    adt = X.dtype if accum_dtype is None else accum_dtype
+    if valids is None:
+        valids = jnp.ones((B, n), bool)
+    if alpha0s is None:
+        alpha0s = jnp.zeros((B, n), adt)
+
+    # one X stream for the WHOLE fleet (every problem shares the rows);
+    # only the rbf family has row norms
+    if kernels.needs_norms(kernel) and sn is None:
+        sn = sq_norms(X)
+
+    def one(y, valid, alpha0, C, gamma, resume_state=None):
+        # dtype discipline: a solo solve receives C/gamma as WEAK python
+        # floats, which adopt the context dtype (gamma the f32 kernel
+        # pipeline, C the accum-dtype comparisons); the batched lanes
+        # arrive as STRONG f64 array elements, which would silently
+        # promote the f32 kernel evaluations to f64 — cast each to the
+        # dtype its solo trace computes in, so the batched program is
+        # the vmap of the identical program
+        return blocked_smo_core(
+            X, y, valid, alpha0, sn=sn,
+            C=C.astype(adt), gamma=gamma.astype(X.dtype), eps=eps,
+            tau=tau, max_iter=max_iter, q=q, max_outer=max_outer,
+            max_inner=max_inner, warm_start=warm_start,
+            accum_dtype=accum_dtype, inner="xla", wss=wss,
+            selection=selection, refine=refine, max_refines=max_refines,
+            matmul_precision=matmul_precision, fused_fupdate=False,
+            telemetry=telemetry, kernel=kernel, degree=degree,
+            coef0=coef0, kernel_fast=kernel_fast,
+            resume_state=resume_state, pause_at=pause_at,
+            return_state=return_state,
+        )
+
+    mapped = (Ys, valids, alpha0s, jnp.asarray(Cs), jnp.asarray(gammas))
+    if resume_states is None:
+        return jax.vmap(one)(*mapped)
+    return jax.vmap(one)(*mapped, resume_states)
+
+
+# observatory + IR-audit registration: the fleet launch is a first-class
+# jit entry point — `ir-audit` traces its batched jaxpr (JXIR101-106) and
+# `--trace` runs record its lower/compile cost like every other entry
+fleet_smo_solve = prof.profiled_jit(
+    "solver.fleet_smo_solve", _fleet_smo_solve_jit, static=_FLEET_STATIC,
+)
+
+
+def _slice_lanes(tree, idx):
+    """Slice every leaf of a batched pytree down to the given lanes."""
+    idx = jnp.asarray(idx, jnp.int32)
+    return jax.tree.map(lambda x: x[idx], tree)
+
+
+def fleet_train(
+    X,
+    Ys: Sequence,
+    Cs: Sequence[float],
+    gammas: Sequence[float],
+    *,
+    valids=None,
+    alpha0s=None,
+    sn=None,
+    bucket: Optional[int] = None,
+    compact_every: int = 0,
+    **solver_opts,
+) -> List[SMOResult]:
+    """Pack -> fleet launch(es) -> per-problem SMOResults.
+
+    The convenience driver consumers call (models.ovr trains all heads
+    through one of these; tune dispatches each rung's fold batch as
+    one): packs the B problems into a power-of-two bucket with inert
+    padding (fleet/batch.py), validates the static knobs are
+    fleet-compatible, launches, and unpacks the padded batched result
+    back into per-problem SMOResults (fleet/results.py). solver_opts
+    are the fleet statics (q, wss, telemetry, kernel, ...) plus
+    eps/tau/max_iter.
+
+    compact_every=0 (default): ONE launch to global convergence — one
+    program, one dispatch; right when the fleet's round counts are
+    balanced (OvR heads) or the backend is parallel enough that the
+    lockstep waste is hidden (TPU). R > 0: problem-axis COMPACTION —
+    run R outer rounds per segment (pause_at), harvest lanes whose
+    status left RUNNING, slice the surviving lanes' carries down to the
+    next power-of-two bucket and resume (resume_states). The batched
+    while-loop otherwise runs every lane until the SLOWEST converges
+    (a finished lane's carry is frozen but its lockstep body compute is
+    not free), so an imbalanced fleet — a tune rung's (C, gamma)
+    population — pays ~B*max(rounds) lane-rounds; compaction bounds
+    that at ~sum(rounds) + B*R. Each lane's carry is independent state,
+    so segmenting + slicing is exact per problem; compiled programs
+    stay bounded at <= 2 per bucket (cold entry + resume entry).
+    """
+    errors = fleet_opt_errors(solver_opts)
+    if errors:
+        raise ValueError("; ".join(errors))
+    if compact_every < 0:
+        raise ValueError(
+            f"compact_every must be >= 0 rounds, got {compact_every}"
+        )
+    # strip knobs at their inert defaults: the fleet jit's signature
+    # does not carry them (they are pinned inside the vmapped call)
+    opts = {k: v for k, v in solver_opts.items()
+            if k not in ("inner", "fused_fupdate", "krow_cache",
+                         "shrink_stable", "pallas_fused_selection",
+                         "pallas_eta_exclude", "pallas_multipair",
+                         "resume_state", "pause_at", "return_state",
+                         "pallas_layout")}
+    batch = pack_problems(Ys, Cs, gammas, valids=valids,
+                          alpha0s=alpha0s, bucket=bucket)
+    if batch.alpha0s is not None:
+        # seeded problems need the warm-start f reconstruction; cold
+        # lanes carry alpha0=0, whose reconstruction is exactly -z, so
+        # mixing seeded and cold problems in one warm launch is exact
+        opts.setdefault("warm_start", True)
+    adt = opts.get("accum_dtype")
+    Ys_d = jnp.asarray(batch.Ys)
+    valids_d = (None if batch.valids is None
+                else jnp.asarray(batch.valids))
+    alpha0s_d = (None if batch.alpha0s is None
+                 else jnp.asarray(batch.alpha0s,
+                                  adt if adt is not None else X.dtype))
+    Cs_d = jnp.asarray(batch.Cs)
+    gs_d = jnp.asarray(batch.gammas)
+
+    if not compact_every:
+        res = fleet_smo_solve(X, Ys_d, valids_d, alpha0s_d,
+                              Cs=Cs_d, gammas=gs_d, sn=sn, **opts)
+        return unpack_results(res, batch.n_problems)
+
+    # segment driver: lanes = positions into the ORIGINAL problem list;
+    # padding lanes terminate NO_WORKING_SET in segment 1 and are
+    # dropped with the first harvest (their results are discarded)
+    results = {}
+    live = list(range(batch.bucket))
+    states = None
+    seg = 0
+    while live:
+        seg += 1
+        pause = jnp.int32(seg * compact_every)
+        res, states = fleet_smo_solve(
+            X, Ys_d, valids_d, alpha0s_d, Cs=Cs_d, gammas=gs_d, sn=sn,
+            resume_states=states, pause_at=pause, return_state=True,
+            **opts,
+        )
+        statuses = np.asarray(res.status)
+        for i, lane in enumerate(live):
+            if statuses[i] != Status.RUNNING and lane < batch.n_problems:
+                results[lane] = lane_result(res, i)
+        keep = [i for i in range(len(live))
+                if statuses[i] == Status.RUNNING]
+        live = [live[i] for i in keep]
+        if not live:
+            break
+        # re-bucket the survivors: pad the KEPT lane list back up to a
+        # power of two by repeating the last survivor — a duplicated
+        # lane computes identical (discarded) results, stays inert to
+        # its twin, and keeps every array at a bucketed shape
+        bkt = bucket_for(len(live))
+        sel = keep + [keep[-1]] * (bkt - len(keep))
+        Ys_d = Ys_d[jnp.asarray(sel)]
+        valids_d = None if valids_d is None else valids_d[jnp.asarray(sel)]
+        alpha0s_d = (None if alpha0s_d is None
+                     else alpha0s_d[jnp.asarray(sel)])
+        Cs_d = Cs_d[jnp.asarray(sel)]
+        gs_d = gs_d[jnp.asarray(sel)]
+        states = _slice_lanes(states, sel)
+        live = live + [live[-1]] * (bkt - len(live))
+    missing = [i for i in range(batch.n_problems) if i not in results]
+    if missing:  # pragma: no cover — every lane terminates (max_outer)
+        raise RuntimeError(f"fleet_train lost lanes {missing}")
+    return [results[i] for i in range(batch.n_problems)]

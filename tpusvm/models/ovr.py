@@ -13,7 +13,17 @@ chips"). TPU-native design:
     sharing one compiled executable — each class's FLOPs ride the MXU, so
     on big problems (MNIST-60k scale) this is orders of magnitude faster
     than lockstep pairwise, whose vmapped while_loop streams all of X once
-    per class per 2-alpha update.
+    per class per 2-alpha update. The scaled X and its row norms are
+    computed ONCE and shared by every head's solve (sn=).
+  - training, solver="fleet": ALL K one-vs-rest heads as ONE batched
+    blocked-solver program (tpusvm.fleet) — the K problems share X and
+    differ only in their +/-1 label vectors, so they pack into one
+    power-of-two bucket launch with per-class convergence masking in the
+    carry. One compile, one X residency, every head's contraction batched
+    onto the MXU together; each head converges to the same optimum as its
+    solver="blocked" loop fit (exact SV-set parity, b within the
+    cross-engine band — tests/test_fleet.py). The right mode when heads
+    are individually too small to saturate the hardware.
   - training, class_parallel=True: the BASELINE config-5 design verbatim
     ("10 SVMs vmapped over chips") — the class axis is sharded over a 1-D
     device mesh via shard_map, each device running the vmapped pair solver
@@ -75,8 +85,10 @@ class OneVsRestSVC:
         class_parallel: bool = False,
         mesh=None,
     ):
-        if solver not in ("pair", "blocked"):
-            raise ValueError(f"solver must be pair|blocked, got {solver!r}")
+        if solver not in ("pair", "blocked", "fleet"):
+            raise ValueError(
+                f"solver must be pair|blocked|fleet, got {solver!r}"
+            )
         if solver == "blocked" and batched:
             warnings.warn(
                 "batched=True has no effect with solver='blocked' "
@@ -92,7 +104,9 @@ class OneVsRestSVC:
             raise ValueError(
                 "class_parallel=True requires solver='pair' (the vmapped "
                 "lockstep solver BASELINE config 5 names); the blocked "
-                "solver trains classes sequentially instead"
+                "solver trains classes sequentially and the fleet solver "
+                "is already one single-launch batched program (sharding "
+                "a fleet over the mesh is a future PR)"
             )
         self.config = config
         self.dtype = dtype
@@ -143,6 +157,16 @@ class OneVsRestSVC:
         if not self.class_parallel:
             Xd = jnp.asarray(Xs, self.dtype)
 
+        if self.solver in ("blocked", "fleet"):
+            # both blocked-core modes share one hoisted row-norms
+            # precompute: the K heads train on the SAME rows, so the
+            # O(n*d) sq_norms stream is paid once for the whole model
+            # instead of once per head's solve (rbf only — no norms
+            # exist for the other families)
+            from tpusvm import kernels as _kernels
+
+            sn_shared = (sq_norms(Xd)
+                         if _kernels.needs_norms(cfg.kernel) else None)
         if self.solver == "blocked":
             # per-class blocked working-set solves, sequentially: every
             # class reuses ONE compiled executable (identical shapes), each
@@ -154,11 +178,13 @@ class OneVsRestSVC:
 
             def solve_one(y):
                 return blocked_smo_solve(
-                    Xd, y, C=cfg.C, gamma=cfg.gamma, eps=cfg.eps,
-                    tau=cfg.tau, max_iter=cfg.max_iter,
+                    Xd, y, sn=sn_shared, C=cfg.C, gamma=cfg.gamma,
+                    eps=cfg.eps, tau=cfg.tau, max_iter=cfg.max_iter,
                     kernel=cfg.kernel, degree=cfg.degree, coef0=cfg.coef0,
                     accum_dtype=accum_dtype, **self.solver_opts,
                 )
+        elif self.solver == "fleet":
+            pass  # one batched launch below — no per-class solve_one
         else:
             def solve_pair(Xarr, y):
                 return smo_solve(
@@ -255,6 +281,26 @@ class OneVsRestSVC:
             bs = np.asarray(res.b)[:K]
             iters = np.asarray(res.n_iter)[:K]
             statuses = np.asarray(res.status)[:K]
+        elif self.solver == "fleet":
+            # ONE batched launch trains every head: the K one-vs-rest
+            # problems share X (and the hoisted norms) and differ only
+            # in labels, so they pack into a power-of-two bucket with
+            # inert padding lanes; per-class convergence masking lives
+            # in the batched while-loop carry (tpusvm.fleet)
+            from tpusvm.fleet import fleet_train
+
+            K = Ys.shape[0]
+            outs = fleet_train(
+                Xd, list(Ys), [cfg.C] * K, [cfg.gamma] * K,
+                sn=sn_shared, eps=cfg.eps, tau=cfg.tau,
+                max_iter=cfg.max_iter, kernel=cfg.kernel,
+                degree=cfg.degree, coef0=cfg.coef0,
+                accum_dtype=accum_dtype, **self.solver_opts,
+            )
+            alphas = np.stack([np.asarray(o.alpha) for o in outs])
+            bs = np.asarray([float(o.b) for o in outs])
+            iters = np.asarray([int(o.n_iter) for o in outs])
+            statuses = np.asarray([int(o.status) for o in outs])
         elif self.batched and self.solver == "pair":
             res = jax.vmap(solve_one)(jnp.asarray(Ys))
             alphas = np.asarray(res.alpha)           # (K, n)

@@ -44,6 +44,7 @@ KEY_FIELDS = (
     "bench", "metric", "summary", "mode", "engine", "kernel", "task",
     "config", "threads", "topology", "P", "n", "n_train", "d", "q",
     "seed", "case", "rows_per_shard", "telemetry", "smoke", "rung",
+    "bucket", "B",
 )
 
 
@@ -107,6 +108,27 @@ SCHEMA_RULES: Dict[str, Tuple[Rule, ...]] = {
     "mnist60k_smo_train_time": (
         Rule("value", "<=", rel_tol=0.3, timing=True),
         Rule("vs_baseline", ">=", rel_tol=0.3, timing=True),
+    ),
+    # round 12, the fleet: rows pair on (bench, mode, B, bucket, n, d,
+    # q). Correctness metrics are exact — every fleet arm must keep the
+    # host-looped control's per-head SV sets and held-out accuracy
+    # byte-for-byte (sv_parity/accuracy_parity are the harness's own
+    # verdicts, statuses the per-head terminations) — the sweep may
+    # never start recompiling (launch economics: per-problem (C, gamma)
+    # are arrays), and the aggregate-throughput metrics are
+    # direction-gated at full level
+    "fleet_train": (
+        Rule("statuses", "=="),
+        Rule("sv_parity", "=="),
+        Rule("accuracy_parity", "=="),
+        Rule("sv_counts", "=="),
+        Rule("accuracy", "=="),
+        Rule("sweep_recompiles", "<="),
+        Rule("updates", "<=", rel_tol=0.1),
+        Rule("agg_speedup", ">=", rel_tol=0.25, timing=True),
+        Rule("train_s", "<=", rel_tol=0.35, timing=True),
+        Rule("loop_train_s", "<=", rel_tol=0.35, timing=True),
+        Rule("problems_per_s", ">=", rel_tol=0.25, timing=True),
     ),
     # round 9, the solver speed ladder: per-rung rows pair on (bench,
     # rung, n, d, q). Correctness metrics are exact — every rung must

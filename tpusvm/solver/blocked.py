@@ -308,7 +308,10 @@ def _inner_smo(K_BB, y_B, a_B, f_B, active_B, C, eps, tau, max_inner,
         a_B = a_B.at[i_h].add(upd.da_h)
         a_B = a_B.at[i_l].add(upd.da_l)
         ok = upd.do_update & ~upd.stalled
-        n_upd = n_upd + jnp.where(ok, 1, 0).astype(jnp.int32)
+        # .astype on the bool, not jnp.where(ok, 1, 0): the literal
+        # branches would make a WEAK int32, which the fleet's vmap
+        # batches into a weak-typed array (JXIR102)
+        n_upd = n_upd + ok.astype(jnp.int32)
         progress = progress | ok
 
         reason = jnp.where(
@@ -388,8 +391,7 @@ def bootstrap_candidates(f, alpha, Y, valid, C, eps, ncand: int):
     return (uv, ui.astype(jnp.int32), lv, li.astype(jnp.int32))
 
 
-@functools.partial(jax.jit, static_argnames=_BLOCKED_STATIC)
-def _blocked_smo_solve_jit(
+def blocked_smo_core(
     X: jax.Array,
     Y: jax.Array,
     valid: Optional[jax.Array] = None,
@@ -653,6 +655,22 @@ def _blocked_smo_solve_jit(
     (tests/test_obs.py asserts this; benchmarks/telemetry_overhead.py
     bounds the time cost at <= 3%). When the solve runs more than T
     outer rounds the ring holds the LAST T (count says how many ran).
+
+    Fleet vmap contract (tpusvm.fleet): this un-jitted core is the
+    function the batched many-model solver vmaps over a leading problem
+    axis — (Y, valid, alpha0, C, gamma) mapped, (X, sn) broadcast. The
+    whole solve state lives in the while-loop carry, so JAX's while/cond
+    batching rules give per-problem convergence masking for free: the
+    batched loop runs until every problem terminates, and a problem
+    whose status has left RUNNING has its carry frozen by the batching
+    rule's per-lane select — its alpha/f/counters are bit-identical to
+    the same problem solved next to ANY companion set in the same
+    bucket program (tests/test_fleet.py pins this bitwise). Only
+    vmap-clean static configs batch: inner='xla' (the Pallas subproblem
+    kernel has no batching rule), fused_fupdate=False, krow_cache=0,
+    shrink_stable=0 (the shrinking driver is a host-side segmenter),
+    pallas_fused_selection=False. tpusvm.fleet.solve enforces that
+    restriction at its boundary.
 
     resume_state / pause_at / return_state: the crash-safe-training
     surface (tpusvm.solver.checkpoint). The outer loop's carry
@@ -1159,7 +1177,8 @@ def _blocked_smo_solve_jit(
         f_exact = needs_refine | (st.f_exact & ~proceed)
         n_refines = st.n_refines + needs_refine.astype(jnp.int32)
 
-        n_outer = st.n_outer + jnp.where(proceed, 1, 0).astype(jnp.int32)
+        n_outer = st.n_outer + proceed.astype(jnp.int32)  # strong int32
+        # (jnp.where(proceed, 1, 0) would be weak — JXIR102 under vmap)
         n_updates = st.n_updates + upd
         tele_gap, tele_upd, tele_status, tele_i, tele_active = (
             st.tele_gap, st.tele_upd, st.tele_status, st.tele_i,
@@ -1304,6 +1323,13 @@ def _blocked_smo_solve_jit(
         return result, final
     return result
 
+
+# the single-problem jit entry: blocked_smo_core traced once per static
+# config, exactly as before the fleet refactor split the core out (the
+# fleet solver jits its OWN vmap of the core instead of nesting jits)
+_blocked_smo_solve_jit = functools.partial(
+    jax.jit, static_argnames=_BLOCKED_STATIC
+)(blocked_smo_core)
 
 # every caller (models, tune, checkpoint, kernels.svr, CLI) goes through
 # this wrapper: with the compile observatory off it is the jit call,

@@ -49,6 +49,9 @@ class TuneResult:
     winner: Dict[str, Any]
     total_updates: int
     wall_s: float
+    # batched fleet dispatch (tpusvm.fleet) — defaulted so results
+    # written before the fleet existed still load
+    fleet: bool = False
 
     def to_dict(self) -> Dict[str, Any]:
         return {
@@ -88,12 +91,17 @@ def load_tune_result(path: str) -> TuneResult:
             f"{path!r}: this build reads version {_FORMAT_VERSION}"
         )
     fields = {f.name for f in dataclasses.fields(TuneResult)}
-    missing = fields - set(raw)
+    required = {
+        f.name for f in dataclasses.fields(TuneResult)
+        if f.default is dataclasses.MISSING
+        and f.default_factory is dataclasses.MISSING
+    }
+    missing = required - set(raw)
     if missing:
         raise ValueError(
             f"{path!r} is missing tune-result fields {sorted(missing)}"
         )
-    return TuneResult(**{k: raw[k] for k in fields})
+    return TuneResult(**{k: raw[k] for k in fields if k in raw})
 
 
 def format_table(result: TuneResult) -> str:

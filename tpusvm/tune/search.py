@@ -75,6 +75,23 @@ class TuneConfig:
         points are recorded as SKIPPED. Ignored by halving (its pruning
         already bounds the cost of bad points).
       plateau_tol: minimum improvement that resets the patience counter.
+      fleet: dispatch each rung's point population as ONE batched fleet
+        launch per fold (tpusvm.fleet) instead of per-point sequential
+        fits — the B grid points share the fold's scaled X (and its
+        cached norms), differing only in (C, gamma), which is exactly
+        the fleet's problem axis; (C, gamma) enter the launch as arrays,
+        so the whole sweep reuses one compiled program per
+        (bucket, rung-size). Warm seeding still works across RUNGS (a
+        point's previous-rung solution seeds its next-rung lane), but
+        not across points WITHIN a rung — the rung solves concurrently,
+        so there is no "already-solved neighbour" to borrow from;
+        expect slightly more updates per rung in exchange for the
+        batched launch. Incompatible with patience (a plateau stop is a
+        sequential notion). The sequential dispatch path remains the
+        default and is what --no-fleet selects from the CLI.
+      fleet_compact: fleet only — compact_every rounds between
+        problem-axis compactions (tpusvm.fleet.fleet_train); 0 = one
+        monolithic launch per (fold, rung).
     """
 
     folds: int = 3
@@ -85,6 +102,8 @@ class TuneConfig:
     warm_start: bool = True
     patience: Optional[int] = None
     plateau_tol: float = 0.0
+    fleet: bool = False
+    fleet_compact: int = 0
 
     def __post_init__(self):
         if self.schedule not in ("grid", "halving"):
@@ -99,6 +118,16 @@ class TuneConfig:
             raise ValueError(f"min_rung must be >= 2, got {self.min_rung}")
         if self.patience is not None and self.patience < 1:
             raise ValueError(f"patience must be >= 1, got {self.patience}")
+        if self.fleet and self.patience is not None:
+            raise ValueError(
+                "fleet=True fits a whole rung's points in one batched "
+                "launch; patience (a sequential plateau stop) cannot "
+                "apply — drop one of the two"
+            )
+        if self.fleet_compact < 0:
+            raise ValueError(
+                f"fleet_compact must be >= 0, got {self.fleet_compact}"
+            )
 
 
 class _FoldCache:
@@ -264,19 +293,20 @@ def tune(
         kern = dict(kernel=spec["kernel"], degree=spec["degree"],
                     coef0=spec["coef0"])
 
+        def seeds_for(pi: int, m: int) -> List[Optional[np.ndarray]]:
+            """Per-fold warm seeds for one point (None entries = cold)."""
+            if not config.warm_start:
+                return [None] * len(caches)
+            C = points[pi][0]
+            return [store.seed(fi, points[pi], m, c.Ytr_host[:m], C)
+                    for fi, c in enumerate(caches)]
+
         def fit_point(pi: int, m: int, rung: int) -> Dict[str, Any]:
             """All k fold fits of one point at rung size m: seeds first,
             then every solve dispatched, then one materialisation pass."""
             C, gamma = points[pi]
-            row = rows[pi]
             t0 = time.perf_counter()
-            seeds = []
-            if config.warm_start:
-                for fi, c in enumerate(caches):
-                    seeds.append(store.seed(fi, points[pi], m,
-                                            c.Ytr_host[:m], C))
-            else:
-                seeds = [None] * len(caches)
+            seeds = seeds_for(pi, m)
             results = []
             for c, seed in zip(caches, seeds):
                 alpha0 = None if seed is None else jnp.asarray(seed, accum)
@@ -289,6 +319,54 @@ def tune(
                     max_iter=base.max_iter, accum_dtype=accum, **kern,
                     **opts,
                 ))
+            return score_point(pi, m, rung, results, seeds,
+                               time.perf_counter() - t0)
+
+        def fit_points_fleet(pis: List[int], m: int,
+                             rung: int) -> List[Dict[str, Any]]:
+            """One rung's whole point population, one fleet launch per
+            fold: the B points share the fold's scaled rows and cached
+            norms and differ only in (C, gamma) — exactly the fleet's
+            problem axis (tpusvm.fleet). Seeds are queried BEFORE the
+            launches (previous rungs only — the rung solves
+            concurrently, so same-rung neighbour seeding cannot
+            happen); the launch wall is attributed evenly across the
+            rung's points."""
+            from tpusvm.fleet import fleet_train
+
+            t0 = time.perf_counter()
+            seeds = {pi: seeds_for(pi, m) for pi in pis}
+            Cs = [points[pi][0] for pi in pis]
+            gs = [points[pi][1] for pi in pis]
+            fold_results = []
+            for fi, c in enumerate(caches):
+                al0 = [seeds[pi][fi] for pi in pis]
+                outs = fleet_train(
+                    c.Xtr[:m], [c.Ytr_host[:m]] * len(pis), Cs, gs,
+                    alpha0s=(al0 if any(a is not None for a in al0)
+                             else None),
+                    sn=c.sn[:m] if rbf else None,
+                    compact_every=config.fleet_compact,
+                    eps=base.eps, tau=base.tau, max_iter=base.max_iter,
+                    accum_dtype=accum, **kern, **opts,
+                )
+                fold_results.append(outs)
+            solve_share = (time.perf_counter() - t0) / max(1, len(pis))
+            return [
+                score_point(pi, m, rung,
+                            [fold_results[fi][j]
+                             for fi in range(len(caches))],
+                            seeds[pi], solve_share)
+                for j, pi in enumerate(pis)
+            ]
+
+        def score_point(pi: int, m: int, rung: int, results, seeds,
+                        solve_s: float) -> Dict[str, Any]:
+            """Materialise + score one point's fold results into its row
+            (shared by the sequential and fleet dispatch paths)."""
+            C, gamma = points[pi]
+            row = rows[pi]
+            t_eval = time.perf_counter()
             accs, svs, updates = [], [], 0
             for fi, (c, res) in enumerate(zip(caches, results)):
                 alpha = np.asarray(res.alpha)  # completion barrier
@@ -312,12 +390,13 @@ def tune(
                     say(f"tune: point (C={C:g}, gamma={gamma:g}, "
                         f"kernel={spec['kernel']}) fold {fi} "
                         f"ended {status.name}")
+            wall = solve_s + (time.perf_counter() - t_eval)
             row.update(
                 rung=rung, n_subset=m,
                 cv_accuracy=float(np.mean(accs)), fold_accuracy=accs,
                 sv_count=float(np.mean(svs)),
                 n_updates=row["n_updates"] + updates,
-                wall_s=row["wall_s"] + (time.perf_counter() - t0),
+                wall_s=row["wall_s"] + wall,
                 warm_seeded=row["warm_seeded"]
                 + sum(s is not None for s in seeds),
             )
@@ -327,39 +406,59 @@ def tune(
                     kernel=spec["kernel"],
                     cv_accuracy=row["cv_accuracy"], n_updates=updates,
                     warm_seeded=sum(s is not None for s in seeds),
-                    wall_s=time.perf_counter() - t0,
+                    wall_s=wall,
                 )
             return row
 
         if config.schedule == "grid":
-            best = -np.inf
-            since_improve = 0
-            for pi in range(len(points)):
-                row = fit_point(pi, n_full, rung=0)
-                row["status"] = TuneStatus.EVALUATED.name
-                say(f"tune: [{spec['kernel']}] C={row['C']:g} "
-                    f"gamma={row['gamma']:g} "
-                    f"cv={row['cv_accuracy']:.4f} "
-                    f"updates={row['n_updates']} "
-                    f"warm={row['warm_seeded']}/{config.folds}")
-                if row["cv_accuracy"] > best + config.plateau_tol:
-                    best = row["cv_accuracy"]
-                    since_improve = 0
-                else:
-                    since_improve += 1
-                if config.patience and since_improve >= config.patience:
-                    say(f"tune: plateau after {pi + 1}/{len(points)} "
-                        f"points (no improvement in {since_improve})")
-                    break
+            if config.fleet:
+                # the whole grid is one rung: one fleet launch per fold
+                # trains every point's fit together (patience is
+                # rejected by TuneConfig — there is no sequential sweep
+                # to stop early)
+                for row in fit_points_fleet(list(range(len(points))),
+                                            n_full, rung=0):
+                    row["status"] = TuneStatus.EVALUATED.name
+                    say(f"tune: [{spec['kernel']}] C={row['C']:g} "
+                        f"gamma={row['gamma']:g} "
+                        f"cv={row['cv_accuracy']:.4f} "
+                        f"updates={row['n_updates']} (fleet)")
+            else:
+                best = -np.inf
+                since_improve = 0
+                for pi in range(len(points)):
+                    row = fit_point(pi, n_full, rung=0)
+                    row["status"] = TuneStatus.EVALUATED.name
+                    say(f"tune: [{spec['kernel']}] C={row['C']:g} "
+                        f"gamma={row['gamma']:g} "
+                        f"cv={row['cv_accuracy']:.4f} "
+                        f"updates={row['n_updates']} "
+                        f"warm={row['warm_seeded']}/{config.folds}")
+                    if row["cv_accuracy"] > best + config.plateau_tol:
+                        best = row["cv_accuracy"]
+                        since_improve = 0
+                    else:
+                        since_improve += 1
+                    if config.patience and since_improve >= config.patience:
+                        say(f"tune: plateau after {pi + 1}/{len(points)} "
+                            f"points (no improvement in {since_improve})")
+                        break
         else:
             survivors = list(range(len(points)))
             sizes = _rung_sizes(n_full, config.min_rung, config.eta)
             for rung, m in enumerate(sizes):
                 last = rung == len(sizes) - 1
-                for pi in survivors:
-                    fit_point(pi, m, rung=rung)
+                if config.fleet:
+                    # the rung's surviving points as one fleet launch
+                    # per fold — previous-rung seeds still apply (each
+                    # lane warm-starts from ITS OWN last solution)
+                    fit_points_fleet(survivors, m, rung=rung)
+                else:
+                    for pi in survivors:
+                        fit_point(pi, m, rung=rung)
                 say(f"tune: [{spec['kernel']}] rung {rung} (m={m}) "
-                    f"scored {len(survivors)} points")
+                    f"scored {len(survivors)} points"
+                    + (" (fleet)" if config.fleet else ""))
                 # rank: best CV accuracy first, solve order breaks ties
                 # deterministically
                 ranked = sorted(
@@ -393,6 +492,7 @@ def tune(
     if tracer is not None:
         tracer.event("tune.winner", **winner)
     return TuneResult(
+        fleet=config.fleet,
         schedule=config.schedule,
         grid={"C_values": list(grid.C_values),
               "gamma_values": list(grid.gamma_values)},
