@@ -1,0 +1,258 @@
+"""Approximate-kernel scale bench: exact rbf vs rff vs nystrom at growing n.
+
+ISSUE 13's acceptance harness. Three training arms run the SAME
+bench-recipe workload (make_workload) at each n of a growing ladder —
+the exact rbf blocked solver (the control whose cost superlinearity the
+approx regime exists to escape), the rff-mapped and nystrom-mapped
+solves (the identical dual SMO machinery routed through the linear
+primal fast path over Phi(X)) — plus one STREAMED rff arm at the top n
+(shards ingested to a temp dir, per-shard mapping in the prefetch hook,
+the tpusvm.approx.primal epoch schedule; its row records the reader's
+audited live-shard high-water mark). House timing protocol: one warm
+run per arm so every jit bucket is compiled, then interleaved timed
+repeats ending at host materialisation, min kept.
+
+A second record family is the KERNEL-APPROXIMATION-ERROR PROBE:
+max |Phi(a).Phi(b) - K(a,b)| over 2048 seeded row pairs for an rff D
+ladder (and the nystrom arm's k) — the direct measurement that the map
+error falls as D grows, committed alongside the timing rows so a map
+regression (a bad omega draw path, a broken eigenvalue floor) shows up
+as a number, not an accuracy mystery.
+
+Gates (violations land in the summary row; non-zero exit):
+  * every arm's solve terminates CONVERGED (the streamed primal arm may
+    also plateau-CONVERGE; MAX_ITER there is a violation);
+  * each approx arm's held-out accuracy within ACC_BAND of the exact
+    arm's at the same n;
+  * the rff probe errors are monotone non-increasing in D (5% slack for
+    the sampling noise of the pair draw);
+  * the streamed arm's live shards <= prefetch_depth + 1.
+
+Usage: python benchmarks/approx_scale.py [--smoke] [--repeats 2]
+           [--jsonl PATH]
+Committed artifacts: benchmarks/results/approx_scale_cpu.jsonl (full),
+benchmarks/results/approx_scale_smoke_cpu.jsonl (the CI benchdiff
+baseline; `tpusvm benchdiff --level smoke` gates direction-only).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from benchmarks.common import emit, log, pin_platform, workload_record  # noqa: E402
+
+pin_platform()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_enable_x64", True)
+
+# held-out accuracy band of an approx arm vs the exact arm at the same
+# n — the fuzz harness's corpus-calibrated band (fuzz_parity.py
+# APPROX_ACC_BAND rationale)
+ACC_BAND = 0.055
+# slack on the "probe error falls with D" gate: the 2048-pair sample
+# mean has ~5% max-statistic noise between adjacent D rungs
+ERR_SLACK = 1.05
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--smoke", action="store_true",
+                    help="tiny shapes (the CI benchdiff baseline run)")
+    ap.add_argument("--d", type=int, default=128,
+                    help="feature count of the bench workload")
+    ap.add_argument("--seed", type=int, default=587)
+    ap.add_argument("--repeats", type=int, default=2,
+                    help="interleaved timed repeats per arm (min kept)")
+    ap.add_argument("--jsonl", default=None,
+                    help="also append the records to this file")
+    args = ap.parse_args(argv)
+
+    import jax.numpy as jnp  # noqa: F401
+    import numpy as np
+
+    from benchmarks.common import make_workload
+    from tpusvm.approx import build_map, kernel_approx_error
+    from tpusvm.config import SVMConfig
+    from tpusvm.data.synthetic import BENCH_LABEL_NOISE, BENCH_NOISE, \
+        mnist_like
+    from tpusvm.models import BinarySVC
+    from tpusvm.stream import ingest_arrays, open_dataset
+
+    if args.smoke:
+        ns = [256, 512]
+        rff_dim, landmarks, q = 512, 128, 128
+        d_ladder = [128, 256, 512]
+        n_test, args.repeats = 256, 1
+        rows_per_shard, primal = 128, dict(primal_epochs=80,
+                                           primal_batch=64)
+    else:
+        ns = [1024, 2048, 4096]
+        rff_dim, landmarks, q = 2048, 256, 256
+        d_ladder = [256, 512, 1024, 2048]
+        n_test = 1024
+        rows_per_shard, primal = 512, dict(primal_epochs=80,
+                                           primal_batch=256)
+
+    gamma = 0.00125 * 784 / args.d  # the bench recipe's width, d-scaled
+    sink = open(args.jsonl, "a") if args.jsonl else None
+
+    def put(rec):
+        emit(rec)  # injects provenance centrally
+        if sink is not None:
+            import json
+
+            print(json.dumps(rec), file=sink, flush=True)
+
+    violations = []
+    base_kw = dict(tau=1e-5, max_iter=50_000_000)
+    wl_kwargs = dict(d=args.d, seed=args.seed, noise=BENCH_NOISE,
+                     label_noise=BENCH_LABEL_NOISE)
+
+    def arm_cfgs():
+        return [
+            ("exact-rbf", SVMConfig(C=10.0, gamma=gamma, **base_kw), {}),
+            ("rff", SVMConfig(C=10.0, gamma=gamma, kernel="rff",
+                              rff_dim=rff_dim, map_seed=args.seed,
+                              **base_kw), {}),
+            ("nystrom", SVMConfig(C=10.0, gamma=gamma, kernel="nystrom",
+                                  landmarks=landmarks,
+                                  map_seed=args.seed, **base_kw), {}),
+        ]
+
+    for n in ns:
+        Xs, Y, Xt, Yt = make_workload(n, d=args.d, seed=args.seed,
+                                      n_test=n_test)
+        opts = dict(q=min(q, n), max_inner=1024, max_outer=50000)
+        results = {}
+        models = {}
+        for arm, cfg, _ in arm_cfgs():
+            models[arm] = lambda cfg=cfg: BinarySVC(
+                config=cfg, solver_opts=dict(opts)).fit(Xs, Y)
+            m = models[arm]()  # warm: compiles every bucket
+            results[arm] = {"model": m, "t": float("inf")}
+        for _ in range(args.repeats):
+            for arm in results:
+                t0 = time.perf_counter()
+                m = models[arm]()
+                # ending at host materialisation (train already ends at
+                # the alpha device->host copy inside fit)
+                results[arm]["t"] = min(results[arm]["t"],
+                                        time.perf_counter() - t0)
+                results[arm]["model"] = m
+        acc_exact = results["exact-rbf"]["model"].score(Xt, Yt)
+        for arm, cfg, _ in arm_cfgs():
+            m = results[arm]["model"]
+            acc = m.score(Xt, Yt) if arm != "exact-rbf" else acc_exact
+            delta = round(acc_exact - acc, 6)
+            status = m.status_.name
+            if status != "CONVERGED":
+                violations.append(f"{arm}@n={n}: {status}")
+            if delta > ACC_BAND:
+                violations.append(
+                    f"{arm}@n={n}: accuracy_delta {delta} > {ACC_BAND}")
+            put({
+                "bench": "approx_scale", "arm": arm, "n": n, "d": args.d,
+                "D": (m.sv_X_.shape[1] if arm != "exact-rbf" else args.d),
+                "q": opts["q"], "smoke": bool(args.smoke),
+                "status": status, "updates": int(m.n_iter_),
+                "sv_count": int(m.n_support_),
+                "train_s": round(results[arm]["t"], 4),
+                "accuracy": round(acc, 6), "accuracy_delta": delta,
+                "workload": workload_record(mnist_like, n=n + n_test,
+                                            **wl_kwargs),
+            })
+        log(f"n={n}: exact {results['exact-rbf']['t']:.2f}s "
+            f"rff {results['rff']['t']:.2f}s "
+            f"nystrom {results['nystrom']['t']:.2f}s acc {acc_exact:.4f}")
+
+    # ---------------------------------------------- streamed rff arm (top n)
+    n_top = ns[-1]
+    Xs, Y, Xt, Yt = make_workload(n_top, d=args.d, seed=args.seed,
+                                  n_test=n_test)
+    cfg = SVMConfig(C=10.0, gamma=gamma, kernel="rff", rff_dim=rff_dim,
+                    map_seed=args.seed, **base_kw)
+    with tempfile.TemporaryDirectory() as tmp:
+        # make_workload already scaled Xs; the streamed model re-derives
+        # the (identity-on-this-data) manifest scaler — harmless
+        ingest_arrays(tmp, Xs, Y, rows_per_shard=rows_per_shard)
+        ds = open_dataset(tmp)
+        t_min, model = float("inf"), None
+        for _ in range(max(1, args.repeats)):
+            m = BinarySVC(config=cfg, solver_opts=dict(primal))
+            t0 = time.perf_counter()
+            m.fit_stream(ds)
+            t_min = min(t_min, time.perf_counter() - t0)
+            model = m
+    acc = model.score(Xt, Yt)
+    delta = round(float(results["exact-rbf"]["model"].score(Xt, Yt))
+                  - acc, 6)
+    live = int(model.stream_max_live_shards_)
+    if model.status_.name != "CONVERGED":
+        violations.append(f"rff-stream@n={n_top}: {model.status_.name}")
+    if delta > ACC_BAND:
+        violations.append(
+            f"rff-stream@n={n_top}: accuracy_delta {delta} > {ACC_BAND}")
+    if live > 3:
+        violations.append(
+            f"rff-stream@n={n_top}: {live} live shards > "
+            "prefetch_depth + 1 = 3")
+    put({
+        "bench": "approx_scale", "arm": "rff-stream", "n": n_top,
+        "d": args.d, "D": rff_dim, "smoke": bool(args.smoke),
+        "status": model.status_.name, "updates": int(model.n_iter_),
+        "train_s": round(t_min, 4), "accuracy": round(acc, 6),
+        "accuracy_delta": delta, "max_live_shards": live,
+    })
+    log(f"rff-stream n={n_top}: {t_min:.2f}s acc {acc:.4f} "
+        f"live_shards {live}")
+
+    # -------------------------------------------- kernel-error probe ladder
+    n_probe = min(2048, ns[-1])
+    Xp = make_workload(n_probe, d=args.d, seed=args.seed + 1)[0]
+    errs = []
+    for D in d_ladder:
+        fm = build_map(SVMConfig(C=10.0, gamma=gamma, kernel="rff",
+                                 rff_dim=D, map_seed=args.seed),
+                       n_features=args.d)
+        err = kernel_approx_error(Xp, fm, gamma, seed=args.seed)
+        errs.append(err)
+        put({"bench": "approx_scale", "arm": "probe-rff", "n": n_probe,
+             "d": args.d, "D": D, "smoke": bool(args.smoke),
+             "kmax_err": round(err, 6)})
+    fmn = build_map(SVMConfig(C=10.0, gamma=gamma, kernel="nystrom",
+                              landmarks=landmarks, map_seed=args.seed),
+                    X_scaled=Xp)
+    errn = kernel_approx_error(Xp, fmn, gamma, seed=args.seed)
+    put({"bench": "approx_scale", "arm": "probe-nystrom", "n": n_probe,
+         "d": args.d, "D": landmarks, "smoke": bool(args.smoke),
+         "kmax_err": round(errn, 6)})
+    err_decreasing = all(b <= a * ERR_SLACK
+                         for a, b in zip(errs, errs[1:]))
+    if not err_decreasing:
+        violations.append(f"probe-rff errors not decreasing in D: {errs}")
+    log(f"probe: rff errs {[round(e, 4) for e in errs]} "
+        f"nystrom@k={landmarks} {errn:.4f}")
+
+    put({"bench": "approx_scale", "summary": True,
+         "smoke": bool(args.smoke), "arms": ["exact-rbf", "rff",
+                                             "nystrom", "rff-stream"],
+         "d_ladder": d_ladder, "err_decreasing": bool(err_decreasing),
+         "acc_band": ACC_BAND, "violations": violations})
+    if sink is not None:
+        sink.close()
+    if violations:
+        log(f"VIOLATIONS: {violations}")
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -63,6 +63,22 @@ engine must match the SV set exactly, f32 engines get the usual
 tau-band allowance. Committed batches live in
 benchmarks/results/fuzz_parity_kernels_cpu.jsonl.
 
+Round 13 additions (the approximate-kernel primal regime, ISSUE 13):
+mode='sigmoid' fuzzes the tanh(gamma/8 x.z - 1) family against the
+kernel-extended oracle like poly, but with FIRST-ORDER engines only
+(SIGMOID_ENGINES: the kernel is indefinite, so wss=2's curvature-model
+selection can converge to a different stationary point — excluded from
+the gate by principle, not band); instances whose oracle bails
+degenerate are recorded as skipped, the established rule. mode='rff' is DIFFERENT in kind:
+the approximate families have no per-instance oracle kernel — their
+correctness claim is that the EXACT rbf solution's quality survives the
+map — so the gate is a held-out ACCURACY DELTA, not SV-set identity:
+each instance draws 256 extra held-out rows, the f64 rbf oracle is
+trained and scored on them, and the rff (D=2048) and nystrom (k=128)
+arms must land within APPROX_ACC_BAND of the oracle's held-out accuracy
+(n floored at 192 so the landmark draw fits). The committed batch lives
+in benchmarks/results/fuzz_parity_approx_cpu.jsonl.
+
 Round 6 addition: mode='pallas-mp-adv' — the multipair engines on an
 ADVERSARIAL derivation of the drawn instance (ADVICE r5 #4 geometry):
 rows reordered so the +/- labels form contiguous blocks (the outer
@@ -160,6 +176,23 @@ KERNEL_TASK_ENGINES = [
     ("blocked-exact-wss2", dict(selection="exact", wss=2), False),
 ]
 
+# the sigmoid mode runs FIRST-ORDER selections only: the kernel is
+# indefinite (conditionally PSD — kernels/sigmoid.py), so the dual is
+# non-convex and SMO converges to A stationary point; the first-order
+# Keerthi rule follows the oracle's trajectory and lands on the same
+# one, but wss=2's second-order gain model (eta as positive curvature)
+# can legitimately steer to a DIFFERENT stationary point on indefinite
+# instances — observed at seed 15033 (blobs, C=100: same CONVERGED
+# claim, b apart by 287 — a different solution, not drift). That is a
+# property of second-order selection on indefinite kernels, not an
+# engine bug, so it is excluded from the parity gate rather than
+# papered over with a band.
+SIGMOID_ENGINES = [
+    ("pair-f64", None, True),
+    ("blocked-exact", dict(selection="exact", wss=1), False),
+    ("blocked-approx", dict(selection="approx", wss=1), False),
+]
+
 # mode -> (engines, instance n range, working-set size q, scenario). The
 # two pallas modes differ in which kernel layout the clamped q exercises:
 # q=128 is R=1 (bitwise the flat layout), q=256 is the smallest GENUINE
@@ -180,7 +213,29 @@ MODES = {
     "linear": (LINEAR_ENGINES, (96, 640), 256, "linear"),
     "poly": (KERNEL_TASK_ENGINES, (96, 640), 256, "poly"),
     "svr": (KERNEL_TASK_ENGINES, (96, 400), 256, "svr"),
+    # the approximate-kernel regime (ISSUE 13): sigmoid is a normal
+    # oracle-parity scenario; 'rff' runs the accuracy-delta gate against
+    # the exact rbf oracle (run_case_approx — n floored at 192 so the
+    # k=128 nystrom landmark draw always fits)
+    "sigmoid": (SIGMOID_ENGINES, (96, 640), 256, "sigmoid"),
+    "rff": (None, (192, 640), 256, "approx"),
 }
+
+# the approx arms of mode='rff': (name, family, config overrides). D and
+# k follow the satellite gate (D=2048 at n<=4096; k=128 tile-aligned)
+APPROX_ARMS = [
+    ("blocked-rff-d2048", "rff", {"rff_dim": 2048}),
+    ("blocked-nystrom-k128", "nystrom", {"landmarks": 128}),
+]
+
+# held-out accuracy band of the approx arms vs the exact rbf oracle:
+# measured max delta over the committed 32-case corpus is 0.0039 (rff,
+# low-gamma rings — rings/blobs are cleanly separable, so most cases
+# sit at delta 0); the noisy mnist-shaped workload of
+# benchmarks/approx_scale.py measures up to ~0.02, and the band holds
+# >2x headroom over that — one 256-row held-out flip is 0.0039, so
+# 0.055 tolerates ~14 boundary flips before calling the map broken
+APPROX_ACC_BAND = 0.055
 
 
 def _adversarialize(X, Y):
@@ -200,10 +255,80 @@ def _adversarialize(X, Y):
 
 
 def engines_for(mode: str):
+    if mode == "rff":
+        return [(name, None, False) for name, _, _ in APPROX_ARMS]
     return MODES[mode][0]
 
 
+def run_case_approx(seed: int):
+    """One accuracy-delta case: exact rbf oracle vs the approx arms.
+
+    The instance draw shares random_instance (the committed-corpus
+    geometry family) with 256 EXTRA held-out rows scaled by the train
+    stats; the oracle and every arm train on the same scaled rows and
+    score the same held-out slice. Gate per arm: CONVERGED status and
+    held-out accuracy within APPROX_ACC_BAND of the oracle's.
+    """
+    from tpusvm.approx import build_map
+    from tpusvm.oracle.smo import kernel_row
+
+    _, n_range, q, _ = MODES["rff"]
+    rng = np.random.default_rng(seed)
+    gen_name, n, X, Y, C, gamma = random_instance(
+        rng, seed, n_range, (2, 24), [1.0, 10.0, 100.0],
+        [0.125, 0.5, 2.0, 10.0], extra=256)
+    sc = MinMaxScaler().fit(X[:n])
+    Xs, Xt = sc.transform(X[:n]), sc.transform(X[n:])
+    Ytr, Yt = Y[:n], Y[n:]
+    cfg = SVMConfig(C=C, gamma=gamma)
+    o = smo_train(Xs, Ytr, cfg)
+    rec = {"seed": seed, "gen": gen_name, "scenario": "approx",
+           "n": n, "d": Xs.shape[1], "n_test": len(Yt),
+           "C": C, "gamma": round(gamma, 6),
+           "oracle_status": Status(int(o.status)).name,
+           "n_sv": int(len(get_sv_indices(o.alpha))),
+           "b": float(o.b), "engines": {}, "violations": []}
+    if o.status != Status.CONVERGED:
+        rec["skipped"] = True
+        return rec
+    # oracle held-out accuracy: the exact-rbf quality every arm must keep
+    coef_o = o.alpha * Ytr
+    scores_o = np.array([
+        float(kernel_row(Xs, x, cfg) @ coef_o) - o.b for x in Xt])
+    acc_o = float(((scores_o > 0) * 2 - 1 == Yt).mean())
+    rec["oracle_accuracy"] = round(acc_o, 6)
+    for name, family, overrides in APPROX_ARMS:
+        acfg = SVMConfig(C=C, gamma=gamma, kernel=family, map_seed=seed,
+                         **overrides)
+        fmap = build_map(acfg, X_scaled=Xs)
+        Z = fmap.transform_np(Xs)
+        Zt = fmap.transform_np(Xt)
+        r = blocked_smo_solve(
+            jnp.asarray(Z), jnp.asarray(Ytr), q=q, max_inner=1024,
+            max_outer=2000, C=C, gamma=gamma, eps=cfg.eps, tau=cfg.tau,
+            max_iter=cfg.max_iter, kernel=family,
+            accum_dtype=jnp.float64)
+        coef = np.asarray(r.alpha, np.float64) * Ytr
+        acc = float((((Zt.astype(np.float64) @
+                       (Z.astype(np.float64).T @ coef)
+                       - float(r.b)) > 0) * 2 - 1 == Yt).mean())
+        delta = acc_o - acc
+        ok = (int(r.status) == Status.CONVERGED
+              and delta <= APPROX_ACC_BAND)
+        rec["engines"][name] = {
+            "status": Status(int(r.status)).name,
+            "accuracy": round(acc, 6),
+            "acc_delta": round(delta, 6),
+            "band": APPROX_ACC_BAND, "ok": bool(ok),
+        }
+        if not ok:
+            rec["violations"].append(name)
+    return rec
+
+
 def run_case(seed: int, mode: str = "xla"):
+    if mode == "rff":
+        return run_case_approx(seed)
     engines, n_range, q, scenario = MODES[mode]
     rng = np.random.default_rng(seed)
     gen_name, n, X, Y, C, gamma = random_instance(
@@ -221,6 +346,15 @@ def run_case(seed: int, mode: str = "xla"):
     targets = None
     if scenario == "linear":
         cfg = SVMConfig(C=C, gamma=gamma, kernel="linear")
+    elif scenario == "sigmoid":
+        # scenario derivation (mode owns its seed contract, like poly's
+        # degree draw): gamma/8 with coef0=-1.0 — the tanh argument then
+        # spans the kernel's informative range on unit-scaled data; the
+        # raw rbf-calibrated draws (up to 10) saturate tanh into
+        # degenerate-eta geometry and skip ~2/3 of the corpus (the
+        # conditionally-PSD caveat, kernels/sigmoid.py)
+        cfg = SVMConfig(C=C, gamma=gamma / 8.0, kernel="sigmoid",
+                        coef0=-1.0)
     elif scenario == "poly":
         degree = int(rng.choice([2, 3]))
         cfg = SVMConfig(C=C, gamma=gamma, kernel="poly", degree=degree,
@@ -332,7 +466,7 @@ def run_case(seed: int, mode: str = "xla"):
             # (observed <= 3e-5).
             b_band = max(2.5e-2, rel * abs(o.b),
                          5e-6 * float(np.abs(o.alpha).sum()))
-        elif scenario in ("linear", "poly"):
+        elif scenario in ("linear", "poly", "sigmoid"):
             # the f32 engines' b noise scales with the DUAL MASS times
             # the KERNEL MAGNITUDE (f accumulates sum_j alpha_j K_ij
             # with ~1e-7 relative evaluation error — the solver's
@@ -348,6 +482,9 @@ def run_case(seed: int, mode: str = "xla"):
             k_diag = (Xs * Xs).sum(axis=1)
             if scenario == "poly":
                 k_diag = (cfg.gamma * k_diag + cfg.coef0) ** cfg.degree
+            elif scenario == "sigmoid":
+                # |tanh| <= 1 bounds the kernel magnitude outright
+                k_diag = np.abs(np.tanh(cfg.gamma * k_diag + cfg.coef0))
             b_band = max(2e-3, rel * abs(o.b),
                          1e-6 * float(np.abs(o.alpha).sum())
                          * float(k_diag.max()))

@@ -230,13 +230,37 @@ def test_committed_kernel_fuzz_batches_are_clean():
         "fuzz_parity_kernels_cpu.jsonl")
     recs = [_json.loads(line) for line in open(path)]
     summaries = [r for r in recs if r.get("summary")]
-    assert {s["mode"] for s in summaries} == {"linear", "poly", "svr"}
+    assert {s["mode"] for s in summaries} == {"linear", "poly", "svr",
+                                              "sigmoid"}
     for s in summaries:
         assert s["cases"] == 64 and s["violations"] == 0
     for r in recs:
         if r.get("summary") or r.get("skipped"):
             continue
         assert r["engines"]["pair-f64"]["sv_sym_diff"] == 0
+
+
+def test_committed_approx_fuzz_batch_is_clean():
+    # the committed accuracy-delta evidence for the approximate regime
+    # (ISSUE 13): 32 cases, every arm CONVERGED within the band of the
+    # exact rbf oracle's held-out accuracy, zero skips
+    import json as _json
+    import os as _os
+
+    path = _os.path.join(_os.path.dirname(_os.path.dirname(
+        _os.path.abspath(__file__))), "benchmarks", "results",
+        "fuzz_parity_approx_cpu.jsonl")
+    recs = [_json.loads(line) for line in open(path)]
+    summary = [r for r in recs if r.get("summary")]
+    assert len(summary) == 1 and summary[0]["violations"] == 0
+    assert summary[0]["cases"] == 32
+    for r in recs:
+        if r.get("summary"):
+            continue
+        assert not r.get("skipped")
+        for name, e in r["engines"].items():
+            assert e["ok"], (r["seed"], name)
+            assert e["acc_delta"] <= e["band"]
 
 
 def test_serve_latency_smoke_schema(capsys):

@@ -30,10 +30,10 @@ def _data(n=64, d=5, seed=0):
 
 # ---------------------------------------------------------------- dispatch
 def test_validate_family():
-    for fam in ("rbf", "linear", "poly"):
+    for fam in ("rbf", "linear", "poly", "sigmoid", "rff", "nystrom"):
         assert kernels.validate_family(fam) == fam
     with pytest.raises(ValueError, match="unknown kernel family"):
-        kernels.validate_family("sigmoid")
+        kernels.validate_family("laplacian")
 
 
 def test_needs_norms_only_rbf():
@@ -229,7 +229,7 @@ def test_solver_rejects_unknown_family():
     X, Y = blobs(n=32, d=3, seed=1)
     with pytest.raises(ValueError, match="unknown kernel family"):
         smo_solve(jnp.asarray(X, jnp.float32), jnp.asarray(Y),
-                  kernel="sigmoid")
+                  kernel="laplacian")
 
 
 def test_config_validates_kernel_fields():

@@ -194,10 +194,10 @@ def test_unknown_kernel_name_rejected_with_specific_error(tmp_path):
     p = str(tmp_path / "good.npz")
     m.save(p)
     bad = str(tmp_path / "bad_kernel.npz")
-    _rewrite_npz(p, bad, config_kernel="sigmoid")
-    with pytest.raises(ValueError, match="kernel family 'sigmoid'"):
+    _rewrite_npz(p, bad, config_kernel="laplacian")
+    with pytest.raises(ValueError, match="kernel family 'laplacian'"):
         load_model(bad)
-    with pytest.raises(ValueError, match="kernel family 'sigmoid'"):
+    with pytest.raises(ValueError, match="kernel family 'laplacian'"):
         BinarySVC.load(bad)
 
 

@@ -256,7 +256,11 @@ def test_normalize_kernel_specs():
     with pytest.raises(ValueError, match="duplicate kernel spec"):
         normalize_kernel_specs(["rbf", "rbf"], base)
     with pytest.raises(ValueError, match="unknown kernel family"):
-        normalize_kernel_specs(["sigmoid"], base)
+        normalize_kernel_specs(["laplacian"], base)
+    # approx families are rejected by NAME (gamma is baked into the
+    # feature map; tune's shared-fold-cache sweep cannot apply)
+    with pytest.raises(ValueError, match="approximate kernel"):
+        normalize_kernel_specs(["rff"], base)
 
 
 # ----------------------------------------------------------------- results

@@ -192,6 +192,50 @@ def _kernels_build(family: str):
     return build
 
 
+# ------------------------------------------- approximate-kernel entry points
+def _approx_rff_transform_build():
+    import tpusvm.approx.features  # noqa: F401 — registers the entries
+
+    jitted, _ = _registered("approx.rff_transform")
+    # canonical map: d=D(128) raw features -> 2*128=256 mapped (both
+    # tile-aligned — config.validate_map_dim enforces the lane rule on
+    # every real rff_dim up front)
+    return jitted, (_s((N, D)), _s((D, 128))), {}
+
+
+def _approx_nystrom_transform_build():
+    import tpusvm.approx.features  # noqa: F401
+
+    jitted, _ = _registered("approx.nystrom_transform")
+    # k=128 landmarks; gamma arrives as a 0-d device array (FeatureMap
+    # pins np.float32(gamma)), so its value cannot bake into the trace
+    return jitted, (_s((N, D)), _s((128, D)), _s((128, 128)),
+                    _s(())), {}
+
+
+def _approx_decision_build():
+    import tpusvm.approx.features  # noqa: F401
+
+    jitted, _ = _registered("predict.approx_decision")
+    # the fused map+decision program serve's bucket cache lowers for
+    # binary/svr approx models (rff face): raw bucket rows + the map
+    # operand tuple + MAPPED support rows
+    fn = functools.partial(jitted, family="rff", block=M)
+    return fn, (_s((M, D)), (_s((D, 128)),), _s((N_SV, 256)),
+                _s((N_SV,)), _s(())), {}
+
+
+def _approx_ovr_scores_build():
+    import tpusvm.approx.features  # noqa: F401
+
+    jitted, _ = _registered("predict.approx_ovr_scores")
+    # the ovr face, on the nystrom branch so both map families' predict
+    # jaxprs are walked (rff is covered by predict.approx_decision)
+    fn = functools.partial(jitted, family="nystrom")
+    return fn, (_s((M, D)), (_s((128, D)), _s((128, 128)), _s(())),
+                _s((N_SV, 128)), _s((N_CLS, N_SV)), _s((N_CLS,))), {}
+
+
 # ------------------------------------------------------ cascade round fn
 def _cascade_round_build():
     if not hasattr(jax, "shard_map"):
@@ -327,6 +371,31 @@ def default_entrypoints():
             build=_kernels_build("poly"),
             sweep={"gamma": (0.5, 0.125), "coef0": (1.0, 0.25)},
             description="kernel-dispatch contraction, poly family",
+        ),
+        IREntryPoint(
+            name="approx.rff_transform",
+            build=_approx_rff_transform_build,
+            description="random-Fourier feature map Phi(X) (cos/sin "
+                        "halves of the seeded omega matmul)",
+        ),
+        IREntryPoint(
+            name="approx.nystrom_transform",
+            build=_approx_nystrom_transform_build,
+            description="Nystrom landmark map K(X, M) @ K_mm^{-1/2} "
+                        "(gamma a 0-d array operand — no scalar leak "
+                        "possible by construction)",
+        ),
+        IREntryPoint(
+            name="predict.approx_decision",
+            build=_approx_decision_build,
+            description="fused map+decision scorer (the approx serve "
+                        "bucket executable, rff face)",
+        ),
+        IREntryPoint(
+            name="predict.approx_ovr_scores",
+            build=_approx_ovr_scores_build,
+            description="fused map+ovr-gemm scorer (approx ovr bucket "
+                        "executable, nystrom face)",
         ),
         IREntryPoint(
             name="cascade.round_fn",

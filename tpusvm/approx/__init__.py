@@ -1,0 +1,42 @@
+"""tpusvm.approx — the approximate-kernel primal regime.
+
+Random Fourier Features + Nystrom landmark maps (features.py) send the
+rbf kernel into an explicit feature space where every kernel touchpoint
+is the linear family's primal matmul, and a streaming mini-batch primal
+solver (primal.py) consumes mapped shards straight off the prefetch
+pipeline — together the linear-cost training path that opens the
+ROADMAP's 100M-row scale class (the cascade/fleet machinery applies
+unchanged on top). Kernel families "rff"/"nystrom" (config.KERNEL_FAMILIES)
+route here via kernels.dispatch and the model layer.
+"""
+
+from tpusvm.approx.features import (
+    FeatureMap,
+    approx_decision_function,
+    approx_ovr_scores,
+    build_map,
+    kernel_approx_error,
+    map_from_state,
+    nystrom_landmark_indices,
+    nystrom_transform,
+    nystrom_weights,
+    rff_omega,
+    rff_transform,
+)
+from tpusvm.approx.primal import PrimalResult, streaming_primal_fit
+
+__all__ = [
+    "FeatureMap",
+    "build_map",
+    "map_from_state",
+    "rff_omega",
+    "rff_transform",
+    "nystrom_landmark_indices",
+    "nystrom_weights",
+    "nystrom_transform",
+    "approx_decision_function",
+    "approx_ovr_scores",
+    "kernel_approx_error",
+    "PrimalResult",
+    "streaming_primal_fit",
+]

@@ -226,15 +226,37 @@ def _build_parser() -> argparse.ArgumentParser:
                       "chip-parallel; requires the pair solver)")
 
     kt = tr.add_argument_group("kernel / task (tpusvm.kernels)")
-    kt.add_argument("--kernel", choices=["rbf", "linear", "poly"],
+    kt.add_argument("--kernel",
+                    choices=["rbf", "linear", "poly", "sigmoid", "rff",
+                             "nystrom"],
                     default="rbf",
                     help="kernel family; rbf (default) = the reference's "
                     "kernel, linear gets a primal-friendly fast path, "
-                    "poly = (gamma*x.z + coef0)^degree")
+                    "poly = (gamma*x.z + coef0)^degree, sigmoid = "
+                    "tanh(gamma*x.z + coef0); rff / nystrom are the "
+                    "APPROXIMATE rbf families (tpusvm.approx): a seeded "
+                    "explicit feature map routes every solve through the "
+                    "linear primal fast path — the linear-cost regime "
+                    "for row counts the exact path cannot reach; with "
+                    "--data they train fully out-of-core (per-shard "
+                    "mapping in the prefetch hook + the streaming "
+                    "primal solver)")
     kt.add_argument("--degree", type=int, default=3,
                     help="polynomial degree (--kernel poly)")
     kt.add_argument("--coef0", type=float, default=0.0,
-                    help="polynomial additive term (--kernel poly)")
+                    help="polynomial/sigmoid additive term (--kernel "
+                    "poly/sigmoid)")
+    kt.add_argument("--rff-dim", type=int, default=2048, metavar="D",
+                    help="--kernel rff: mapped feature width (must be a "
+                    "multiple of the 128-lane TPU tile; default 2048)")
+    kt.add_argument("--rff-seed", type=int, default=0, metavar="S",
+                    help="--kernel rff/nystrom: deterministic map seed — "
+                    "the same seed reproduces bit-identical features "
+                    "across ingest/train/predict/serve (default 0)")
+    kt.add_argument("--landmarks", type=int, default=256, metavar="K",
+                    help="--kernel nystrom: landmark row count = mapped "
+                    "width (tile-aligned like --rff-dim; must be <= n; "
+                    "default 256)")
     kt.add_argument("--task", choices=["svc", "svr", "ovr"], default="svc",
                     help="svc = classification (default); svr = "
                     "epsilon-insensitive regression over the doubled "
@@ -312,6 +334,20 @@ def _build_parser() -> argparse.ArgumentParser:
     ing.add_argument("--out", metavar="DIR",
                      help="output dataset directory (required unless "
                      "--smoke)")
+    ing.add_argument("--kernel", choices=["raw", "rff", "nystrom"],
+                     default="raw",
+                     help="feature handling for approximate-kernel "
+                     "training: shards always store RAW features — "
+                     "naming rff/nystrom here explains (with an error) "
+                     "that the map is applied STREAM-SIDE at train time "
+                     "(per-shard in the prefetch hook), so one ingested "
+                     "dataset serves every (D, seed) without re-ingest")
+    ing.add_argument("--rff-dim", type=int, default=2048, metavar="D",
+                     help=argparse.SUPPRESS)
+    ing.add_argument("--rff-seed", type=int, default=0, metavar="S",
+                     help=argparse.SUPPRESS)
+    ing.add_argument("--landmarks", type=int, default=256, metavar="K",
+                     help=argparse.SUPPRESS)
     ing.add_argument("--rows-per-shard", type=int, default=65536,
                      help="rows per .npz shard (default 65536)")
     ing.add_argument("--resume", action="store_true",
@@ -768,9 +804,26 @@ def _cmd_train(args) -> int:
         elif args.task == "svr":
             args.synthetic, args.d = "sine", 2
             args.C, args.gamma, args.epsilon = 10.0, 20.0, 0.1
-        elif args.kernel == "rbf":
+        elif args.kernel in ("rbf", "rff", "nystrom"):
+            # the approx families are rbf approximators: they get the
+            # SAME rings workload the exact rbf smoke gates (linear
+            # fails rings by construction, so passing it proves the map
+            # carries the rbf geometry); map widths sized to the tiny
+            # smoke problem (landmarks must be <= n = 240)
             args.synthetic = "rings"
             args.C, args.gamma = 10.0, 10.0
+            if args.kernel == "rff":
+                args.rff_dim = min(args.rff_dim, 512)
+            if args.kernel == "nystrom":
+                args.landmarks = min(args.landmarks, 128)
+        elif args.kernel == "sigmoid":
+            # tanh needs the negative offset to carve a margin on blobs
+            # (coef0=0 saturates into a linear-at-origin surface whose
+            # eta degenerates); measured CONVERGED at 1.0 accuracy
+            args.synthetic, args.d = "blobs", 6
+            args.C, args.gamma = 10.0, 0.25
+            if args.coef0 == 0.0:
+                args.coef0 = -1.0
         else:
             args.synthetic, args.d = "blobs", 6
             args.C, args.gamma = 1.0, 1.0
@@ -804,16 +857,24 @@ def _cmd_train(args) -> int:
         jax.config.update("jax_enable_x64", True)
 
     kernel_kw = dict(kernel=args.kernel, degree=args.degree,
-                     coef0=args.coef0, epsilon=args.epsilon)
-    if args.preset:
-        cfg = preset(args.preset, tau=args.tau, eps=args.eps,
-                     sv_tol=args.sv_tol, max_iter=args.max_iter,
-                     max_rounds=args.max_rounds, **kernel_kw)
-    else:
-        cfg = SVMConfig(C=args.C, gamma=args.gamma, tau=args.tau,
-                        eps=args.eps, sv_tol=args.sv_tol,
-                        max_iter=args.max_iter, max_rounds=args.max_rounds,
-                        **kernel_kw)
+                     coef0=args.coef0, epsilon=args.epsilon,
+                     rff_dim=args.rff_dim, map_seed=args.rff_seed,
+                     landmarks=args.landmarks)
+    try:
+        if args.preset:
+            cfg = preset(args.preset, tau=args.tau, eps=args.eps,
+                         sv_tol=args.sv_tol, max_iter=args.max_iter,
+                         max_rounds=args.max_rounds, **kernel_kw)
+        else:
+            cfg = SVMConfig(C=args.C, gamma=args.gamma, tau=args.tau,
+                            eps=args.eps, sv_tol=args.sv_tol,
+                            max_iter=args.max_iter,
+                            max_rounds=args.max_rounds, **kernel_kw)
+    except ValueError as e:
+        # e.g. a tile-misaligned --rff-dim/--landmarks: the config
+        # validator rejects it up front (the JXIR104 rationale), before
+        # any data is loaded
+        raise SystemExit(f"train: {e}")
 
     solver_opts = _parse_solver_opts(args.solver_opt)
 
@@ -888,6 +949,12 @@ def _cmd_train(args) -> int:
         elif solver_name == "fleet":
             # the packing/compaction knobs of the fleet driver
             known |= {"bucket", "compact_every"}
+        if args.data and args.kernel in ("rff", "nystrom"):
+            # streamed approx training runs the primal epoch schedule
+            # (tpusvm.approx.primal), whose knobs replace the blocked
+            # solver's — fit_stream rejects blocked knobs by name there
+            known |= {"primal_batch", "primal_epochs", "primal_tol",
+                      "prefetch_depth"}
         bad = sorted(set(solver_opts) - known)
         if bad:
             hint = [k for k in bad if k in flagged]
@@ -920,6 +987,29 @@ def _cmd_train(args) -> int:
             if args.multiclass:
                 raise SystemExit("--shrink-every supports binary/svr "
                                  "--mode single training for now")
+    if args.kernel in ("rff", "nystrom"):
+        if args.mode == "oracle":
+            raise SystemExit(
+                "--mode oracle has no approximate kernels: the NumPy "
+                "oracle is the EXACT rbf anchor the approx families are "
+                "gated against (benchmarks/fuzz_parity.py mode rff); "
+                "train --kernel rbf --mode oracle instead"
+            )
+        if args.mode == "cascade" and args.data:
+            raise SystemExit(
+                "--mode cascade --data with an approximate kernel is "
+                "not supported yet (leaf partitions carry RAW rows; the "
+                "mapped width would change every buffer shape): drop "
+                "--mode cascade for the streaming primal path, or load "
+                "the data in-memory for a mapped cascade"
+            )
+        if args.data and args.convergence:
+            raise SystemExit(
+                "--convergence rides the blocked solver's outer loop; "
+                "streamed approximate training runs the primal epoch "
+                "schedule (tpusvm.approx.primal), which has no "
+                "convergence ring yet"
+            )
     if args.task == "svr":
         if args.mode != "single":
             raise SystemExit("--task svr requires --mode single (the "
@@ -1250,6 +1340,18 @@ def _cmd_ingest(args) -> int:
 
     say = (lambda msg: None) if args.quiet else print
 
+    if getattr(args, "kernel", "raw") != "raw":
+        # explicit interop decision, not a silent pass-through: shards
+        # hold raw features by design — pre-mapping at ingest would pin
+        # the dataset to one (D, seed) AND break the scale-then-map
+        # order (the scaler comes from manifest stats at train time)
+        raise SystemExit(
+            f"ingest --kernel {args.kernel}: shards store RAW features; "
+            "the approximate map is applied stream-side during training "
+            "prefetch (tpusvm.approx) so one ingested dataset serves "
+            f"every map — run `tpusvm train --data OUT --kernel "
+            f"{args.kernel} --rff-dim D --rff-seed S` instead"
+        )
     if args.smoke:
         return _ingest_smoke(args, say)
     if not args.out:
@@ -1630,6 +1732,16 @@ def _cmd_tune(args) -> int:
                      coef0=args.coef0)
     kernel_specs = (None if not args.kernels
                     else [k.strip() for k in args.kernels.split(",")])
+    if kernel_specs is not None:
+        # fail fast (before the data load): unknown names, duplicates,
+        # and the approximate families' explicit rejection (gamma is
+        # baked into their feature map — tune.normalize_kernel_specs)
+        from tpusvm.tune.search import normalize_kernel_specs
+
+        try:
+            normalize_kernel_specs(kernel_specs, base)
+        except ValueError as e:
+            raise SystemExit(f"tune: {e}")
     try:
         config = TuneConfig(
             folds=args.folds, seed=args.fold_seed, schedule=args.schedule,
@@ -1791,19 +1903,45 @@ def _info_artifact(path: str) -> int:
     kind = {"ovr": "multiclass (one-vs-rest)", "svr": "epsilon-SVR"}.get(
         task, "binary")
     print(f"model: {kind}")
+    from tpusvm.config import APPROX_FAMILIES
+
+    approx = config.kernel in APPROX_FAMILIES
+    # approx states: sv_X holds MAPPED rows — the request-row width is
+    # the map provenance field, the mapped width the sv_X trailing dim
+    n_feat = (int(state["map_n_features_in"]) if approx
+              and "map_n_features_in" in state
+              else state["sv_X"].shape[1])
     if task == "ovr":
         print(f"classes: {state['classes'].tolist()}")
         print(f"SV union: {state['sv_X'].shape[0]}")
-        print(f"n_features: {state['sv_X'].shape[1]}")
+        print(f"n_features: {n_feat}")
     else:
         sv_key = "sv_coef" if task == "svr" else "sv_alpha"
         print(f"SV count: {len(state[sv_key])}")
-        print(f"n_features: {state['sv_X'].shape[1]}")
+        print(f"n_features: {n_feat}")
         print(f"b = {float(state['b']):.15f}")
     kern = f"kernel: {config.kernel}"
     if config.kernel == "poly":
         kern += f" (degree={config.degree} coef0={config.coef0:g})"
+    if config.kernel == "sigmoid":
+        kern += f" (coef0={config.coef0:g})"
     print(kern)
+    if approx:
+        # approx provenance (serialization v4): which map produced the
+        # mapped SV rows, and what regenerates/reads it at load
+        dim = int(state["sv_X"].shape[1])
+        if config.kernel == "rff":
+            print(f"approx map: rff D={config.rff_dim} "
+                  f"seed={config.map_seed} "
+                  f"({n_feat} raw -> {dim} mapped features; omega "
+                  "regenerates from config)")
+        else:
+            n_lm = (int(state["map_landmarks"].shape[0])
+                    if "map_landmarks" in state else config.landmarks)
+            print(f"approx map: nystrom landmarks={n_lm} "
+                  f"seed={config.map_seed} "
+                  f"({n_feat} raw -> {dim} mapped features; landmark "
+                  "rows stored in the artifact)")
     print(f"config: C={config.C:g} gamma={config.gamma:g} "
           f"tau={config.tau:g} sv_tol={config.sv_tol:g}"
           + (f" epsilon={config.epsilon:g}" if task == "svr" else ""))

@@ -17,7 +17,51 @@ from typing import Optional
 # Supported kernel families (tpusvm.kernels). Lives here — not in the
 # kernels package — so config/serialization can validate names without
 # importing the JAX-backed dispatch module.
-KERNEL_FAMILIES = ("rbf", "linear", "poly")
+#
+# "sigmoid" (tanh(gamma x.z + coef0)) closes the last named EXACT-kernel
+# gap; "rff"/"nystrom" are the APPROXIMATE families (tpusvm.approx): a
+# seeded explicit feature map sends the rbf kernel into a space where
+# every kernel computation is the linear family's primal-friendly
+# matmul — the solvers/predict/serve paths receive PRE-MAPPED features
+# and dispatch routes the approx names through the linear fast path.
+KERNEL_FAMILIES = ("rbf", "linear", "poly", "sigmoid", "rff", "nystrom")
+
+# the families whose "features" are an explicit approximate-kernel map
+# Phi(x) rather than raw data rows (tpusvm.approx.features); model/serve
+# layers apply the map, solver/kernel layers see linear geometry
+APPROX_FAMILIES = ("rff", "nystrom")
+
+
+def is_approx_family(family: str) -> bool:
+    return family in APPROX_FAMILIES
+
+
+# the lane dimension every TPU tile shares (TPU_TILE_SHAPES below): the
+# trailing dim of any MXU/VMEM operand pads up to a multiple of this
+_TPU_LANE = 128
+
+
+def validate_map_dim(D: int, what: str = "rff_dim") -> int:
+    """Validate an approximate-map feature dimension for TPU tiling.
+
+    The mapped feature matrix (n, D) is the solver's streamed MXU operand
+    — its trailing dim D lands on the lane axis, so a D that is not a
+    multiple of the 128-lane tile is padded up by the compiler, silently
+    burning HBM bandwidth and MXU cycles on zeros on EVERY f-update
+    contraction (the JXIR104 padding-waste rationale, applied up front:
+    the map dimension is chosen by config, so misalignment is a config
+    bug, not a data property). RFF additionally needs an even D (cos/sin
+    halves of D/2 frequency draws) — implied by the lane rule.
+    """
+    if D < _TPU_LANE or D % _TPU_LANE != 0:
+        raise ValueError(
+            f"{what}={D} is not TPU-tile-aligned: the mapped feature "
+            f"matrix (n, {what}) streams through the MXU with {what} on "
+            f"the 128-lane axis, so {what} must be a positive multiple "
+            f"of {_TPU_LANE} (TPU_TILE_SHAPES; the JXIR104 rule) — e.g. "
+            f"{max(_TPU_LANE, (D // _TPU_LANE + 1) * _TPU_LANE)}"
+        )
+    return D
 
 
 # ---------------------------------------------------------------- precision
@@ -110,9 +154,20 @@ class SVMConfig:
         config.
       degree: polynomial degree (kernel="poly" only; static — each degree
         compiles its own solver).
-      coef0: polynomial additive term (kernel="poly" only; traced).
+      coef0: polynomial/sigmoid additive term (kernel="poly"/"sigmoid";
+        traced).
       epsilon: the epsilon-SVR tube half-width (EpsilonSVR only; ignored by
         classification).
+      rff_dim: random-Fourier-feature map dimension D (kernel="rff" only):
+        the mapped feature width, validated TPU-tile-aligned up front
+        (validate_map_dim — the JXIR104 padding-waste rule applied at
+        config time). D/2 Gaussian frequency draws feed cos/sin halves.
+      map_seed: deterministic seed of the approximate feature map
+        (kernel="rff"/"nystrom"): the same seed reproduces bit-identical
+        features across ingest/train/predict/serve.
+      landmarks: Nystrom landmark count k (kernel="nystrom" only): the
+        mapped feature width, tile-aligned like rff_dim; must also be
+        <= n at fit time (landmark rows are drawn from the data).
     """
 
     C: float = 10.0
@@ -126,6 +181,9 @@ class SVMConfig:
     degree: int = 3
     coef0: float = 0.0
     epsilon: float = 0.1
+    rff_dim: int = 2048
+    map_seed: int = 0
+    landmarks: int = 256
 
     def __post_init__(self):
         if self.kernel not in KERNEL_FAMILIES:
@@ -137,6 +195,13 @@ class SVMConfig:
             raise ValueError(f"degree must be >= 1, got {self.degree}")
         if self.epsilon < 0:
             raise ValueError(f"epsilon must be >= 0, got {self.epsilon}")
+        # approximate-map dimensions are validated AT CONFIG TIME: the
+        # mapped width is the solver's MXU lane dim for the whole fit,
+        # so a misaligned choice is rejected before any data is touched
+        if self.kernel == "rff":
+            validate_map_dim(self.rff_dim, "rff_dim")
+        if self.kernel == "nystrom":
+            validate_map_dim(self.landmarks, "landmarks")
 
 
 @dataclasses.dataclass(frozen=True)

@@ -236,6 +236,20 @@ def fleet_train(
         raise ValueError(
             f"compact_every must be >= 0 rounds, got {compact_every}"
         )
+    family = solver_opts.get("kernel", "rbf")
+    if kernels.is_approx(family) and len(set(map(float, gammas))) > 1:
+        # explicit interop decision (no silent wrong-answer path): an
+        # approx family's X is ALREADY the mapped features, whose map
+        # was built from ONE gamma — the per-problem gammas array is
+        # inert for the linear-geometry dispatch, so distinct values
+        # would silently all train against the map's gamma
+        raise ValueError(
+            f"fleet with the approximate family {family!r} requires a "
+            "single shared gamma: gamma parameterises the feature map "
+            "the shared X was built with (tpusvm.approx), not the "
+            "per-problem kernel — got distinct gammas "
+            f"{sorted(set(map(float, gammas)))}"
+        )
     # strip knobs at their inert defaults: the fleet jit's signature
     # does not carry them (they are pinned inside the vmapped call)
     opts = {k: v for k, v in solver_opts.items()

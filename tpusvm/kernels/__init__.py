@@ -3,15 +3,18 @@
 The solvers' SMO structure is kernel-agnostic (SURVEY §0: K-row
 computation, error-vector update, working-set selection); this package
 factors the kernel touchpoints behind a static family dispatch
-(dispatch.py: "rbf" | "linear" | "poly") and hosts the two task
-extensions built on it — the epsilon-SVR variable doubling (svr.py) and
-Platt probability calibration (platt.py).
+(dispatch.py: "rbf" | "linear" | "poly" | "sigmoid", plus the
+approximate families "rff" | "nystrom" that route the linear primal
+path over explicitly mapped features — tpusvm.approx) and hosts the two
+task extensions built on it — the epsilon-SVR variable doubling (svr.py)
+and Platt probability calibration (platt.py).
 """
 
-from tpusvm.config import KERNEL_FAMILIES
+from tpusvm.config import APPROX_FAMILIES, KERNEL_FAMILIES
 from tpusvm.kernels.dispatch import (
     cross,
     cross_matvec,
+    is_approx,
     matvec,
     needs_norms,
     rows_at,
@@ -23,11 +26,13 @@ from tpusvm.kernels.svr import collapse_duals, doubled_problem
 
 __all__ = [
     "KERNEL_FAMILIES",
+    "APPROX_FAMILIES",
     "rows_at",
     "cross",
     "cross_matvec",
     "matvec",
     "needs_norms",
+    "is_approx",
     "validate_family",
     "doubled_problem",
     "collapse_duals",

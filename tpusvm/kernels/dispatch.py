@@ -9,18 +9,31 @@ family named by a STATIC string (`kernel` is a jit static argname in both
 solvers), so the dispatch happens at trace time and every family compiles
 to exactly its own program:
 
-  - "rbf":    the existing ops/rbf.py implementations, called with
-              byte-identical arguments — the refactor is bit-transparent
-              to every pre-existing RBF trajectory;
-  - "linear": K(x, z) = x.z — no precomputables at all (needs_norms is
-              False, so solvers skip the sq_norms pass entirely), and the
-              blocked contraction has a primal fast path
-              X @ (X_B^T coef) that never materialises a kernel slab
-              (kernels/linear.py);
-  - "poly":   K(x, z) = (gamma x.z + coef0)^degree — the same dot-form
-              matmuls as linear with a pointwise affine+power epilogue
-              (kernels/poly.py). `degree` is static (a Python int power),
-              gamma/coef0 are traced scalars like gamma everywhere else.
+  - "rbf":     the existing ops/rbf.py implementations, called with
+               byte-identical arguments — the refactor is bit-transparent
+               to every pre-existing RBF trajectory;
+  - "linear":  K(x, z) = x.z — no precomputables at all (needs_norms is
+               False, so solvers skip the sq_norms pass entirely), and the
+               blocked contraction has a primal fast path
+               X @ (X_B^T coef) that never materialises a kernel slab
+               (kernels/linear.py);
+  - "poly":    K(x, z) = (gamma x.z + coef0)^degree — the same dot-form
+               matmuls as linear with a pointwise affine+power epilogue
+               (kernels/poly.py). `degree` is static (a Python int power),
+               gamma/coef0 are traced scalars like gamma everywhere else;
+  - "sigmoid": K(x, z) = tanh(gamma x.z + coef0) — poly's structure with
+               a tanh epilogue (kernels/sigmoid.py); gamma/coef0 traced;
+  - "rff" / "nystrom" (config.APPROX_FAMILIES): the APPROXIMATE-kernel
+               primal regime (tpusvm.approx). The caller has already
+               applied the explicit feature map Phi — the "X" these
+               computations receive IS the mapped matrix, and
+               K̂(x, z) = Phi(x).Phi(z) is exactly the linear kernel over
+               it — so both names route verbatim through the linear
+               family's implementations, primal fast path included. The
+               solvers therefore run the LINEAR-COST program for approx
+               fits while the model/serve layers own the map; gamma is
+               consumed by the map (it parameterises omega / K_nm), never
+               by these contractions.
 
 Family validation raises the same clear error everywhere (solvers,
 serialization, config) via `validate_family`.
@@ -32,9 +45,10 @@ from typing import Optional
 
 import jax
 
-from tpusvm.config import KERNEL_FAMILIES
+from tpusvm.config import APPROX_FAMILIES, KERNEL_FAMILIES
 from tpusvm.kernels import linear as _lin
 from tpusvm.kernels import poly as _poly
+from tpusvm.kernels import sigmoid as _sig
 from tpusvm.ops import rbf as _rbf
 
 
@@ -47,11 +61,18 @@ def validate_family(family: str) -> str:
     return family
 
 
+def is_approx(family: str) -> bool:
+    """Whether the family's features are an explicit approximate-kernel
+    map (tpusvm.approx) — the model layer applies Phi, the kernel layer
+    sees linear geometry over the mapped rows."""
+    return validate_family(family) in APPROX_FAMILIES
+
+
 def needs_norms(family: str) -> bool:
     """Whether the family consumes per-row squared norms (sq_norms).
 
-    Only RBF does (the distance-dot trick); linear/poly solvers skip the
-    O(n*d) norms pass and carry sn=None.
+    Only RBF does (the distance-dot trick); linear/poly/sigmoid and the
+    approx families skip the O(n*d) norms pass and carry sn=None.
     """
     return validate_family(family) == "rbf"
 
@@ -73,8 +94,10 @@ def rows_at(family: str, X: jax.Array, idx: jax.Array, *, gamma, coef0=0.0,
     """K(X[idx[k]], X[j]) for a small static-size index vector. (k, n)."""
     if family == "rbf":
         return _rbf.rbf_rows_at(X, idx, gamma, sn, precision)
-    if family == "linear":
+    if family == "linear" or family in APPROX_FAMILIES:
         return _lin.linear_rows_at(X, idx, precision)
+    if family == "sigmoid":
+        return _sig.sigmoid_rows_at(X, idx, gamma, coef0, precision)
     validate_family(family)
     return _poly.poly_rows_at(X, idx, gamma, coef0, degree, precision)
 
@@ -85,8 +108,10 @@ def cross(family: str, XA: jax.Array, XB: jax.Array, *, gamma, coef0=0.0,
     """Full K(XA, XB) kernel matrix, shape (nA, nB)."""
     if family == "rbf":
         return _rbf.rbf_cross(XA, XB, gamma, snA, snB, precision)
-    if family == "linear":
+    if family == "linear" or family in APPROX_FAMILIES:
         return _lin.linear_cross(XA, XB, precision)
+    if family == "sigmoid":
+        return _sig.sigmoid_cross(XA, XB, gamma, coef0, precision)
     validate_family(family)
     return _poly.poly_cross(XA, XB, gamma, coef0, degree, precision)
 
@@ -97,17 +122,21 @@ def cross_matvec(family: str, X: jax.Array, XB: jax.Array, coef: jax.Array,
                  precision=None, fast: bool = True) -> jax.Array:
     """sum_k coef_k K(x_i, xb_k) for all i — the blocked f update. (n,).
 
-    fast only affects "linear": True (default) computes the primal form
-    X @ (X_B^T coef) — one (d,) intermediate, no (n, q) kernel slab, no
-    row-norm traffic; False runs the generic blocked K-row path (the
+    fast only affects the linear-geometry families ("linear" and the
+    approx names routing through it): True (default) computes the primal
+    form X @ (X_B^T coef) — one (d,) intermediate, no (n, q) kernel slab,
+    no row-norm traffic; False runs the generic blocked K-row path (the
     benchmark control arm, benchmarks/kernel_matrix.py).
     """
     if family == "rbf":
         return _rbf.rbf_cross_matvec(X, XB, coef, gamma, sn, block,
                                      precision)
-    if family == "linear":
+    if family == "linear" or family in APPROX_FAMILIES:
         return _lin.linear_cross_matvec(X, XB, coef, block=block,
                                         precision=precision, fast=fast)
+    if family == "sigmoid":
+        return _sig.sigmoid_cross_matvec(X, XB, coef, gamma, coef0,
+                                         block=block, precision=precision)
     validate_family(family)
     return _poly.poly_cross_matvec(X, XB, coef, gamma, coef0, degree,
                                    block=block, precision=precision)
@@ -118,8 +147,11 @@ def matvec(family: str, X: jax.Array, coef: jax.Array, *, gamma, coef0=0.0,
     """sum_j coef_j K(x_j, x_i) for all i — warm-start f reconstruction."""
     if family == "rbf":
         return _rbf.rbf_matvec(X, coef, gamma, block, precision)
-    if family == "linear":
+    if family == "linear" or family in APPROX_FAMILIES:
         return _lin.linear_matvec(X, coef, precision=precision)
+    if family == "sigmoid":
+        return _sig.sigmoid_matvec(X, coef, gamma, coef0, block=block,
+                                   precision=precision)
     validate_family(family)
     return _poly.poly_matvec(X, coef, gamma, coef0, degree, block=block,
                              precision=precision)

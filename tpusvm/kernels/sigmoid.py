@@ -1,0 +1,86 @@
+"""Sigmoid kernel K(x, z) = tanh(gamma * x.z + coef0).
+
+The last named exact-kernel gap of the (kernel, task) matrix: the same
+"dot product + pointwise epilogue" structure as the polynomial family —
+one MXU matmul forms the dots, tanh(gamma*. + coef0) is applied
+elementwise on the result tile. gamma and coef0 are traced scalars (a
+(gamma, coef0) sweep reuses one compiled solver, the contract every
+family shares); there is no static parameter, so one executable serves
+the whole family. Note the sigmoid kernel is only conditionally positive
+semi-definite (classic libsvm caveat) — SMO still runs (eta <= eps pairs
+are excluded like everywhere else), and the f64 oracle carries the same
+formulation, so parity evidence is meaningful regardless.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from tpusvm.ops.rbf import _prec, coef_matvec, matmul_p
+
+
+def _epilogue(dots: jax.Array, gamma, coef0) -> jax.Array:
+    return jnp.tanh(gamma * dots + coef0)
+
+
+def sigmoid_row(X: jax.Array, x: jax.Array, gamma, coef0,
+                precision=None) -> jax.Array:
+    """K(x, X[j]) for all j. Shape (n,)."""
+    return _epilogue(jnp.matmul(X, x, precision=_prec(precision)),
+                     gamma, coef0)
+
+
+def sigmoid_rows_at(X: jax.Array, idx: jax.Array, gamma, coef0,
+                    precision=None) -> jax.Array:
+    """K(X[idx[k]], X[j]) via one (k, d) x (d, n) matmul. Shape (k, n).
+
+    Routed through the precision ladder (ops.rbf.matmul_p) like the poly
+    family's K-row refresh.
+    """
+    dots = matmul_p(X[idx], X.T, precision)
+    return _epilogue(dots, gamma, coef0)
+
+
+def sigmoid_cross(XA: jax.Array, XB: jax.Array, gamma, coef0,
+                  precision=None) -> jax.Array:
+    """Full K(XA, XB), shape (nA, nB)."""
+    dots = jnp.matmul(XA, XB.T, precision=_prec(precision))
+    return _epilogue(dots, gamma, coef0)
+
+
+def sigmoid_cross_matvec(X: jax.Array, XB: jax.Array, coef: jax.Array,
+                         gamma, coef0, *, block: int = 8192,
+                         precision=None) -> jax.Array:
+    """sum_k coef_k K(x_i, xb_k) for all i, blocked over i. Shape (n,).
+
+    tanh is not linear, so (like poly) there is no primal collapse: the
+    generic blocked K-row path streams X in (block, q) tiles, never the
+    full (n, q) slab.
+    """
+    n, d = X.shape
+    block = min(block, n)
+    nb = -(-n // block)
+    coef = coef.astype(X.dtype)
+
+    def step(_, start):
+        zero = jnp.zeros((), start.dtype)
+        Xblk = jax.lax.dynamic_slice(X, (start, zero), (block, d))
+        dots = matmul_p(Xblk, XB.T, precision)
+        return None, coef_matvec(_epilogue(dots, gamma, coef0),
+                                 coef, precision)
+
+    starts = jnp.minimum(
+        jnp.arange(nb, dtype=jnp.int32) * block, max(n - block, 0)
+    )
+    _, chunks = jax.lax.scan(step, None, starts)
+    body = chunks[:-1].reshape(-1)
+    tail = chunks[-1, (nb * block - n):]
+    return jnp.concatenate([body, tail]).astype(X.dtype)
+
+
+def sigmoid_matvec(X: jax.Array, coef: jax.Array, gamma, coef0, *,
+                   block: int = 1024, precision=None) -> jax.Array:
+    """sum_j coef_j K(x_j, x_i) for all i. Shape (n,)."""
+    return sigmoid_cross_matvec(X, X, coef, gamma, coef0, block=block,
+                                precision=precision)

@@ -122,6 +122,11 @@ class OneVsRestSVC:
         self.solver_opts = dict(solver_opts or {})
         self.scaler_: Optional[MinMaxScaler] = None
         self.classes_: Optional[np.ndarray] = None
+        # approximate-kernel state (config.kernel in APPROX_FAMILIES):
+        # the fitted feature map + raw input width — X_sv_ then holds
+        # MAPPED rows and every predict path applies the map first
+        self.fmap_ = None
+        self.n_features_in_: Optional[int] = None
         self.X_sv_: Optional[np.ndarray] = None   # union of SVs across classes
         self.coef_: Optional[np.ndarray] = None   # (K, n_sv_union) alpha*y
         self.b_: Optional[np.ndarray] = None      # (K,)
@@ -151,6 +156,19 @@ class OneVsRestSVC:
             Xs = self.scaler_.transform(X)
         else:
             Xs = X
+        # approx families map ONCE for all heads: the K one-vs-rest
+        # problems share Phi(X) exactly as they share X (only the +/-1
+        # labels differ), so the fleet/blocked/pair paths below all run
+        # the linear primal geometry over one mapped matrix
+        from tpusvm import kernels as _kernels
+
+        if _kernels.is_approx(cfg.kernel):
+            from tpusvm.approx import build_map
+
+            self.n_features_in_ = int(np.asarray(Xs).shape[1])
+            self.fmap_ = build_map(cfg, X_scaled=np.asarray(Xs))
+            Xs = self.fmap_.transform_np(
+                np.asarray(Xs), np.dtype(jnp.dtype(self.dtype)))
         # the class_parallel path feeds X in as a mesh-replicated global
         # array instead, so only the single-controller branches pay the
         # plain device transfer
@@ -352,6 +370,22 @@ class OneVsRestSVC:
 
         Xq = self.scaler_.transform(np.asarray(X)) if self.scale else np.asarray(X)
         Xd, m = shard_rows_padded(mesh, jnp.asarray(Xq, self.dtype))
+        if self.fmap_ is not None:
+            # the FUSED map+gemm program — the exact executable serve's
+            # ovr bucket cache AOT-compiles, so served scores match this
+            # path bit-for-bit; the gemm is flat, so the row sharding of
+            # a mesh call partitions cleanly through the map too
+            from tpusvm.approx import approx_ovr_scores
+
+            params = tuple(jnp.asarray(a) for a in self.fmap_.arrays)
+            scores = approx_ovr_scores(
+                Xd, params,
+                jnp.asarray(self.X_sv_, self.dtype),
+                jnp.asarray(self.coef_, self.dtype),
+                jnp.asarray(self.b_, self.dtype),
+                family=self.config.kernel,
+            )
+            return np.asarray(scores[:m])
         scores = _ovr_scores(
             Xd,
             jnp.asarray(self.X_sv_, self.dtype),
@@ -384,6 +418,9 @@ class OneVsRestSVC:
         if self.scale:
             state["scaler_min"] = self.scaler_.min_val
             state["scaler_max"] = self.scaler_.max_val
+        if self.fmap_ is not None:
+            # approximate-map provenance (serialization format v4)
+            state.update(self.fmap_.state_entries())
         save_model(path, state, self.config)
 
     @classmethod
@@ -398,6 +435,13 @@ class OneVsRestSVC:
             model.scaler_ = MinMaxScaler(
                 min_val=state["scaler_min"], max_val=state["scaler_max"]
             )
+        from tpusvm import kernels as _kernels
+
+        if _kernels.is_approx(config.kernel):
+            from tpusvm.approx import map_from_state
+
+            model.fmap_ = map_from_state(state, config)
+            model.n_features_in_ = model.fmap_.n_features_in
         return model
 
 

@@ -13,18 +13,28 @@ Format history:
       EpsilonSVR; absent = classification), SVR states store signed
       `sv_coef` instead of (sv_Y, sv_alpha), and calibrated classifiers
       add `platt_a`/`platt_b`.
-  v3  the solver speed ladder (this version): state gains the training
-      provenance fields `train_precision` ("f32" | "bf16_f32" |
-      "bf16_f32c" | "default") and `shrink_every`/`shrink_stable` —
-      which ladder rung and shrinking cadence produced the artifact.
-      Scoring never reads them.
+  v3  the solver speed ladder: state gains the training provenance
+      fields `train_precision` ("f32" | "bf16_f32" | "bf16_f32c" |
+      "default") and `shrink_every`/`shrink_stable` — which ladder rung
+      and shrinking cadence produced the artifact. Scoring never reads
+      them.
+  v4  the approximate-kernel primal regime (this version): the config
+      gains the map parameters `rff_dim`/`map_seed`/`landmarks`
+      (tpusvm.approx), and approx-family states carry the map
+      provenance — `map_n_features_in` (the RAW input width; sv_X is
+      the MAPPED rows) for both families, plus the data-dependent
+      `map_landmarks`/`map_weights` arrays for nystrom (rff's omega
+      regenerates bit-identically from (d, rff_dim, gamma, map_seed),
+      so a saved rff model predicts without retraining OR storing the
+      map). Exact-family states are unchanged byte-for-byte.
 
-Compatibility contract: v1/v2 files LOAD — configs predating the kernel
-fields default to the implicit RBF family, and states predating the
-provenance fields load as f32/no-shrink; both are bit-identical in
-scoring to the build that wrote them. Files with an unknown kernel name
-fail with a specific error (written by a newer/tampered tpusvm), never a
-downstream shape or math error.
+Compatibility contract: v1/v2/v3 files LOAD — configs predating the
+kernel fields default to the implicit RBF family, configs predating the
+map fields to the (inert for exact families) map defaults, and states
+predating the provenance fields load as f32/no-shrink; all are
+bit-identical in scoring to the build that wrote them. Files with an
+unknown kernel name fail with a specific error (written by a
+newer/tampered tpusvm), never a downstream shape or math error.
 """
 
 from __future__ import annotations
@@ -36,8 +46,8 @@ import numpy as np
 
 from tpusvm.config import KERNEL_FAMILIES, SVMConfig
 
-_FORMAT_VERSION = 3
-_SUPPORTED_VERSIONS = (1, 2, 3)
+_FORMAT_VERSION = 4
+_SUPPORTED_VERSIONS = (1, 2, 3, 4)
 
 
 def _norm(path: str) -> str:

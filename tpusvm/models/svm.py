@@ -23,6 +23,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from tpusvm import kernels as _kernels
 from tpusvm.config import CascadeConfig, SVMConfig, resolve_accum_dtype
 from tpusvm.data.scaler import MinMaxScaler
 from tpusvm.models.serialization import load_model, save_model
@@ -99,6 +100,14 @@ class BinarySVC:
         self.shrink_stable_: int = 0
         # Platt sigmoid (A, B) after calibrate(); enables predict_proba
         self.platt_: Optional[tuple] = None
+        # approximate-kernel state (config.kernel in APPROX_FAMILIES):
+        # the fitted feature map and the RAW input width — sv_X_ then
+        # holds MAPPED rows, and every predict path applies the map
+        self.fmap_ = None
+        self.n_features_in_: Optional[int] = None
+        # streamed approx fits record the reader residency high-water
+        # mark (the prefetch_depth + 1 bound the tests audit)
+        self.stream_max_live_shards_: Optional[int] = None
 
     # ------------------------------------------------------------------ fit
     def _scale_fit(self, X: np.ndarray) -> np.ndarray:
@@ -106,6 +115,19 @@ class BinarySVC:
             self.scaler_ = MinMaxScaler().fit(X)
             return self.scaler_.transform(X)
         return X
+
+    def _map_fit(self, Xs: np.ndarray) -> np.ndarray:
+        """Fit + apply the approximate feature map (identity for exact
+        families): everything downstream — solver, SV extraction,
+        cascade buffers — then lives in the mapped space."""
+        if not _kernels.is_approx(self.config.kernel):
+            return Xs
+        from tpusvm.approx import build_map
+
+        self.n_features_in_ = int(Xs.shape[1])
+        self.fmap_ = build_map(self.config, X_scaled=Xs)
+        return self.fmap_.transform_np(
+            Xs, np.dtype(jnp.dtype(self.dtype)))
 
     def fit(self, X: np.ndarray, Y: np.ndarray,
             checkpoint_path: Optional[str] = None,
@@ -132,10 +154,17 @@ class BinarySVC:
 
         The scaler is fitted from MANIFEST statistics (bit-identical to a
         full-array fit — stream.stats) and shards are scaled as they
-        stream in, so the raw array is never materialised. The SCALED
-        matrix is — single-chip SMO needs every row on device; use
-        fit_cascade_stream when per-leaf loading is the point.
-        checkpoint_path/resume: see fit().
+        stream in, so the raw array is never materialised. For the EXACT
+        families the SCALED matrix is — single-chip SMO needs every row
+        on device; use fit_cascade_stream when per-leaf loading is the
+        point. The APPROXIMATE families (kernel="rff"/"nystrom") instead
+        run the streaming primal solver (tpusvm.approx.primal): shards
+        are mapped per-block inside the reader's prefetch hook and
+        consumed batch-by-batch, so NEITHER the raw nor the mapped
+        (n, D) matrix is ever materialised — peak residency stays the
+        reader's prefetch_depth + 1 bound (stream_max_live_shards_
+        records the audited high-water mark).
+        checkpoint_path/resume: see fit() (exact families only).
         """
         from tpusvm.stream.reader import ShardReader
 
@@ -143,6 +172,15 @@ class BinarySVC:
         scaler = None
         if self.scale:
             self.scaler_ = scaler = dataset.scaler()
+        if _kernels.is_approx(self.config.kernel):
+            if checkpoint_path is not None or resume:
+                raise ValueError(
+                    "checkpoint_path/resume is a blocked-solver outer-"
+                    "loop surface; the streamed approximate fit runs "
+                    "the tpusvm.approx.primal epoch schedule instead — "
+                    "checkpointing it is a future PR"
+                )
+            return self._fit_stream_approx(dataset, scaler, t0)
         parts = [X for X, _ in ShardReader(dataset, scaler=scaler)]
         Xs = np.concatenate(parts)
         del parts
@@ -151,12 +189,99 @@ class BinarySVC:
                                 checkpoint_every=checkpoint_every,
                                 resume=resume)
 
+    def _fit_stream_approx(self, dataset, scaler, t0: float) -> "BinarySVC":
+        """Out-of-core approx training: per-shard mapping in the reader's
+        prefetch hook + the streaming mini-batch primal solver.
+
+        The result is embedded as a ONE-support-vector linear model over
+        mapped features (sv_X_ = w, alpha*y = 1, b = -bias): exactly the
+        layout every predict/serve/serialization path already speaks, so
+        the primal regime rides the standard machinery unchanged.
+        solver_opts: primal_batch (default 1024), primal_epochs (64),
+        primal_tol (0.05 — the relative per-epoch improvement below
+        which the 1/t SGD tail is diminishing returns), prefetch_depth
+        (2); anything else is a blocked-solver knob and is rejected by
+        name.
+        """
+        from tpusvm.approx import build_map, streaming_primal_fit
+        from tpusvm.approx.features import nystrom_landmark_indices
+        from tpusvm.stream.reader import ShardReader
+
+        cfg = self.config
+        opts = dict(self.solver_opts)
+        batch = int(opts.pop("primal_batch", 1024))
+        epochs = int(opts.pop("primal_epochs", 64))
+        tol = float(opts.pop("primal_tol", 0.05))
+        prefetch_depth = int(opts.pop("prefetch_depth", 2))
+        if opts:
+            raise ValueError(
+                f"streamed approximate fits take only the primal knobs "
+                f"(primal_batch, primal_epochs, primal_tol, "
+                f"prefetch_depth); got blocked-solver opts "
+                f"{sorted(opts)}"
+            )
+        n, d = dataset.n_rows, dataset.n_features
+        self.n_features_in_ = int(d)
+        if cfg.kernel == "nystrom":
+            # the SAME seeded landmark rows the in-memory path would
+            # draw, gathered from the manifest without loading the rest
+            from tpusvm.stream.assign import gather_rows
+
+            idx = nystrom_landmark_indices(n, cfg.landmarks, cfg.map_seed)
+            rows = gather_rows(dataset, idx)
+            if scaler is not None:
+                rows = scaler.transform(rows)
+            fmap = build_map(cfg, landmark_rows=rows)
+        else:
+            fmap = build_map(cfg, n_features=d)
+        self.fmap_ = fmap
+        dt = np.dtype(jnp.dtype(self.dtype))
+        readers = []
+
+        def make_reader(epoch):
+            r = ShardReader(
+                dataset, prefetch_depth=prefetch_depth, scaler=scaler,
+                transform=lambda X: fmap.transform_np(X, dt))
+            readers.append(r)
+            return r
+
+        res = streaming_primal_fit(
+            make_reader, fmap.dim, C=cfg.C, n_rows=n, batch=batch,
+            epochs=epochs, tol=tol, dtype=dt)
+        self.stream_max_live_shards_ = max(
+            r.max_live_shards for r in readers)
+        self.train_time_s_ = time.perf_counter() - t0
+        self.sv_X_ = res.w[None, :].astype(dt)
+        self.sv_Y_ = np.array([1], np.int32)
+        self.sv_alpha_ = np.array([1.0], dt)
+        # the primal weight vector is not a training row: sentinel id
+        self.sv_ids_ = np.array([-1], np.int32)
+        # decision_function computes Phi(x).sv_coef - b_, and the primal
+        # model is f = w.Phi(x) - bias: same sign, b_ IS the bias
+        self.b_ = res.bias
+        self.n_iter_ = int(res.n_steps)
+        self.status_ = res.status
+        if self.status_ != Status.CONVERGED:
+            warnings.warn(
+                f"streaming primal fit ended {self.status_.name} after "
+                f"{res.epochs_run} epochs (objective {res.objective:g}); "
+                "raise primal_epochs or loosen primal_tol",
+                RuntimeWarning,
+                stacklevel=2,
+            )
+        return self
+
     def _fit_scaled(self, Xs: np.ndarray, Y: np.ndarray, t0: float,
                     checkpoint_path: Optional[str] = None,
                     checkpoint_every: int = 64,
                     resume: bool = False) -> "BinarySVC":
         """Shared solve + SV extraction on an already-scaled matrix."""
         cfg = self.config
+        # approx families: map first — the solver then runs the linear
+        # primal fast path over Phi(X) (kernels.dispatch routes the
+        # family name through kernels/linear.py), and the extracted
+        # sv_X_ rows are MAPPED rows
+        Xs = self._map_fit(Xs)
         opts = dict(self.solver_opts)
         shrink_every = opts.pop("shrink_every", 0)
         driver_kw = {k: opts.pop(k) for k in
@@ -282,9 +407,15 @@ class BinarySVC:
 
         stratified: per-class round-robin sharding instead of the
         reference's contiguous scatter — safe on label-sorted input
-        (parallel.cascade.cascade_fit)."""
+        (parallel.cascade.cascade_fit).
+
+        Approximate families (kernel="rff"/"nystrom") cascade over the
+        MAPPED features: the map is fitted once on the full scaled data,
+        every leaf solve then runs the linear primal fast path, and the
+        merged SV buffers hold mapped rows — cascade machinery applies
+        unchanged on top of the linear-cost solver."""
         t0 = time.perf_counter()
-        Xs = self._scale_fit(np.asarray(X))
+        Xs = self._map_fit(self._scale_fit(np.asarray(X)))
         res = cascade_fit(
             Xs, Y, self.config, cascade_config, mesh=mesh, dtype=self.dtype,
             # cascade_fit resolves the "auto" sentinel itself
@@ -317,6 +448,15 @@ class BinarySVC:
         fit_cascade on the equivalent array: same SV-ID set, same b, same
         accuracy (the partition is bit-identical and everything downstream
         consumes only the partition)."""
+        if _kernels.is_approx(self.config.kernel):
+            raise ValueError(
+                "fit_cascade_stream does not support the approximate "
+                f"families yet (kernel={self.config.kernel!r}): leaf "
+                "partitions are filled with RAW rows and the mapped "
+                "width would change every buffer shape; use fit_stream "
+                "(the streaming primal path) or in-memory fit_cascade "
+                "over mapped features"
+            )
         t0 = time.perf_counter()
         from tpusvm.stream.assign import partition_from_dataset
 
@@ -377,12 +517,28 @@ class BinarySVC:
         Xs = self.scaler_.transform(np.asarray(X)) if self.scale else np.asarray(X)
         Xd, m = shard_rows_padded(mesh, jnp.asarray(Xs, self.dtype))
         coef = jnp.asarray(self.sv_alpha_ * self.sv_Y_, self.dtype)
-        args = (
-            Xd,
-            jnp.asarray(self.sv_X_, self.dtype),
-            coef,
-            jnp.asarray(self.b_, self.dtype),
-        )
+        sv = jnp.asarray(self.sv_X_, self.dtype)
+        b = jnp.asarray(self.b_, self.dtype)
+        if self.fmap_ is not None:
+            # approx families: sv_X_ is MAPPED rows, Xd is raw scaled
+            # rows. Single-device scoring runs the FUSED map+decision
+            # program (approx_decision_function) — the exact executable
+            # serve's bucket cache AOT-compiles, so served scores are
+            # bit-identical to this path by construction. The mesh path
+            # maps first and uses the flat matmul (the fused program's
+            # blocked scan would destroy row sharding).
+            if mesh is not None:
+                Z = self.fmap_.transform(Xd)
+                scores = _decision_flat(Z, sv, coef, b, gamma=0.0,
+                                        kernel=self.config.kernel)
+            else:
+                from tpusvm.approx import approx_decision_function
+
+                params = tuple(jnp.asarray(a) for a in self.fmap_.arrays)
+                scores = approx_decision_function(
+                    Xd, params, sv, coef, b, family=self.config.kernel)
+            return np.asarray(scores[:m])
+        args = (Xd, sv, coef, b)
         kern = dict(gamma=self.config.gamma, kernel=self.config.kernel,
                     degree=self.config.degree, coef0=self.config.coef0)
         if mesh is not None:
@@ -477,6 +633,11 @@ class BinarySVC:
         state["train_precision"] = self.train_precision_
         state["shrink_every"] = self.shrink_every_
         state["shrink_stable"] = self.shrink_stable_
+        # approximate-map provenance (format v4): the raw input width
+        # for both families, landmark rows + inverse-root weights for
+        # nystrom; rff's omega regenerates from the config alone
+        if self.fmap_ is not None:
+            state.update(self.fmap_.state_entries())
         save_model(path, state, self.config)
 
     @classmethod
@@ -502,5 +663,13 @@ class BinarySVC:
         if "shrink_every" in state:
             model.shrink_every_ = int(state["shrink_every"])
             model.shrink_stable_ = int(state["shrink_stable"])
+        if _kernels.is_approx(config.kernel):
+            # v4: rebuild the fitted map (rff regenerates omega from the
+            # config; nystrom reads its stored landmark/weight arrays) —
+            # the loaded model predicts without retraining the map
+            from tpusvm.approx import map_from_state
+
+            model.fmap_ = map_from_state(state, config)
+            model.n_features_in_ = model.fmap_.n_features_in
         model.status_ = Status.CONVERGED
         return model

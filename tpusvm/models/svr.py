@@ -61,6 +61,10 @@ class EpsilonSVR:
         self.solver = solver
         self.solver_opts = dict(solver_opts or {})
         self.scaler_: Optional[MinMaxScaler] = None
+        # approximate-kernel state: fitted map + raw input width
+        # (sv_X_ holds MAPPED rows for the approx families)
+        self.fmap_ = None
+        self.n_features_in_: Optional[int] = None
         self.sv_X_: Optional[np.ndarray] = None
         self.sv_coef_: Optional[np.ndarray] = None
         self.sv_ids_: Optional[np.ndarray] = None
@@ -85,6 +89,18 @@ class EpsilonSVR:
             Xs = self.scaler_.transform(X)
         else:
             Xs = X
+        # approx families: the doubled problem solves over Phi(X) — the
+        # map is fitted on the SINGLE set of rows (the doubling shares
+        # it), and sv_X_ below holds mapped rows
+        from tpusvm import kernels as _kernels
+
+        if _kernels.is_approx(cfg.kernel):
+            from tpusvm.approx import build_map
+
+            self.n_features_in_ = int(np.asarray(Xs).shape[1])
+            self.fmap_ = build_map(cfg, X_scaled=np.asarray(Xs))
+            Xs = self.fmap_.transform_np(
+                np.asarray(Xs), np.dtype(jnp.dtype(self.dtype)))
         Y2, z = doubled_problem(t, cfg.epsilon)
         opts = dict(self.solver_opts)
         shrink_every = opts.pop("shrink_every", 0)
@@ -167,6 +183,20 @@ class EpsilonSVR:
         Xs = (self.scaler_.transform(np.asarray(X)) if self.scale
               else np.asarray(X))
         cfg = self.config
+        if self.fmap_ is not None:
+            # the fused map+decision program serve's bucket cache lowers
+            # (approx families; bit-identical served scores)
+            from tpusvm.approx import approx_decision_function
+
+            params = tuple(jnp.asarray(a) for a in self.fmap_.arrays)
+            scores = approx_decision_function(
+                jnp.asarray(Xs, self.dtype), params,
+                jnp.asarray(self.sv_X_, self.dtype),
+                jnp.asarray(self.sv_coef_, self.dtype),
+                jnp.asarray(self.b_, self.dtype),
+                family=cfg.kernel,
+            )
+            return np.asarray(scores)
         scores = _decision(
             jnp.asarray(Xs, self.dtype),
             jnp.asarray(self.sv_X_, self.dtype),
@@ -209,6 +239,9 @@ class EpsilonSVR:
         if self.scale:
             state["scaler_min"] = self.scaler_.min_val
             state["scaler_max"] = self.scaler_.max_val
+        if self.fmap_ is not None:
+            # approximate-map provenance (serialization format v4)
+            state.update(self.fmap_.state_entries())
         save_model(path, state, self.config)
 
     @classmethod
@@ -228,5 +261,12 @@ class EpsilonSVR:
             model.scaler_ = MinMaxScaler(
                 min_val=state["scaler_min"], max_val=state["scaler_max"]
             )
+        from tpusvm import kernels as _kernels
+
+        if _kernels.is_approx(config.kernel):
+            from tpusvm.approx import map_from_state
+
+            model.fmap_ = map_from_state(state, config)
+            model.n_features_in_ = model.fmap_.n_features_in
         model.status_ = Status.CONVERGED
         return model

@@ -54,12 +54,24 @@ def kernel_row(X: np.ndarray, x: np.ndarray, config: SVMConfig) -> np.ndarray:
 
     The oracle's single kernel touchpoint, mirroring tpusvm.kernels:
     "rbf" keeps the reference's per-pair formulation byte-for-byte;
-    "linear"/"poly" are the dot forms in f64.
+    "linear"/"poly"/"sigmoid" are the dot forms in f64. The approximate
+    families have no oracle kernel by design — their parity anchor is
+    the EXACT rbf oracle on the same instance (the accuracy-delta gate
+    of benchmarks/fuzz_parity.py mode 'rff'), so an approx family name
+    reaching this function is a harness bug, not a fallback case.
     """
     if config.kernel == "linear":
         return X @ x
     if config.kernel == "poly":
         return (config.gamma * (X @ x) + config.coef0) ** config.degree
+    if config.kernel == "sigmoid":
+        return np.tanh(config.gamma * (X @ x) + config.coef0)
+    if config.kernel != "rbf":
+        raise ValueError(
+            f"the NumPy oracle has no kernel {config.kernel!r} "
+            "(approximate families are gated against the exact rbf "
+            "oracle, not re-implemented here)"
+        )
     return rbf_row(X, x, config.gamma)
 
 

@@ -111,6 +111,36 @@ class CompileCache:
     def _lower(self, bucket: int, Xz):
         e = self.entry
         cfg = e.config
+        if e.fmap is not None:
+            # approximate families: the bucket executable is the FUSED
+            # map+decision program (tpusvm.approx) over RAW padded rows,
+            # with the pinned map parameter arrays as operands — the
+            # same jitted entry the offline decision_function calls, so
+            # served scores are bit-identical by construction
+            from tpusvm.approx import (
+                approx_decision_function,
+                approx_ovr_scores,
+            )
+
+            if e.kind in ("binary", "svr"):
+                # block deliberately NOT capped at the bucket (unlike the
+                # exact path below): the fused program pads raw rows to a
+                # block multiple BEFORE the map, so offline (block=2048
+                # default) and every bucket then run IDENTICAL matmul
+                # shapes — the bit-identity contract. Capping would run
+                # e.g. a 4-row gemm whose CPU dot kernel drifts ~1 ulp
+                # against the 2048-row program (measured at m=3/bucket=4;
+                # the same degenerate-shape physics as _MIN_BUCKET). The
+                # exact path's throughput rationale for the cap weighs
+                # differently here: the map+decision flops are MXU-dense
+                # and the padded rows vectorise, while a score that
+                # differs from the offline artifact is a correctness bug.
+                return approx_decision_function.lower(
+                    Xz, e.map_params, e.X_sv, e.coef, e.b,
+                    family=cfg.kernel, block=self.block)
+            return approx_ovr_scores.lower(
+                Xz, e.map_params, e.X_sv, e.coef, e.b,
+                family=cfg.kernel)
         if e.kind in ("binary", "svr"):
             # block capped at the bucket: decision_function pads m up to a
             # block multiple internally, so block=2048 would make a 1-row
@@ -174,7 +204,13 @@ class CompileCache:
         Xp = np.zeros((bucket, X.shape[1]), np.dtype(jnp.dtype(e.dtype)))
         Xp[:m] = X
         fn = self._get(bucket)
-        if e.kind in ("binary", "svr"):
+        if e.fmap is not None:
+            # fused map+decision executable: raw padded rows + the
+            # pinned map operands (padding rows map to garbage scores
+            # that are sliced off — row independence holds through the
+            # map's matmuls exactly as through the kernel's)
+            out = fn(jnp.asarray(Xp), e.map_params, e.X_sv, e.coef, e.b)
+        elif e.kind in ("binary", "svr"):
             out = fn(jnp.asarray(Xp), e.X_sv, e.coef, e.b)
         else:
             gamma = jnp.asarray(e.config.gamma, e.dtype)

@@ -33,7 +33,8 @@ class ModelEntry:
     name: str
     kind: str                      # "binary" | "ovr" | "svr"
     config: SVMConfig
-    n_features: int
+    n_features: int                # RAW request-row width (approx models:
+    #                                the pre-map input width; X_sv is mapped)
     X_sv: jax.Array                # (n_sv, d), device-resident
     coef: jax.Array                # binary: (n_sv,) alpha*y; ovr: (K, n_sv);
     #                                svr: (n_sv,) signed alpha - alpha*
@@ -45,6 +46,12 @@ class ModelEntry:
     # frontend then adds a `proba` field computed host-side from the
     # served scores — the exact predict_proba arithmetic
     platt: Optional[tuple] = None
+    # approximate-kernel models (config.kernel in APPROX_FAMILIES): the
+    # fitted FeatureMap (host provenance) and its parameter arrays pinned
+    # on device — the bucket cache lowers the FUSED map+decision program
+    # (tpusvm.approx) and feeds these pinned operands to every call
+    fmap: Optional[object] = None
+    map_params: Optional[tuple] = None
 
     @property
     def n_sv(self) -> int:
@@ -66,36 +73,52 @@ class ModelEntry:
         """
         # OneVsRestSVC carries classes_/X_sv_/coef_; EpsilonSVR sv_coef_;
         # BinarySVC sv_X_/sv_alpha_
+        fmap = getattr(model, "fmap_", None)
+        map_kw = {}
+        if fmap is not None:
+            # pin the map's parameter arrays once, like the SV set — a
+            # steady-state request uploads only its own padded raw rows
+            map_kw = dict(fmap=fmap, map_params=tuple(
+                jnp.asarray(a) for a in fmap.arrays))
+
+        def nf(sv_arr) -> int:
+            # approx models serve RAW rows (the executable maps inside);
+            # sv_arr's width is the MAPPED dim there, not the row width
+            return (int(fmap.n_features_in) if fmap is not None
+                    else int(sv_arr.shape[1]))
+
         if getattr(model, "classes_", None) is not None:
             if model.X_sv_ is None:
                 raise RuntimeError("model is not fitted")
             return cls(
                 name=name, kind="ovr", config=model.config,
-                n_features=int(model.X_sv_.shape[1]),
+                n_features=nf(model.X_sv_),
                 X_sv=jnp.asarray(model.X_sv_, model.dtype),
                 coef=jnp.asarray(model.coef_, model.dtype),
                 b=jnp.asarray(model.b_, model.dtype),
                 scaler=model.scaler_ if model.scale else None,
                 classes=np.asarray(model.classes_),
                 dtype=model.dtype,
+                **map_kw,
             )
         if model.sv_X_ is None:
             raise RuntimeError("model is not fitted")
         if getattr(model, "sv_coef_", None) is not None:
             return cls(
                 name=name, kind="svr", config=model.config,
-                n_features=int(model.sv_X_.shape[1]),
+                n_features=nf(model.sv_X_),
                 X_sv=jnp.asarray(model.sv_X_, model.dtype),
                 coef=jnp.asarray(model.sv_coef_, model.dtype),
                 b=jnp.asarray(model.b_, model.dtype),
                 scaler=model.scaler_ if model.scale else None,
                 classes=None,
                 dtype=model.dtype,
+                **map_kw,
             )
         coef = np.asarray(model.sv_alpha_) * np.asarray(model.sv_Y_)
         return cls(
             name=name, kind="binary", config=model.config,
-            n_features=int(model.sv_X_.shape[1]),
+            n_features=nf(model.sv_X_),
             X_sv=jnp.asarray(model.sv_X_, model.dtype),
             coef=jnp.asarray(coef, model.dtype),
             b=jnp.asarray(model.b_, model.dtype),
@@ -103,6 +126,7 @@ class ModelEntry:
             classes=None,
             dtype=model.dtype,
             platt=getattr(model, "platt_", None),
+            **map_kw,
         )
 
     @classmethod
@@ -145,6 +169,14 @@ class ModelEntry:
         if self.config.kernel == "poly":
             d["degree"] = self.config.degree
             d["coef0"] = self.config.coef0
+        if self.config.kernel == "sigmoid":
+            d["coef0"] = self.config.coef0
+        if self.fmap is not None:
+            # approx provenance: which map is fused into the executables
+            d["map_seed"] = self.config.map_seed
+            d["map_dim"] = self.fmap.dim
+            if self.config.kernel == "nystrom":
+                d["landmarks"] = self.config.landmarks
         if self.kind == "svr":
             d["epsilon"] = self.config.epsilon
         if self.classes is not None:

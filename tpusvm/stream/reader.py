@@ -54,6 +54,13 @@ class ShardReader:
         manifest-fitted global scaler), so consumers see scaled rows
         without a second pass over the data.
       dtype: optional numpy dtype the X block is cast to after scaling.
+      transform: optional row-wise feature transform applied LAST (after
+        scaler and dtype), per shard, on the producer thread — the
+        approximate-kernel prefetch hook (tpusvm.approx.FeatureMap
+        .transform_np): mapped features are produced while IO overlaps
+        compute, so no materialised (n, D) feature array ever exists and
+        the residency bound is unchanged (a block is one resident shard
+        whether raw or mapped). Must be a pure (m, d) -> (m, D) function.
       verify: re-checksum each shard against the manifest on load.
       metrics: an obs.registry.MetricsRegistry for the pipeline health
         counters (default: the process-wide default_registry) —
@@ -73,7 +80,8 @@ class ShardReader:
     def __init__(self, dataset: ShardedDataset, prefetch_depth: int = 2,
                  seed: Optional[int] = None, scaler=None, dtype=None,
                  verify: bool = False, metrics=None,
-                 retry_policy: Optional[faults.RetryPolicy] = None):
+                 retry_policy: Optional[faults.RetryPolicy] = None,
+                 transform=None):
         if prefetch_depth < 1:
             raise ValueError(
                 f"prefetch_depth must be >= 1, got {prefetch_depth}"
@@ -82,6 +90,7 @@ class ShardReader:
         self.prefetch_depth = prefetch_depth
         self.scaler = scaler
         self.dtype = dtype
+        self.transform = transform
         self.verify = verify
         order = np.arange(dataset.n_shards)
         if seed is not None:
@@ -157,6 +166,8 @@ class ShardReader:
                         X = self.scaler.transform(X)
                     if self.dtype is not None:
                         X = np.asarray(X, self.dtype)
+                    if self.transform is not None:
+                        X = self.transform(X)
                 except BaseException:
                     self._release()
                     raise

@@ -198,6 +198,22 @@ def normalize_kernel_specs(kernel_specs, base: SVMConfig) -> List[Dict[str, Any]
         if isinstance(spec, str):
             spec = {"kernel": spec}
         family = _kernels.validate_family(spec.get("kernel", base.kernel))
+        if _kernels.is_approx(family):
+            # explicit interop decision (no silent wrong-answer path):
+            # tune sweeps gamma as a TRACED scalar over shared fold
+            # caches, but an approx family bakes gamma into its feature
+            # map — every gamma cell would need its own mapped fold
+            # caches and its own warm store, which is a different search
+            # architecture (a map-aware tune is a future PR)
+            raise ValueError(
+                f"tune does not search approximate kernel families "
+                f"({family!r}): gamma parameterises the feature map "
+                "itself (tpusvm.approx), so the shared-fold-cache "
+                "(C, gamma) sweep cannot apply; tune the exact 'rbf' "
+                "family and train the chosen (C, gamma) with "
+                f"kernel={family!r}, or sweep approx fits explicitly "
+                "with benchmarks/approx_scale.py"
+            )
         resolved = {
             "kernel": family,
             "degree": int(spec.get("degree", base.degree)),
