@@ -11,7 +11,7 @@ type, reporters and fingerprinted baseline
 Dynamic arm (``python -m tpusvm.analysis conc-stress``): a deterministic
 schedule-perturbation harness — seeded lock/queue/semaphore wrappers
 inject yields and micro-sleeps at acquire/release/handoff points —
-driven against the four real hot objects (obs MetricsRegistry, serve
+driven against the five real hot objects (obs MetricsRegistry, serve
 MicroBatcher, stream ShardReader, faults CircuitBreaker) with their own
 invariants asserted; any violation reports the reproducing seed.
 """

@@ -128,7 +128,7 @@ def build_stress_parser() -> argparse.ArgumentParser:
                    help="schedule seed; a violation report names the "
                         "seed that reproduces it (default 0)")
     p.add_argument("--suite", action="append", default=[],
-                   help="suite to run (repeatable; default: the four "
+                   help="suite to run (repeatable; default: the five "
                         "real-object suites)")
     p.add_argument("--list-suites", action="store_true")
     p.add_argument("--self-test", action="store_true",
@@ -136,7 +136,7 @@ def build_stress_parser() -> argparse.ArgumentParser:
                         "racy fixture (exit 1 if no seed in 0..7 "
                         "triggers it)")
     p.add_argument("--smoke", action="store_true",
-                   help="CI gate: all four real-object suites clean at "
+                   help="CI gate: all five real-object suites clean at "
                         "the fixed seed AND the self-test catches the "
                         "racy fixture")
     return p

@@ -40,6 +40,13 @@ Suites (run all: `python -m tpusvm.analysis conc-stress`):
             machine (closed -tripped-> open -half_open-> half_open
             -recovered/reopened-> ...), and trip/recovery counters match
             the event log;
+  swap      serve ModelRegistry's versioned hot-swap: swapper threads
+            flip entries while readers call get_versioned() with the
+            registry lock perturbed across the generation flip — a
+            reader must never observe a torn pair (the returned entry's
+            own generation stamp disagreeing with the generation the
+            registry reports), generations must be monotone per reader,
+            and the final count must equal 1 + successful swaps;
   racy      a DELIBERATELY broken fixture (read-modify-write with no
             lock) the harness must catch — the self-test proving the
             perturber actually amplifies races (`--self-test`).
@@ -67,6 +74,8 @@ SUITE_SITES = {
                "reader.q.put", "reader.q.get", "reader.load",
                "reader.consume"),
     "breaker": ("breaker.step",),
+    "swap": ("swap.lock.acquire", "swap.lock.release", "swap.read",
+             "swap.flip"),
     "racy": ("racy.rmw",),
 }
 
@@ -585,6 +594,83 @@ def stress_breaker(seed: int = DEFAULT_SEED, iters: int = 150,
     return _report("breaker", p, violations, t0)
 
 
+def stress_swap(seed: int = DEFAULT_SEED, iters: int = 120,
+                threads: int = 4) -> StressReport:
+    """serve ModelRegistry versioned swap: the generation flip perturbed.
+
+    The REAL registry object (serve/registry.py) hammered with its lock
+    wrapped by PerturbLock: `threads` swapper threads flip fresh stub
+    entries in while one reader thread spins on get_versioned().
+    Invariants — the atomic-hot-swap contract the serving runtime
+    builds on:
+
+      * no torn pair: get_versioned's (entry, generation) always agree
+        with the entry's own `.generation` stamp (swap writes both in
+        ONE lock region; a torn implementation parks exactly where the
+        perturber sleeps);
+      * monotone: generations observed by the reader never decrease;
+      * exact count: the final generation is 1 + total swaps (no flip
+        lost, none double-counted)."""
+    from tpusvm.serve.registry import ModelRegistry
+
+    p = SchedulePerturber(seed)
+    t0 = time.perf_counter()
+    reg = ModelRegistry()
+    reg._lock = PerturbLock(p, "swap.lock", inner=reg._lock)
+
+    class _Stub:
+        """Duck-typed ModelEntry: the registry reads .name and stamps
+        .generation; nothing else is touched by add/swap/get."""
+
+        __slots__ = ("name", "generation", "tag")
+
+        def __init__(self, tag):
+            self.name = "m"
+            self.generation = 1
+            self.tag = tag
+
+    reg.add(_Stub(("init", 0)))
+    violations: List[str] = []
+    vlock = threading.Lock()
+    stop = threading.Event()
+
+    def swapper(t):
+        def run():
+            for i in range(iters):
+                reg.swap(_Stub((t, i)))
+                p.perturb("swap.flip")
+        return run
+
+    def reader():
+        last = 0
+        while not stop.is_set():
+            e, gen = reg.get_versioned("m")
+            p.perturb("swap.read")
+            if e.generation != gen:
+                with vlock:
+                    violations.append(
+                        f"torn read: entry stamped generation "
+                        f"{e.generation} but registry reported {gen} "
+                        f"(tag {e.tag})")
+            if gen < last:
+                with vlock:
+                    violations.append(
+                        f"generation went backwards: {gen} after {last}")
+            last = gen
+
+    rthread = threading.Thread(target=reader, daemon=True)
+    rthread.start()
+    violations += _run_threads([swapper(t) for t in range(threads)])
+    stop.set()
+    rthread.join(timeout=30.0)
+    final = reg.generation("m")
+    want = 1 + threads * iters
+    if final != want:
+        violations.append(
+            f"final generation {final} != 1 + {threads * iters} swaps")
+    return _report("swap", p, violations, t0)
+
+
 # ----------------------------------------------------------- self-test
 class RacyTally:
     """DELIBERATELY racy: classic read-modify-write with no lock. The
@@ -627,12 +713,13 @@ SUITES: Dict[str, Callable[..., StressReport]] = {
     "batcher": stress_batcher,
     "reader": stress_reader,
     "breaker": stress_breaker,
+    "swap": stress_swap,
     "racy": stress_racy,
 }
 
 # the real-object suites --smoke runs (racy is the self-test, expected
 # to FAIL — it proves the harness catches what it exists to catch)
-REAL_SUITES = ("registry", "batcher", "reader", "breaker")
+REAL_SUITES = ("registry", "batcher", "reader", "breaker", "swap")
 
 
 def self_test(seeds: Sequence[int] = range(8)) -> Optional[StressReport]:

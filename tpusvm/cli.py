@@ -401,10 +401,12 @@ def _build_parser() -> argparse.ArgumentParser:
         "serve", parents=[common],
         help="serve saved models over HTTP with deadline-aware "
         "micro-batching (tpusvm.serve)")
-    sv.add_argument("--model", action="append", required=True,
+    sv.add_argument("--model", action="append", default=[],
                     metavar="[NAME=]NPZ", dest="models",
                     help="model to host, repeatable; NAME defaults to the "
-                    "file stem (binary vs multiclass auto-detected)")
+                    "file stem (binary vs multiclass auto-detected). "
+                    "Optional when --state names a manifest to restore "
+                    "or --watch a directory to load from")
     sv.add_argument("--host", default="127.0.0.1")
     sv.add_argument("--port", type=int, default=8471,
                     help="HTTP port (0 = ephemeral; default 8471)")
@@ -434,6 +436,35 @@ def _build_parser() -> argparse.ArgumentParser:
     sv.add_argument("--no-warmup", action="store_true",
                     help="skip AOT-compiling the bucket executables (first "
                     "request per bucket then pays the compile)")
+    rr = sv.add_argument_group("restart robustness / continuous serving")
+    rr.add_argument("--cache-dir", metavar="DIR", default=None,
+                    help="persist the compile cache: bucket executables "
+                    "compile through jax's persistent compilation cache "
+                    "in DIR (plus a bucket-signature manifest), so a "
+                    "restarted server — or a replica sharing DIR — "
+                    "reaches first prediction with ZERO fresh XLA "
+                    "compiles (BENCH_r01's 22.3s cold start becomes a "
+                    "cache read); also honoured from TPUSVM_CACHE_DIR")
+    rr.add_argument("--assert-cached", action="store_true",
+                    help="with --cache-dir: exit non-zero unless EVERY "
+                    "compile this run was served from the persistent "
+                    "cache (cache misses == 0) — the CI restart gate "
+                    "run as the second of two smokes sharing DIR")
+    rr.add_argument("--state", metavar="JSON", default=None,
+                    help="serialized registry manifest (serve_state."
+                    "json): restored at startup (the full model set "
+                    "reloads with its generation history) and "
+                    "atomically rewritten after every successful "
+                    "load/swap")
+    rr.add_argument("--watch", metavar="DIR", default=None,
+                    help="poll DIR for model .npz files: a new stem "
+                    "loads as a new model, a newer mtime on a hosted "
+                    "stem hot-swaps it in (staged off to the side, "
+                    "probe-verified, atomic generation flip — a bad "
+                    "artifact rolls back and the old generation keeps "
+                    "serving); the `tune`/`refresh` --save handoff")
+    rr.add_argument("--watch-interval-s", type=float, default=2.0,
+                    help="--watch poll period (default 2.0)")
     sv.add_argument("--smoke", action="store_true",
                     help="no HTTP: warm up, fire concurrent in-process "
                     "requests, print metrics, exit non-zero on any error "
@@ -471,6 +502,51 @@ def _build_parser() -> argparse.ArgumentParser:
                      help="admission control: shed new requests "
                      "(OVERLOADED, retryable) while the latency budget "
                      "burns; requires --slo-p99-ms")
+
+    rf = sub.add_parser(
+        "refresh", parents=[common],
+        help="crash-safe online refresh: warm-start a refit from a "
+        "DEPLOYED model's alphas, checkpoint it, save atomically, and "
+        "hot-swap it into a running `tpusvm serve` (tpusvm.serve."
+        "refresh)")
+    add_data_source(rf, sharded=False)
+    rf.set_defaults(multiclass=False, task="svc")
+    rf.add_argument("--model", metavar="NPZ",
+                    help="the deployed artifact to refresh (required "
+                    "unless --smoke); its config and alphas seed the "
+                    "refit — the new data must keep its training rows "
+                    "as a prefix (appended micro-batches)")
+    rf.add_argument("--save", metavar="NPZ",
+                    help="refreshed artifact output (atomic write; "
+                    "required unless --smoke) — drop it in a serve "
+                    "--watch directory or name it with --swap")
+    rf.add_argument("--cold", action="store_true",
+                    help="skip the warm seed (the control arm the warm "
+                    "path's update savings are measured against)")
+    rf.add_argument("--checkpoint", metavar="NPZ",
+                    help="crash-safe refit: solver-carry checkpoints "
+                    "every --checkpoint-every outer rounds; a killed "
+                    "refresh resumed with --resume is bit-identical to "
+                    "an uninterrupted one")
+    rf.add_argument("--checkpoint-every", type=int, default=64,
+                    metavar="K")
+    rf.add_argument("--resume", action="store_true",
+                    help="resume from --checkpoint if it exists")
+    rf.add_argument("--swap", metavar="URL", dest="swap_url",
+                    help="after saving, POST /admin/swap on this "
+                    "running serve frontend (e.g. "
+                    "http://127.0.0.1:8471) — the staged atomic flip; "
+                    "a refused swap reports the server's rollback "
+                    "reason")
+    rf.add_argument("--swap-name", metavar="NAME", default=None,
+                    help="hosted model name to swap (default: the "
+                    "--save file stem)")
+    rf.add_argument("--smoke", action="store_true",
+                    help="CI gate: deploy a tiny model, grow the data, "
+                    "refresh warm + cold control, hot-swap in-process; "
+                    "asserts convergence, warm update savings, and "
+                    "bit-identical served scores post-swap")
+    rf.add_argument("-q", "--quiet", action="store_true")
 
     tu = sub.add_parser(
         "tune", parents=[common],
@@ -1585,22 +1661,78 @@ def _cmd_serve(args) -> int:
                     server._worker(name).metrics.registry_snapshot())
         _close_tracer(tracer)
 
+    from tpusvm.serve import ModelLoadError
+
+    cache_dir = args.cache_dir or os.environ.get("TPUSVM_CACHE_DIR")
+    if args.assert_cached and not cache_dir:
+        raise SystemExit("serve: --assert-cached needs --cache-dir (or "
+                         "TPUSVM_CACHE_DIR) — there is no persistent "
+                         "cache to have hit")
+    if not (args.models or args.state or args.watch):
+        raise SystemExit("serve: nothing to host — pass --model, "
+                         "--state MANIFEST, or --watch DIR")
+
     server = Server(cfg, dtype=getattr(jnp, args.dtype))
-    for spec in args.models:
-        name, sep, path = spec.partition("=")
-        if not sep:
-            name, path = "", spec
-        if not name:
-            name = os.path.splitext(os.path.basename(path))[0]
-        entry = server.load_model(name, path)
-        print(f"loaded {name}: {entry.kind}, {entry.n_sv} SVs, "
-              f"{entry.n_features} features")
+    if cache_dir:
+        manifest = server.configure_cache(cache_dir)
+        known = len(manifest.get("signatures", {}))
+        print(f"persistent compile cache: {cache_dir} "
+              f"({known} known bucket signatures"
+              f"{' — expecting a warm start' if known else ''})")
+    if args.state:
+        try:
+            restored = server.restore_state(args.state)
+        except FileNotFoundError:
+            print(f"serve state {args.state}: absent (fresh start); "
+                  "will be written after the first load")
+        except ValueError as e:
+            raise SystemExit(f"serve: --state: {e}")
+        else:
+            for n in restored["restored"]:
+                gen = server.registry.generation(n)
+                print(f"restored {n} (generation {gen}) from "
+                      f"{args.state}")
+            for n in restored["skipped"]:
+                print(f"NOT restored (no source path recorded): {n}")
+        server.enable_state(args.state)
+    try:
+        for spec in args.models:
+            name, sep, path = spec.partition("=")
+            if not sep:
+                name, path = "", spec
+            if not name:
+                name = os.path.splitext(os.path.basename(path))[0]
+            entry = server.load_model(name, path)
+            print(f"loaded {name}: {entry.kind}, {entry.n_sv} SVs, "
+                  f"{entry.n_features} features")
+    except ModelLoadError as e:
+        # the classified load failure (ServeStatus.LOAD_FAILED): the
+        # offending path and cause, never a raw numpy/zipfile traceback
+        raise SystemExit(f"serve: {e}")
     if not args.no_warmup:
         warm_span = (tracer.span("warmup", phase=True) if tracer
                      else contextlib.nullcontext())
         with warm_span:
             for name, n in server.warmup().items():
                 print(f"warmed {name}: {n} bucket executables compiled")
+    if cache_dir:
+        from tpusvm.serve.cache import persistent_cache_stats
+
+        stats = persistent_cache_stats()
+        print(f"persistent cache: {stats['hits']} hits, "
+              f"{stats['misses']} misses")
+
+    watcher = None
+    if args.watch:
+        from tpusvm.serve.watch import ModelWatcher
+
+        watcher = ModelWatcher(server, args.watch,
+                               interval_s=args.watch_interval_s,
+                               log_fn=print)
+        watcher.poll_once()  # pick up anything already there
+        if not args.smoke:
+            watcher.start()
+        print(f"watching {args.watch} every {args.watch_interval_s:g}s")
 
     from tpusvm.utils import trace as _profile_trace
 
@@ -1610,6 +1742,19 @@ def _cmd_serve(args) -> int:
         with smoke_span, _profile_trace(args.profile):
             rc = _serve_smoke(server, args.smoke_threads,
                               args.smoke_requests)
+        if args.assert_cached:
+            from tpusvm.serve.cache import persistent_cache_stats
+
+            misses = persistent_cache_stats()["misses"]
+            if misses:
+                print(f"SMOKE FAILED --assert-cached: {misses} compile "
+                      "cache misses (expected every executable to come "
+                      "off the persistent cache)")
+                rc = rc or 1
+            else:
+                print("assert-cached ok: 0 fresh compiles — warm "
+                      "restart reached serving entirely from the "
+                      "persistent cache")
         print(server.metrics_text(), end="")
         _trace_final_metrics()
         server.close()
@@ -1623,13 +1768,16 @@ def _cmd_serve(args) -> int:
     server.attach_http(httpd)
     host, port = httpd.server_address[:2]
     print(f"serving on http://{host}:{port} "
-          f"(POST /v1/models/<name>:predict, GET /metrics)")
+          f"(POST /v1/models/<name>:predict, POST /admin/swap, "
+          f"GET /metrics)")
     try:
         with _profile_trace(args.profile):
             httpd.serve_forever()
     except KeyboardInterrupt:
         pass
     finally:
+        if watcher is not None:
+            watcher.stop()
         print(server.metrics_text(), end="")
         print(json.dumps(server.status()))
         _trace_final_metrics()
@@ -1676,6 +1824,130 @@ def _serve_smoke(server, n_threads: int, n_requests: int) -> int:
             print(f"SMOKE FAILED {name}: statuses={bad} errors={errors} "
                   f"recompiles={recompiles}")
         return 1
+    return 0
+
+
+def _cmd_refresh(args) -> int:
+    """Warm-started crash-safe refit of a deployed model + hot-swap."""
+    import os
+
+    from tpusvm.serve.refresh import refresh_fit, swap_via_http
+    from tpusvm.utils import PhaseTimer
+
+    if args.smoke:
+        return _refresh_smoke(args)
+    if not args.model or not args.save:
+        raise SystemExit("refresh: --model (the deployed artifact) and "
+                         "--save (the refreshed output) are required "
+                         "(or --smoke)")
+    if args.resume and not args.checkpoint:
+        raise SystemExit("refresh: --resume requires --checkpoint")
+
+    say = (lambda msg: None) if args.quiet else print
+    timer = PhaseTimer()
+    with timer.phase("data"):
+        X, Y, Xt, Yt = _load_train_data(args)
+    say(f"refresh: {X.shape[0]} rows x {X.shape[1]} features "
+        f"(deployed: {args.model})")
+    try:
+        with timer.phase("training"):
+            model = refresh_fit(
+                args.model, X, Y, out_path=args.save,
+                checkpoint_path=args.checkpoint,
+                checkpoint_every=args.checkpoint_every,
+                resume=args.resume, warm=not args.cold,
+            )
+    except (OSError, ValueError) as e:
+        raise SystemExit(f"refresh: {e}")
+    say(f"refreshed model: {model.n_support_} SVs, "
+        f"{model.n_iter_} updates, status {model.status_.name}, "
+        f"saved to {args.save}")
+    if Xt is not None and len(Xt):
+        with timer.phase("prediction"):
+            acc = model.score(Xt, Yt)
+        say(f"held-out accuracy = {acc:.4f}")
+    if args.swap_url:
+        name = args.swap_name or os.path.splitext(
+            os.path.basename(args.save))[0]
+        try:
+            out = swap_via_http(args.swap_url, name,
+                                os.path.abspath(args.save))
+        except (RuntimeError, OSError) as e:
+            raise SystemExit(f"refresh: {e}")
+        say(f"swapped {name} -> generation {out['generation']} "
+            f"({out['latency_s'] * 1e3:.1f} ms; the artifact is live)")
+    say(timer.report())
+    return 0
+
+
+def _refresh_smoke(args) -> int:
+    """CI gate for the refresh loop: deploy tiny, grow, refresh warm +
+    cold control, hot-swap in-process; gates convergence, warm update
+    savings, and bit-identical served scores post-swap."""
+    import tempfile
+
+    import jax.numpy as jnp
+
+    from tpusvm.config import SVMConfig
+    from tpusvm.data import rings
+    from tpusvm.models import BinarySVC
+    from tpusvm.serve import ServeConfig, Server
+    from tpusvm.serve.refresh import refresh_fit
+
+    failures = []
+    X, Y = rings(n=360, seed=11)
+    with tempfile.TemporaryDirectory() as td:
+        import os as _os
+
+        deployed = _os.path.join(td, "deployed.npz")
+        refreshed = _os.path.join(td, "refreshed.npz")
+        cfg = SVMConfig(C=10.0, gamma=10.0)
+        # the deployed model: trained on the data's prefix
+        BinarySVC(cfg).fit(X[:240], Y[:240]).save(deployed)
+        warm = refresh_fit(deployed, X, Y, out_path=refreshed)
+        cold = refresh_fit(deployed, X, Y,
+                           out_path=_os.path.join(td, "cold.npz"),
+                           warm=False)
+        if warm.status_.name != "CONVERGED":
+            failures.append(f"warm refresh ended {warm.status_.name}")
+        if cold.status_.name != "CONVERGED":
+            failures.append(f"cold control ended {cold.status_.name}")
+        if warm.n_iter_ >= cold.n_iter_:
+            failures.append(
+                f"warm seed saved nothing: {warm.n_iter_} updates warm "
+                f"vs {cold.n_iter_} cold")
+        acc = warm.score(X, Y)
+        if acc <= 0.8:
+            failures.append(f"refreshed accuracy gate failed ({acc:.4f})")
+        # the hot-swap leg: deployed serves, the refresh swaps in, and
+        # the served scores ARE the refreshed model's offline scores
+        with Server(ServeConfig(max_batch=8)) as srv:
+            srv.load_model("m", deployed)
+            srv.warmup()
+            out = srv.swap("m", refreshed)
+            scores, _ = srv.predict_direct("m", X[:16])
+            ref = srv.registry.get("m")
+            offline = BinarySVC.load(refreshed, dtype=jnp.float32)
+            import numpy as _np
+
+            want = _np.asarray(offline.decision_function(X[:16]))
+            if not _np.array_equal(scores, want):
+                failures.append("served scores after swap are not "
+                                "bit-identical to the refreshed model")
+            if out["generation"] != 2 or ref.generation != 2:
+                failures.append(
+                    f"swap generation bookkeeping off: {out}")
+            h = srv.health()
+            if h["status"] != "ok" or h["swap"]["m"]["staleness_s"] < 0:
+                failures.append(f"health after swap: {h['status']}")
+    if failures:
+        for f in failures:
+            print(f"REFRESH SMOKE FAILED: {f}")
+        return 1
+    print(f"refresh smoke ok: warm {warm.n_iter_} vs cold "
+          f"{cold.n_iter_} updates "
+          f"({1 - warm.n_iter_ / cold.n_iter_:.1%} saved), accuracy "
+          f"{acc:.4f}, swap generation 2, served scores bit-identical")
     return 0
 
 
@@ -2161,6 +2433,7 @@ def main(argv=None) -> int:
         jax.distributed.initialize(**kw)
     return {"train": _cmd_train, "ingest": _cmd_ingest,
             "predict": _cmd_predict, "serve": _cmd_serve,
+            "refresh": _cmd_refresh,
             "tune": _cmd_tune, "info": _cmd_info,
             "report": _cmd_report,
             "benchdiff": _cmd_benchdiff}[args.command](args)

@@ -12,6 +12,17 @@ Commands:
                       retried to success. Non-zero exit on any failure.
   validate PLAN.json  Parse + validate a fault plan (rule points/kinds
                       checked against the registry); prints the rules.
+  swap-chaos-smoke    The resilient-serving CI gate: client threads
+                      stream requests while hot-swaps flip between two
+                      models with distinct outputs, under a fault plan
+                      that kills a swap mid-stage, corrupts a staged
+                      artifact's bytes, and injects compile/stage
+                      latency. Asserts: every scored response bitwise-
+                      matches exactly one of the two models (no torn
+                      generation), zero requests lost or errored beyond
+                      the injected causes, every failed stage rolled
+                      back to a serving old generation with /healthz
+                      degraded, and a subsequent clean swap recovers.
 """
 
 from __future__ import annotations
@@ -132,6 +143,133 @@ def _validate(path: str) -> int:
     return 0
 
 
+def _swap_chaos_smoke() -> int:
+    import os
+    import threading
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tpusvm import faults
+    from tpusvm.config import SVMConfig
+    from tpusvm.data import rings
+    from tpusvm.models import BinarySVC
+    from tpusvm.serve import ModelLoadError, ServeConfig, Server
+
+    failures = []
+    Xa, Ya = rings(n=240, seed=2)
+    Xb, Yb = rings(n=240, seed=9)
+    A = BinarySVC(SVMConfig(C=10.0, gamma=10.0),
+                  dtype=jnp.float32).fit(Xa, Ya)
+    B = BinarySVC(SVMConfig(C=10.0, gamma=5.0),
+                  dtype=jnp.float32).fit(Xb, Yb)
+    Xq, _ = rings(n=32, seed=3)
+
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as td, \
+            Server(ServeConfig(max_batch=8), dtype=jnp.float32) as srv:
+        pa = os.path.join(td, "a.npz")
+        pb = os.path.join(td, "b.npz")
+        A.save(pa)
+        B.save(pb)
+        srv.load_model("m", pa)
+        srv.warmup()
+        refA, _ = srv.predict_direct("m", Xq)
+        srv.swap("m", pb)
+        refB, _ = srv.predict_direct("m", Xq)
+        srv.swap("m", pa)
+        if np.array_equal(refA, refB):
+            print("SWAP CHAOS SMOKE FAILED: the two models are not "
+                  "distinguishable — the torn-read check is vacuous")
+            return 1
+
+        # the chaos plan: kill one stage mid-swap, corrupt one staged
+        # artifact's bytes, latency on the others — all seeded
+        plan = faults.FaultPlan([
+            faults.FaultRule(point="serve.swap", kind="kill", at_hit=2),
+            faults.FaultRule(point="registry.load", kind="corrupt",
+                             at_hit=4),
+            faults.FaultRule(point="serve.swap", kind="latency",
+                             p=0.5, delay_ms=5.0),
+        ], seed=20260805)
+
+        stop = threading.Event()
+        bad = []
+        bad_lock = threading.Lock()
+
+        def client(t):
+            i = t
+            while not stop.is_set():
+                r = srv.submit("m", Xq[i % 32], timeout_s=10.0)
+                if not r.ok:
+                    with bad_lock:
+                        bad.append(("status", r.status))
+                else:
+                    s = np.asarray(r.scores)
+                    if s != refA[i % 32] and s != refB[i % 32]:
+                        with bad_lock:
+                            bad.append(("torn", i % 32, float(s)))
+                i += 1
+
+        threads = [threading.Thread(target=client, args=(t,))
+                   for t in range(4)]
+        killed = corrupted = ok_swaps = 0
+        with faults.active(plan):
+            for t in threads:
+                t.start()
+            for k in range(8):
+                target = pb if k % 2 == 0 else pa
+                try:
+                    srv.swap("m", target)
+                    ok_swaps += 1
+                except faults.SimulatedKill:
+                    killed += 1  # mid-stage death: nothing flipped
+                except ModelLoadError:
+                    corrupted += 1
+                    h = srv.health()
+                    if h["status"] != "degraded":
+                        failures.append(
+                            "healthz not degraded after a corrupt "
+                            f"staged artifact (got {h['status']})")
+                # old generation must still answer, bitwise
+                s, _ = srv.predict_direct("m", Xq)
+                if not (np.array_equal(s, refA)
+                        or np.array_equal(s, refB)):
+                    failures.append(
+                        f"scores after swap attempt {k} match neither "
+                        "generation")
+            stop.set()
+            for t in threads:
+                t.join(10.0)
+        if bad:
+            failures.append(f"client anomalies under chaos: {bad[:5]} "
+                            f"({len(bad)} total)")
+        if killed == 0:
+            failures.append("the kill rule never fired")
+        if corrupted == 0:
+            failures.append("the corrupt rule never produced a "
+                            "classified load failure")
+        # recovery: a clean swap clears the degraded flag
+        faults.deactivate()
+        srv.swap("m", pb)
+        h = srv.health()
+        if h["status"] != "ok":
+            failures.append(f"clean swap did not recover health: {h}")
+        gen = h["swap"]["m"]["generation"]
+    if failures:
+        for f in failures:
+            print(f"SWAP CHAOS SMOKE FAILED: {f}")
+        return 1
+    print(f"swap chaos smoke ok: {ok_swaps} swaps flipped, {killed} "
+          f"killed mid-stage, {corrupted} corrupt stages rolled back, "
+          f"0 torn/lost responses, final generation {gen}, health ok")
+    return 0
+
+
 def main(argv=None) -> int:
     argv = sys.argv[1:] if argv is None else argv
     if not argv or argv[0] in ("-h", "--help"):
@@ -140,6 +278,8 @@ def main(argv=None) -> int:
     cmd, rest = argv[0], argv[1:]
     if cmd == "kill-resume-smoke":
         return _kill_resume_smoke()
+    if cmd == "swap-chaos-smoke":
+        return _swap_chaos_smoke()
     if cmd == "validate":
         if len(rest) != 1:
             print("usage: python -m tpusvm.faults validate PLAN.json")

@@ -25,6 +25,17 @@ rejected at load time):
                               apply here (stream/format.py)
   ``serve.score``             _ModelWorker's batched scoring path
                               (serve/server.py)
+  ``serve.swap``              the hot-swap STAGE path — load, compile,
+                              probe-verify happen behind this point, so
+                              kill/latency rules die or stall a swap
+                              mid-stage while the old generation keeps
+                              serving (serve/server.py)
+  ``registry.load``           the model-artifact read feeding a load or
+                              a staged swap; carries the raw .npz byte
+                              payload, so ``corrupt`` rules apply here
+                              (serve/registry.py)
+  ``cache.read``              the persistent-compile-cache manifest
+                              read at serve startup (serve/cache.py)
   ``cascade.round``           the host-side cascade round loop
                               (parallel/cascade.py)
   ``solver.outer_checkpoint`` the solver-state checkpoint write
@@ -56,6 +67,9 @@ POINTS = frozenset({
     "stream.read_shard",
     "ingest.write_shard",
     "serve.score",
+    "serve.swap",
+    "registry.load",
+    "cache.read",
     "cascade.round",
     "solver.outer_checkpoint",
 })
@@ -313,7 +327,7 @@ def point(name: str, payload: Optional[bytes] = None, **attrs):
                 raise ValueError(
                     f"corrupt rule fired at {name!r}, which carries no "
                     "byte payload to corrupt (corrupt applies to "
-                    "ingest.write_shard)"
+                    "ingest.write_shard and registry.load)"
                 )
             buf = bytearray(payload)
             # seeded offset keeps the corruption reproducible; skip the

@@ -40,6 +40,7 @@ newer/tampered tpusvm), never a downstream shape or math error.
 from __future__ import annotations
 
 import dataclasses
+import os
 from typing import Any, Dict
 
 import numpy as np
@@ -50,22 +51,40 @@ _FORMAT_VERSION = 4
 _SUPPORTED_VERSIONS = (1, 2, 3, 4)
 
 
-def _norm(path: str) -> str:
+def _norm(path) -> str:
     # np.savez appends ".npz" to suffix-less paths; normalise so save/load
     # agree on the actual filename
     return path if path.endswith(".npz") else path + ".npz"
 
 
-def is_multiclass_model(path: str) -> bool:
+def _open_npz(path_or_file):
+    """np.load over a path OR a seekable file-like (rewound first).
+
+    The file-like form is the serving registry's staged-load path: the
+    artifact bytes are read once (through the ``registry.load`` fault
+    point, where corrupt rules can mangle them) and parsed from memory —
+    each np.load sniff/read pass rewinds the same buffer."""
+    if hasattr(path_or_file, "seek"):
+        path_or_file.seek(0)
+        return np.load(path_or_file, allow_pickle=False)
+    return np.load(_norm(path_or_file), allow_pickle=False)
+
+
+def _name_of(path_or_file) -> str:
+    return (_norm(path_or_file) if isinstance(path_or_file, str)
+            else getattr(path_or_file, "name", "<bytes>"))
+
+
+def is_multiclass_model(path) -> bool:
     """True if the saved model is a OneVsRestSVC state (carries the
     `classes` array; BinarySVC state has no such key). Reads only the zip
     directory — cheap enough to sniff before choosing which class to
     load."""
-    with np.load(_norm(path), allow_pickle=False) as z:
+    with _open_npz(path) as z:
         return "classes" in z.files
 
 
-def model_task(path: str) -> str:
+def model_task(path) -> str:
     """Artifact kind sniff: "ovr" | "svr" | "svc".
 
     Dispatch key for loaders (`tpusvm predict`, serve's from_path): OvR
@@ -73,7 +92,7 @@ def model_task(path: str) -> str:
     binary classifier (including every v1 file, which predates the
     marker).
     """
-    with np.load(_norm(path), allow_pickle=False) as z:
+    with _open_npz(path) as z:
         if "classes" in z.files:
             return "ovr"
         if "task" in z.files:
@@ -82,12 +101,22 @@ def model_task(path: str) -> str:
 
 
 def save_model(path: str, state: Dict[str, Any], config: SVMConfig) -> None:
+    """Atomically persist a model artifact (temp file + os.replace).
+
+    The house atomic-write discipline (stream shards, solver
+    checkpoints) applied to models: a process killed mid-save — e.g. a
+    `tpusvm refresh` dying while writing its output — leaves either the
+    previous complete artifact or none, never a truncated .npz that a
+    serve --watch loop would then try to stage."""
+    out = _norm(path)
+    tmp = out + ".tmp.npz"
     np.savez_compressed(
-        _norm(path),
+        tmp,
         format_version=_FORMAT_VERSION,
         **state,
         **{f"config_{k}": v for k, v in dataclasses.asdict(config).items()},
     )
+    os.replace(tmp, out)
 
 
 def load_model(path: str):
@@ -101,10 +130,10 @@ def load_model(path: str):
     the same treatment: a v2 file naming a family this build does not
     implement fails HERE, not as a dispatch error mid-request.
     """
-    with np.load(_norm(path), allow_pickle=False) as z:
+    with _open_npz(path) as z:
         if "format_version" not in z.files:
             raise ValueError(
-                f"{_norm(path)!r} has no format_version field — not a "
+                f"{_name_of(path)!r} has no format_version field — not a "
                 "tpusvm model artifact (or written before format "
                 "versioning; retrain and re-save it)"
             )
@@ -112,7 +141,7 @@ def load_model(path: str):
         if version not in _SUPPORTED_VERSIONS:
             raise ValueError(
                 f"unsupported model format version {version} in "
-                f"{_norm(path)!r}: this build reads versions "
+                f"{_name_of(path)!r}: this build reads versions "
                 f"{list(_SUPPORTED_VERSIONS)}"
             )
         cfg_fields = SVMConfig.__dataclass_fields__
@@ -141,7 +170,7 @@ def load_model(path: str):
     family = cfg_kwargs.get("kernel", "rbf")
     if family not in KERNEL_FAMILIES:
         raise ValueError(
-            f"{_norm(path)!r} names kernel family {family!r}, which this "
+            f"{_name_of(path)!r} names kernel family {family!r}, which this "
             f"build does not implement (supported: {list(KERNEL_FAMILIES)}"
             "); the artifact was written by a newer tpusvm or tampered with"
         )

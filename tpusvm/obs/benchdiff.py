@@ -151,6 +151,20 @@ SCHEMA_RULES: Dict[str, Tuple[Rule, ...]] = {
         Rule("updates", "<=", rel_tol=0.15),
         Rule("train_s", "<=", rel_tol=0.35, timing=True),
     ),
+    # resilient-serving round, the restart gate: rows pair on (bench,
+    # arm, n, d, smoke). warm_ok is the harness's own verdict (warm arm:
+    # persistent-cache misses == 0 — the ~zero-cold-start claim) and
+    # score_parity pins that a cache-served executable returns the same
+    # bytes; both exact. misses may only fall (the warm arm's committed 0
+    # then enforces staying 0), and the wall-clock columns are
+    # direction-gated at full level only
+    "cold_start": (
+        Rule("warm_ok", "=="),
+        Rule("score_parity", "=="),
+        Rule("misses", "<="),
+        Rule("first_prediction_s", "<=", rel_tol=0.5, timing=True),
+        Rule("warm_speedup", ">=", rel_tol=0.4, timing=True),
+    ),
     # round 9, the solver speed ladder: per-rung rows pair on (bench,
     # rung, n, d, q). Correctness metrics are exact — every rung must
     # keep the control's solution (sv_count/accuracy) byte-for-byte

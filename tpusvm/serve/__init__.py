@@ -16,8 +16,16 @@ top of the repo's own predict kernels):
                 AOT-compiled executables, warm-up API, recompile counter
   metrics.py    request/error/timeout counters, batch-occupancy histogram,
                 latency percentiles; JSON + plaintext /metrics dumps
-  server.py     the in-process frontend: Server.submit()/submit_many()
-  http.py       stdlib-only JSON-over-HTTP endpoint (`tpusvm serve`)
+  server.py     the in-process frontend: Server.submit()/submit_many(),
+                atomic hot-swap (Server.swap: staged generation flip)
+  http.py       stdlib-only JSON-over-HTTP endpoint (`tpusvm serve`),
+                POST /admin/swap
+  cache.py      restart robustness: jax persistent compilation cache +
+                bucket-signature manifest (~zero cold start) and the
+                serve_state.json registry manifest
+  watch.py      `serve --watch DIR`: poll for newer artifacts, hot-swap
+  refresh.py    `tpusvm refresh`: crash-safe warm-started refits that
+                hot-swap into the running registry
 
 Correctness contract: a served score is BIT-IDENTICAL to a direct
 decision_function call on the same rows — per-row scores are independent of
@@ -31,18 +39,20 @@ engineered out by bucket floors (buckets.py: binary pads lone requests to
 from tpusvm.serve.batcher import MicroBatcher, ServeResult
 from tpusvm.serve.buckets import CompileCache, bucket_for, default_buckets
 from tpusvm.serve.metrics import Metrics
-from tpusvm.serve.registry import ModelEntry, ModelRegistry
-from tpusvm.serve.server import ServeConfig, Server
+from tpusvm.serve.registry import ModelEntry, ModelLoadError, ModelRegistry
+from tpusvm.serve.server import ServeConfig, Server, SwapError
 
 __all__ = [
     "CompileCache",
     "Metrics",
     "MicroBatcher",
     "ModelEntry",
+    "ModelLoadError",
     "ModelRegistry",
     "ServeConfig",
     "ServeResult",
     "Server",
+    "SwapError",
     "bucket_for",
     "default_buckets",
 ]

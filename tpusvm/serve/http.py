@@ -15,6 +15,18 @@ Routes:
   POST /admin/drain               stop admitting requests, wait for
                                   in-flight work ({"drained": bool});
                                   the zero-downtime-restart hook
+  POST /admin/swap                {"name": ..., "path": model.npz}
+                                  atomic hot-swap: stage the artifact
+                                  fully off to the side (load, compile,
+                                  probe-verify), then flip the serving
+                                  generation — in-flight batches finish
+                                  on the old model. 200 {"swapped":
+                                  true, "generation": g, "latency_s"};
+                                  a failed stage rolls back (the old
+                                  generation keeps serving) and returns
+                                  409 {"swapped": false, "error": ...};
+                                  unknown model name -> 404. The
+                                  `tpusvm refresh` handoff endpoint.
   GET  /v1/models                 hosted-model summaries (Server.status())
   GET  /v1/models/<name>/metrics  one model's metrics JSON
   GET  /metrics                   plaintext metrics for every model
@@ -103,6 +115,33 @@ class _Handler(BaseHTTPRequestHandler):
         if self.path == "/admin/drain":
             ok = self._srv.drain()
             self._send_json({"drained": ok})
+            return
+        if self.path == "/admin/swap":
+            try:
+                length = int(self.headers.get("Content-Length", 0))
+                payload = json.loads(self.rfile.read(length) or b"{}")
+                name = payload["name"]
+                path = payload["path"]
+            except (ValueError, KeyError, TypeError) as e:
+                self._send_json(
+                    {"error": f"bad request body (need name+path): {e}"},
+                    code=400)
+                return
+            try:
+                out = self._srv.swap(name, path)
+            except KeyError as e:
+                self._send_json({"swapped": False, "error": str(e)},
+                                code=404)
+                return
+            except Exception as e:  # noqa: BLE001 — the stage rolled
+                # back; the old generation is still serving, so this is
+                # a conflict report, not a handler crash
+                self._send_json(
+                    {"swapped": False,
+                     "error": f"{type(e).__name__}: {e}"},
+                    code=409)
+                return
+            self._send_json({"swapped": True, **out})
             return
         if not (self.path.startswith("/v1/models/")
                 and self.path.endswith(":predict")):

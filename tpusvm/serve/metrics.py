@@ -45,6 +45,9 @@ _COUNTERS = (
     "retries",       # scoring attempts re-run by the retry policy
     "breaker_trips",       # closed -> open transitions
     "breaker_recoveries",  # half-open probe succeeded, breaker closed
+    # resilient-serving round (hot-swap):
+    "swaps",           # successful generation flips
+    "swap_failures",   # staged swaps that failed + rolled back
 )
 
 

@@ -10,13 +10,23 @@ steady-state request uploads only its own padded rows.
 Feature scaling stays on the host (numpy, per batch): it is O(m*d) on a
 few-row batch, and keeping it host-side makes the served scores use the
 exact scaler arithmetic of the offline path (bit-identity contract).
+
+Resilient-serving round: the registry is VERSIONED — every entry carries
+a generation counter that `swap()` bumps atomically under the registry
+lock, `get_versioned()` returns a consistent (entry, generation) pair,
+and artifact loads are classified: a missing/truncated/corrupted .npz
+raises :class:`ModelLoadError` (ServeStatus.LOAD_FAILED) naming the
+path, with transient I/O retried through faults.retry.DEFAULT_IO_POLICY
+and the raw bytes routed through the ``registry.load`` injection point
+(where chaos corrupt rules mangle them) before parsing.
 """
 
 from __future__ import annotations
 
 import dataclasses
+import io
 import threading
-from typing import Dict, Optional
+from typing import Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -24,6 +34,49 @@ import numpy as np
 
 from tpusvm.config import SVMConfig
 from tpusvm.data.scaler import MinMaxScaler
+from tpusvm.status import ServeStatus
+
+
+class ModelLoadError(Exception):
+    """A model artifact could not be loaded/staged (ServeStatus.LOAD_FAILED).
+
+    One named error for every way an artifact read goes bad — missing
+    file, truncated/corrupted zip, a non-model npz, transient I/O that
+    survived the retry budget — so `tpusvm serve`, POST /admin/swap and
+    the --watch loop report the offending path and cause instead of a
+    raw traceback, and a failed hot-swap stage rolls back cleanly."""
+
+    status = ServeStatus.LOAD_FAILED
+
+    def __init__(self, path: str, cause: BaseException):
+        self.path = path
+        self.cause = cause
+        super().__init__(
+            f"failed to load model artifact {path!r}: "
+            f"{type(cause).__name__}: {cause}"
+        )
+
+
+def _read_model_bytes(path: str) -> bytes:
+    """Artifact bytes through the retried ``registry.load`` fault point.
+
+    The read itself is retried under DEFAULT_IO_POLICY (an injected
+    transient or a real flaky disk behaves like the stream reader's
+    shard reads); the returned payload may have been corrupted by an
+    active corrupt rule — np.load's zip CRC then catches it downstream,
+    which is exactly the staged-swap failure path under test."""
+    from tpusvm import faults
+
+    def _read():
+        with open(path, "rb") as f:
+            raw = f.read()
+        # the point carries the bytes: transient/kill/latency rules act
+        # like any other I/O fault, corrupt rules mangle the payload
+        out = faults.point("registry.load", payload=raw, path=path)
+        return out if out is not None else raw
+
+    retry = faults.Retry(faults.DEFAULT_IO_POLICY, op="registry.load")
+    return retry(_read)
 
 
 @dataclasses.dataclass
@@ -52,6 +105,12 @@ class ModelEntry:
     # (tpusvm.approx) and feeds these pinned operands to every call
     fmap: Optional[object] = None
     map_params: Optional[tuple] = None
+    # hot-swap provenance: the registry bumps `generation` on every
+    # swap (1 = the initially loaded model); `source_path` is the .npz
+    # the entry came from (None for in-process add_model), recorded in
+    # serve_state.json so a restarted server reloads its full model set
+    generation: int = 1
+    source_path: Optional[str] = None
 
     @property
     def n_sv(self) -> int:
@@ -131,10 +190,33 @@ class ModelEntry:
 
     @classmethod
     def from_path(cls, name: str, path: str, dtype=jnp.float32) -> "ModelEntry":
-        """Load a serialized model (binary/OVR/SVR auto-detected), pin it."""
+        """Load a serialized model (binary/OVR/SVR auto-detected), pin it.
+
+        Hardened (ShardError discipline): the artifact bytes are read
+        with transient-I/O retries and parsed from memory — a corrupt or
+        truncated file, a non-model npz, or exhausted retries raise
+        :class:`ModelLoadError` naming the path, never a raw
+        BadZipFile/zlib traceback from deep inside numpy."""
+        import zlib
+        from zipfile import BadZipFile
+
+        from tpusvm import faults
         from tpusvm.models import load_any
 
-        return cls.from_estimator(name, load_any(path, dtype=dtype))
+        try:
+            raw = _read_model_bytes(path)
+            model = load_any(io.BytesIO(raw), dtype=dtype)
+        except faults.SimulatedKill:
+            raise  # a killed process does not get a classification
+        except (OSError, ValueError, KeyError, BadZipFile, zlib.error,
+                # zipfile raises NotImplementedError when corruption
+                # lands on a member's compression-type field
+                NotImplementedError,
+                faults.RetryExhaustedError) as e:
+            raise ModelLoadError(path, e) from e
+        entry = cls.from_estimator(name, model)
+        entry.source_path = path
+        return entry
 
     def validate_rows(self, X: np.ndarray) -> np.ndarray:
         # float64 on the host regardless of the model dtype: the scaler
@@ -158,6 +240,8 @@ class ModelEntry:
         d = {
             "name": self.name,
             "kind": self.kind,
+            "generation": self.generation,
+            "source_path": self.source_path,
             "n_sv": self.n_sv,
             "n_features": self.n_features,
             "kernel": self.config.kernel,
@@ -185,7 +269,15 @@ class ModelEntry:
 
 
 class ModelRegistry:
-    """Thread-safe name -> ModelEntry map."""
+    """Thread-safe, VERSIONED name -> ModelEntry map.
+
+    Every entry carries a generation counter: `add` installs generation
+    1 (or the entry's own, when a serve_state.json restore carries a
+    history forward), `swap` stamps old generation + 1 onto the
+    replacement and stores it in ONE lock region — a reader calling
+    `get_versioned` can never observe an entry whose `.generation` field
+    disagrees with the generation the registry reports for it (the
+    torn-read invariant the conc-stress `swap` suite perturbs)."""
 
     def __init__(self):
         self._lock = threading.Lock()
@@ -198,6 +290,21 @@ class ModelRegistry:
             self._entries[entry.name] = entry
         return entry
 
+    def swap(self, entry: ModelEntry) -> int:
+        """Replace the registered entry of the same name; returns the new
+        generation. The name must already be registered (a swap of an
+        unknown name is a caller bug, not an implicit add)."""
+        with self._lock:
+            old = self._entries.get(entry.name)
+            if old is None:
+                raise KeyError(
+                    f"cannot swap unknown model {entry.name!r}; "
+                    f"registered: {sorted(self._entries)}"
+                )
+            entry.generation = old.generation + 1
+            self._entries[entry.name] = entry
+            return entry.generation
+
     def load(self, name: str, path: str, dtype=jnp.float32) -> ModelEntry:
         return self.add(ModelEntry.from_path(name, path, dtype=dtype))
 
@@ -209,6 +316,21 @@ class ModelRegistry:
                 raise KeyError(
                     f"unknown model {name!r}; registered: {sorted(self._entries)}"
                 ) from None
+
+    def get_versioned(self, name: str) -> Tuple[ModelEntry, int]:
+        """(entry, generation) read in one lock region — the pair is
+        guaranteed consistent (entry.generation == generation)."""
+        with self._lock:
+            try:
+                e = self._entries[name]
+            except KeyError:
+                raise KeyError(
+                    f"unknown model {name!r}; registered: {sorted(self._entries)}"
+                ) from None
+            return e, e.generation
+
+    def generation(self, name: str) -> int:
+        return self.get_versioned(name)[1]
 
     def unload(self, name: str) -> None:
         with self._lock:

@@ -14,12 +14,26 @@ predict_direct() is the sequential one-request-at-a-time path — the same
 scoring arithmetic with no queue or coalescing. It exists as the benchmark
 baseline (benchmarks/serve_latency.py measures batched-vs-sequential
 throughput against it) and as the bit-identity oracle in tests.
+
+Resilient-serving round — atomic hot-swap: a worker's servable state is
+one immutable `_Generation` bundle (entry + compile cache + generation
+number), and `Server.swap()` stages its replacement FULLY off to the
+side — artifact load (retried, classified), device pinning, bucket
+AOT-compiles, a probe-vector verification — before flipping the
+worker's bundle reference under the server lock. A batch reads its
+bundle exactly once, so in-flight work finishes on the old generation
+and no request ever sees a torn entry/cache pair; a failed stage
+(corrupt .npz, compile error, probe mismatch, injected fault) changes
+NOTHING — the old generation keeps serving, the failure is recorded on
+/healthz (`degraded`) and the swap_failures counter. Breaker state,
+SLO windows and every metric survive the flip: only the bundle moves.
 """
 
 from __future__ import annotations
 
 import dataclasses
 import threading
+import time
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import jax.numpy as jnp
@@ -101,19 +115,62 @@ class ServeConfig:
         return max(1, int(self.shed_threshold * self.queue_size))
 
 
+class _Generation:
+    """One immutable servable bundle: the unit the hot-swap flips.
+
+    A scoring path reads the worker's `_gen` reference ONCE and uses
+    this bundle throughout — entry and compile cache can never be
+    observed from different generations (the torn-model hazard the
+    swap-under-load tests hammer). The reference store itself is a
+    single GIL-atomic pointer write performed under the server lock."""
+
+    __slots__ = ("entry", "cache", "generation", "loaded_t",
+                 "probe_scores")
+
+    def __init__(self, entry: ModelEntry, cache: CompileCache,
+                 generation: int, loaded_t: float, probe_scores=None):
+        self.entry = entry
+        self.cache = cache
+        self.generation = generation
+        self.loaded_t = loaded_t
+        self.probe_scores = probe_scores
+
+
+class SwapError(Exception):
+    """A hot-swap stage failed and was rolled back; the previous
+    generation keeps serving. Wraps the staging failure (load error,
+    compile failure, probe mismatch) with the model name."""
+
+    def __init__(self, name: str, cause: BaseException):
+        self.name = name
+        self.cause = cause
+        super().__init__(
+            f"swap of model {name!r} failed and was rolled back: "
+            f"{type(cause).__name__}: {cause}"
+        )
+
+
 class _ModelWorker:
-    """Entry + cache + metrics + batcher + breaker for one hosted model."""
+    """Metrics + batcher + breaker for one hosted model, serving the
+    current `_Generation` bundle (entry + compile cache)."""
 
     def __init__(self, entry: ModelEntry, config: ServeConfig,
                  clock=None):
         buckets = config.resolved_buckets()
-        self.entry = entry
+        self.config = config
+        self._clock = clock or time.monotonic
         self.metrics = Metrics(buckets, slo=config.resolved_slo(),
                                clock=clock)
         # the cache reports per-bucket compile time + cost analysis into
         # this worker's registry, so /metrics carries compile accounting
-        self.cache = CompileCache(entry, buckets, block=config.block,
-                                  registry=self.metrics.registry)
+        cache = CompileCache(entry, buckets, block=config.block,
+                             registry=self.metrics.registry)
+        self._gen = _Generation(entry, cache, entry.generation,
+                                self._clock())
+        # last swap attempt's outcome (None until the first swap):
+        # {"outcome": "ok"|"failed", "generation": int, "error": str?}
+        # tpusvm: guarded-by=single dict ref, swapped whole under the server lock
+        self._last_swap: Optional[dict] = None
         self.breaker = faults.CircuitBreaker(
             threshold=config.breaker_threshold,
             cooldown_s=config.breaker_cooldown_s,
@@ -142,6 +199,94 @@ class _ModelWorker:
             admission=(self._slo_admission if config.slo_shed else None),
         )
 
+    # ------------------------------------------------------- generations
+    @property
+    def entry(self) -> ModelEntry:
+        return self._gen.entry
+
+    @property
+    def cache(self) -> CompileCache:
+        return self._gen.cache
+
+    @property
+    def generation(self) -> int:
+        return self._gen.generation
+
+    def probe_rows(self, entry: Optional[ModelEntry] = None) -> np.ndarray:
+        """The pinned probe vector: deterministic rows every staged
+        generation must score before it may serve. Seeded per feature
+        width, so A->B->A swaps verify against the identical probe."""
+        e = entry if entry is not None else self._gen.entry
+        rng = np.random.default_rng(0xFEED ^ e.n_features)
+        return rng.random((2, e.n_features))
+
+    def stage(self, entry: ModelEntry) -> _Generation:
+        """Build a fully-warmed replacement bundle OFF TO THE SIDE.
+
+        Device-pins are already in `entry`; this AOT-compiles every
+        bucket executable (cold requests after the flip would otherwise
+        pay a compile) and verifies the staged executables against the
+        pinned probe vector — finite scores of the right shape, computed
+        through the real bucket path. Nothing the serving path reads is
+        touched; any failure here leaves the old generation serving."""
+        faults.point("serve.swap", model=entry.name)
+        cache = CompileCache(entry, self.config.resolved_buckets(),
+                             block=self.config.block,
+                             registry=self.metrics.registry)
+        cache.warmup()
+        probe = entry.validate_rows(self.probe_rows(entry))
+        # exactly the serving arithmetic (scale host-side, cast at the
+        # pad-buffer upload), so probe scores are the bundle's served
+        # scores for these rows, bitwise
+        with self._exec_lock:
+            scores, _ = cache.scores(entry.scale(probe))
+        want = ((probe.shape[0], len(entry.classes))
+                if entry.kind == "ovr" else (probe.shape[0],))
+        if scores.shape != want or not np.all(np.isfinite(scores)):
+            raise SwapError(entry.name, ValueError(
+                f"probe verification failed: scores shape {scores.shape} "
+                f"(want {want}), finite={bool(np.all(np.isfinite(scores)))}"
+            ))
+        # generation is stamped by the registry at flip time
+        return _Generation(entry, cache, entry.generation, self._clock(),
+                           probe_scores=scores)
+
+    def flip(self, gen: _Generation) -> None:
+        """Install a staged bundle — one reference store (the caller
+        holds the server lock; in-flight batches keep their old bundle)."""
+        self._gen = gen
+        self._last_swap = {"outcome": "ok", "generation": gen.generation}
+        self.metrics.inc("swaps")
+        reg = self.metrics.registry
+        reg.gauge("serve.generation").set(float(gen.generation))
+        reg.gauge("serve.last_swap_ok").set(1.0)
+
+    def record_swap_failure(self, error: BaseException) -> None:
+        g = self._gen
+        self._last_swap = {
+            "outcome": "failed",
+            "generation": g.generation,   # the generation STILL serving
+            "error": f"{type(error).__name__}: {error}",
+        }
+        self.metrics.inc("swap_failures")
+        self.metrics.registry.gauge("serve.last_swap_ok").set(0.0)
+
+    def swap_status(self) -> dict:
+        """Per-model swap/staleness view for health() and /metrics.
+
+        staleness_s = time since the serving generation was installed;
+        refreshed into the registry gauges at every scrape so `tpusvm
+        report` and merged snapshots carry the same numbers."""
+        g = self._gen
+        staleness = max(0.0, self._clock() - g.loaded_t)
+        reg = self.metrics.registry
+        reg.gauge("serve.generation").set(float(g.generation))
+        reg.gauge("serve.staleness_s").set(staleness)
+        out = {"generation": g.generation,
+               "staleness_s": staleness,
+               "last_swap": self._last_swap}
+        return out
+
     def _slo_admission(self) -> bool:
         """SLO-fed admission control (config.slo_shed): refuse new work
         while the latency budget burns. Error burn deliberately does NOT
@@ -163,19 +308,24 @@ class _ModelWorker:
         """(scores, labels, [(bucket, rows), ...]) for validated f64 rows.
 
         Batches larger than the top bucket (possible only via the direct
-        path — the batcher caps at max_batch) are chunked through it."""
-        e = self.entry
+        path — the batcher caps at max_batch) are chunked through it.
+
+        The generation bundle is read ONCE: a swap flipping mid-batch
+        changes nothing here — this batch finishes on the bundle it
+        started with (entry and cache always from the same generation)."""
+        g = self._gen
+        e = g.entry
         if X.shape[0] == 0:
             shape = (0, len(e.classes)) if e.kind == "ovr" else (0,)
             empty_labels = (np.zeros(0) if e.kind == "svr"
                             else np.zeros(0, np.int32))
             return np.zeros(shape), empty_labels, []
         Xs = e.scale(X)
-        top = self.cache.buckets[-1]
+        top = g.cache.buckets[-1]
         parts, chunks = [], []
         with self._exec_lock:
             for i in range(0, Xs.shape[0], top):
-                s, bucket = self.cache.scores(Xs[i:i + top])
+                s, bucket = g.cache.scores(Xs[i:i + top])
                 parts.append(s)
                 chunks.append((bucket, s.shape[0]))
         scores = np.concatenate(parts) if len(parts) > 1 else parts[0]
@@ -230,26 +380,150 @@ class Server:
         self.registry = ModelRegistry()
         self._workers: Dict[str, _ModelWorker] = {}
         self._lock = threading.Lock()
+        # serializes whole swap operations (stage + flip): staging is
+        # slow (compiles), so it must not hold the server lock, but two
+        # concurrent swaps of one model must not interleave their
+        # stage/flip pairs (the second would flip over the first)
+        self._swap_lock = threading.Lock()
         self._closed = False
         self._draining = False
         self._httpd = None
         self._http_thread = None
+        self._state_path: Optional[str] = None
+        self._cache_dir: Optional[str] = None
 
     # ----------------------------------------------------------- hosting
     def _install(self, entry: ModelEntry) -> ModelEntry:
         self.registry.add(entry)
         with self._lock:
             self._workers[entry.name] = _ModelWorker(entry, self.config)
+        self._persist_state()
         return entry
 
     def load_model(self, name: str, path: str) -> ModelEntry:
-        """Load a serialized .npz model (binary/OVR auto-detected)."""
+        """Load a serialized .npz model (binary/OVR auto-detected).
+
+        A missing/corrupt/transiently-unreadable artifact raises the
+        classified serve.ModelLoadError naming the path (transient I/O
+        is retried first — tpusvm.faults.retry.DEFAULT_IO_POLICY)."""
         return self._install(ModelEntry.from_path(name, path,
                                                   dtype=self.dtype))
 
     def add_model(self, name: str, model) -> ModelEntry:
         """Host an already-fitted BinarySVC / OneVsRestSVC."""
         return self._install(ModelEntry.from_estimator(name, model))
+
+    # --------------------------------------------------------- hot-swap
+    def swap(self, name: str, model_or_path) -> dict:
+        """Atomically replace a hosted model with a new generation.
+
+        `model_or_path`: a serialized .npz path (the `tune`/`refresh`
+        winner handoff) or an already-fitted estimator. The replacement
+        is staged fully off to the side — load + device-pin +
+        bucket-compile + probe-verify — and only then does the worker's
+        generation bundle flip, under the server lock, together with
+        the registry entry. In-flight batches finish on the old
+        generation; breaker state, SLO windows and metrics carry over.
+
+        On ANY staging failure the old model keeps serving: the failure
+        is recorded (healthz degrades, swap_failures increments) and
+        re-raised for the caller. A SimulatedKill propagates unrecorded
+        — a killed process records nothing, and the restarted server
+        reloads the old generation from serve_state.json.
+
+        Returns {"name", "generation", "latency_s", "staleness_before_s"}.
+        """
+        w = self._worker(name)
+        t0 = time.perf_counter()
+        with self._swap_lock:
+            old = w._gen
+            try:
+                if isinstance(model_or_path, str):
+                    entry = ModelEntry.from_path(name, model_or_path,
+                                                 dtype=self.dtype)
+                elif isinstance(model_or_path, ModelEntry):
+                    entry = model_or_path
+                else:
+                    entry = ModelEntry.from_estimator(name, model_or_path)
+                gen = w.stage(entry)
+            except faults.SimulatedKill:
+                raise
+            except BaseException as e:  # noqa: BLE001 — every staging
+                # failure must roll back AND be visible on healthz
+                w.record_swap_failure(e)
+                faults.emit("serve.swap_failed", model=name,
+                            error=f"{type(e).__name__}: {e}",
+                            generation=old.generation)
+                raise
+            staleness_before = max(0.0, w._clock() - old.loaded_t)
+            with self._lock:
+                gen.generation = self.registry.swap(entry)
+                w.flip(gen)
+        latency = time.perf_counter() - t0
+        w.metrics.registry.gauge("serve.swap_latency_s").set_max(latency)
+        faults.emit("serve.swapped", model=name,
+                    generation=gen.generation, latency_s=latency,
+                    staleness_before_s=staleness_before)
+        self._persist_state()
+        return {"name": name, "generation": gen.generation,
+                "latency_s": latency,
+                "staleness_before_s": staleness_before}
+
+    # ------------------------------------------------- restart robustness
+    def configure_cache(self, cache_dir: str) -> dict:
+        """Point jax's persistent compilation cache at `cache_dir` (see
+        serve/cache.py) so bucket compiles persist across restarts;
+        returns the signature manifest found there. warmup() then
+        records every built signature back into the manifest."""
+        from tpusvm.serve import cache as _cache
+
+        manifest = _cache.configure_persistent_cache(cache_dir)
+        self._cache_dir = cache_dir
+        return manifest
+
+    def enable_state(self, path: str) -> None:
+        """Persist the registry manifest (model paths + generations) to
+        `path` after every successful load/swap — the restart story."""
+        self._state_path = path
+        self._persist_state()
+
+    def _persist_state(self) -> None:
+        if self._state_path is None:
+            return
+        from tpusvm.serve.cache import save_serve_state
+
+        models = {}
+        for n in self.registry.names():
+            e, gen = self.registry.get_versioned(n)
+            models[n] = {"path": e.source_path, "generation": gen}
+        save_serve_state(self._state_path, models,
+                         cache_dir=self._cache_dir)
+
+    def restore_state(self, path: str) -> dict:
+        """Reload the model set recorded in a serve_state.json: every
+        path-backed model is loaded and its generation counter restored
+        (so staleness/generation history survives the restart). Models
+        recorded without a source path (in-process add_model) cannot be
+        restored and are reported in the returned dict's "skipped"."""
+        from tpusvm.serve.cache import load_serve_state
+
+        state = load_serve_state(path)
+        restored, skipped = [], []
+        for name, info in sorted(state["models"].items()):
+            if name in self.registry:
+                continue
+            if not info.get("path"):
+                skipped.append(name)
+                continue
+            entry = ModelEntry.from_path(name, info["path"],
+                                         dtype=self.dtype)
+            entry.generation = int(info.get("generation", 1))
+            self._install(entry)
+            restored.append(name)
+        if state.get("cache_dir") and self._cache_dir is None:
+            self.configure_cache(state["cache_dir"])
+        return {"restored": restored, "skipped": skipped,
+                "cache_dir": state.get("cache_dir")}
 
     def _worker(self, name: str) -> _ModelWorker:
         with self._lock:
@@ -261,9 +535,24 @@ class Server:
                 ) from None
 
     def warmup(self, name: Optional[str] = None) -> Dict[str, int]:
-        """AOT-compile every bucket executable; {model: compiles done}."""
+        """AOT-compile every bucket executable; {model: compiles done}.
+
+        With a persistent cache configured, every built signature is
+        recorded into the cache dir's manifest — the provenance record
+        of exactly which executables a warm restart expects to find."""
         names = [name] if name is not None else self.registry.names()
-        return {n: self._worker(n).cache.warmup() for n in names}
+        out = {n: self._worker(n).cache.warmup() for n in names}
+        if self._cache_dir is not None:
+            from tpusvm.serve.cache import bucket_signature, record_signatures
+
+            sigs = []
+            for n in names:
+                w = self._worker(n)
+                g = w._gen
+                sigs.extend(bucket_signature(g.entry, b, g.cache.block)
+                            for b in g.cache.buckets)
+            record_signatures(self._cache_dir, sigs)
+        return out
 
     # ----------------------------------------------------------- serving
     def submit(self, name: str, x: np.ndarray,
@@ -318,19 +607,23 @@ class Server:
         models = {}
         for n in self.registry.names():
             w = self._worker(n)
+            g = w._gen  # one bundle: entry/cache stats stay consistent
             models[n] = {
-                **w.entry.describe(),
-                "buckets": list(w.cache.buckets),
-                "compiled_shapes": w.cache.compiled_shapes,
-                "compiles": w.cache.compiles,
-                "recompiles": w.cache.recompiles,
-                "warmed": w.cache.warmed,
+                **g.entry.describe(),
+                **w.swap_status(),
+                "buckets": list(g.cache.buckets),
+                "compiled_shapes": g.cache.compiled_shapes,
+                "compiles": g.cache.compiles,
+                "recompiles": g.cache.recompiles,
+                "warmed": g.cache.warmed,
                 "queue_depth": w.batcher.depth,
                 "breaker": w.breaker.describe(),
             }
         return {
             "models": models,
             "draining": self._draining,
+            "state_path": self._state_path,
+            "cache_dir": self._cache_dir,
             "config": dataclasses.asdict(self.config),
         }
 
@@ -338,22 +631,34 @@ class Server:
         """The /healthz payload: overall status + per-model breaker state.
 
         "ok" only when the server is accepting work; "draining" after
-        drain(); a model with an open breaker OR a burning SLO budget
+        drain(); a model with an open breaker, a burning SLO budget OR
+        a failed last swap (the staged replacement rolled back — the
+        old generation is serving, but the operator should know)
         degrades the report to "degraded" without failing the whole
-        health check (the other models still serve)."""
+        health check (the other models still serve). Per-model swap
+        history — generation, staleness_s, last_swap outcome — rides in
+        the "swap" key and the serve.generation / serve.staleness_s /
+        serve.last_swap_ok gauges."""
         with self._lock:
             workers = dict(self._workers)
         breakers = {n: w.breaker.state for n, w in workers.items()}
+        swap = {n: w.swap_status() for n, w in workers.items()}
+        failed_swaps = [
+            n for n, st in swap.items()
+            if st["last_swap"] is not None
+            and st["last_swap"]["outcome"] == "failed"
+        ]
         slo = {n: st for n, w in workers.items()
                if (st := w.metrics.slo_status()) is not None}
         burning = [n for n, st in slo.items() if st["burning"]]
         if self._draining or self._closed:
             status = "draining"
-        elif any(s != "closed" for s in breakers.values()) or burning:
+        elif any(s != "closed" for s in breakers.values()) or burning \
+                or failed_swaps:
             status = "degraded"
         else:
             status = "ok"
-        out = {"status": status, "models": breakers}
+        out = {"status": status, "models": breakers, "swap": swap}
         if slo:
             out["slo"] = {
                 n: {"latency_burn": st["latency_burn"],

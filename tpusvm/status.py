@@ -57,6 +57,13 @@ class ServeStatus(enum.IntEnum):
                   recovers the model (tpusvm.faults.breaker)
       DRAINING    the server is draining (Server.drain()): in-flight
                   requests complete, new ones are refused
+      LOAD_FAILED a model artifact could not be loaded/staged (missing,
+                  truncated or corrupted .npz, or transient I/O that
+                  survived the retry budget) — carried by
+                  serve.ModelLoadError so `tpusvm serve`, /admin/swap
+                  and the --watch loop report the offending path
+                  instead of a raw traceback; a failed hot-swap stage
+                  rolls back and the previous generation keeps serving
     """
 
     OK = 0
@@ -67,6 +74,7 @@ class ServeStatus(enum.IntEnum):
     OVERLOADED = 5
     UNAVAILABLE = 6
     DRAINING = 7
+    LOAD_FAILED = 8
 
 
 class StreamStatus(enum.IntEnum):

@@ -60,6 +60,33 @@ def feasible_seed(alpha: np.ndarray, Y: np.ndarray, C: float) -> np.ndarray:
     return a
 
 
+def deployed_seed(sv_ids: np.ndarray, sv_alpha: np.ndarray, n_rows: int,
+                  Y: np.ndarray, C: float) -> np.ndarray:
+    """Full-length alpha0 for a refresh fit, from a DEPLOYED model's SV set.
+
+    The online-learning warm start (`tpusvm refresh`): the deployed
+    artifact stores only its support vectors' (sv_ids, sv_alpha); the
+    refresh training set must keep the deployed run's rows as a PREFIX
+    (new data appends — the stream.ShardWriter tail-shard contract), so
+    the donor solution scatters back to full length at its original row
+    positions, new rows start at alpha=0 exactly as cold SMO would
+    start them (the pad_alpha0 semantics, by construction), and the
+    result is projected feasible for the refresh problem's labels/box
+    (feasible_seed — the scaler refit may have moved the geometry, but
+    a feasible seed is a valid seed regardless).
+    """
+    ids = np.asarray(sv_ids, np.int64)
+    if ids.size and int(ids.max()) >= n_rows:
+        raise ValueError(
+            f"deployed model's SV ids reach row {int(ids.max())} but the "
+            f"refresh training set has only {n_rows} rows — refresh "
+            "requires the deployed run's rows as a prefix of the new data"
+        )
+    a = np.zeros(n_rows, np.float64)
+    a[ids] = np.asarray(sv_alpha, np.float64)
+    return feasible_seed(a, Y, C)
+
+
 class WarmStore:
     """Per-fold memory of solved points' alphas, queried by log-space
     nearest neighbour.
