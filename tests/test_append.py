@@ -1,0 +1,261 @@
+"""Crash-safe tail-shard append ingest (tpusvm/stream/append.py).
+
+The contract under test: ShardWriter.open_append grows a committed
+dataset BIT-IDENTICALLY to a one-shot ingest of the concatenated data
+(shard layout, per-shard stats, manifest JSON — including the merged
+feature min/max, the reopen close() bug), with exactly-once semantics
+under a kill at EVERY journal/shard/commit transition, and divergent
+replays rejected rather than silently applied.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from tpusvm import faults
+from tpusvm.data import rings
+from tpusvm.status import StreamStatus
+from tpusvm.stream import (
+    AppendError,
+    ShardWriter,
+    ingest_arrays,
+    open_dataset,
+)
+
+X, Y = rings(n=300, seed=11)
+
+BATCHES = [(s, min(300, s + 40)) for s in range(150, 300, 40)]
+
+
+def _one_shot(tmp_path, name="ref"):
+    return ingest_arrays(str(tmp_path / name), X, Y, rows_per_shard=64)
+
+
+def _prefix(tmp_path, name):
+    out = str(tmp_path / name)
+    ingest_arrays(out, X[:150], Y[:150], rows_per_shard=64)
+    return out
+
+
+def _append_session(out, resume=False):
+    w = ShardWriter.open_append(out, resume=resume)
+    for a, b in BATCHES:
+        w.append(X[a:b], Y[a:b])
+    return w.close()
+
+
+def _manifest_json(m):
+    return json.dumps(m.to_json(), sort_keys=True)
+
+
+# ------------------------------------------------- one-shot bit-parity
+def test_append_matches_one_shot_ingest_bitwise(tmp_path):
+    """The headline parity claim, which subsumes the reopen-close()
+    min/max merge bugfix: the manifest JSON (per-shard stats, checksums,
+    global min/max via merged stats) is byte-equal to a one-shot ingest
+    of the concatenation — a tail-only stats refit could not pass."""
+    ref = _one_shot(tmp_path)
+    out = _prefix(tmp_path, "grown")
+    m = _append_session(out)
+    assert _manifest_json(m) == _manifest_json(ref)
+    ds = open_dataset(out)
+    assert all(s == StreamStatus.OK for s in ds.validate())
+    Xr, Yr = ds.load_arrays()
+    assert np.array_equal(Xr, X) and np.array_equal(Yr, Y)
+    # the merged scaler == a full-array fit (the min/max merge pin)
+    st = ds.stats()
+    assert np.array_equal(st.min_val, X.min(axis=0))
+    assert np.array_equal(st.max_val, X.max(axis=0))
+
+
+def test_append_preserves_prefix_row_order(tmp_path):
+    """The prefix-extension contract refresh/assign enforce by name:
+    the original dataset's global row order is a strict prefix of the
+    grown dataset's."""
+    out = _prefix(tmp_path, "g2")
+    before = open_dataset(out).load_arrays()
+    _append_session(out)
+    after = open_dataset(out).load_arrays()
+    n0 = len(before[0])
+    assert np.array_equal(after[0][:n0], before[0])
+    assert np.array_equal(after[1][:n0], before[1])
+
+
+def test_append_full_shard_tail_and_default_rows_per_shard(tmp_path):
+    """A dataset whose last shard is exactly full appends without
+    touching any existing file (no tail adoption)."""
+    ref = ingest_arrays(str(tmp_path / "r"), X[:256], Y[:256],
+                        rows_per_shard=64)
+    out = str(tmp_path / "g")
+    ingest_arrays(out, X[:192], Y[:192], rows_per_shard=64)
+    w = ShardWriter.open_append(out)   # rows_per_shard derived: 64
+    w.append(X[192:256], Y[192:256])
+    m = w.close()
+    assert _manifest_json(m) == _manifest_json(ref)
+
+
+def test_open_append_validation(tmp_path):
+    with pytest.raises(FileNotFoundError, match="not a committed"):
+        ShardWriter.open_append(str(tmp_path / "nope"))
+    out = _prefix(tmp_path, "v")
+    with pytest.raises(AppendError, match="rows_per_shard"):
+        ShardWriter.open_append(out, rows_per_shard=32)
+    w = ShardWriter.open_append(out)
+    with pytest.raises(ValueError, match="feature count"):
+        w.append(np.zeros((4, 5)), np.ones(4, np.int32))
+
+
+def test_append_empty_session_is_a_noop(tmp_path):
+    out = _prefix(tmp_path, "noop")
+    before = _manifest_json(open_dataset(out).manifest)
+    w = ShardWriter.open_append(out)
+    m = w.close()
+    assert _manifest_json(m) == before
+    assert all(s == StreamStatus.OK
+               for s in open_dataset(out).validate())
+
+
+# --------------------------------------------- exactly-once under kill
+def _count_hits(tmp_path, point):
+    out = _prefix(tmp_path, f"count_{point.replace('.', '_')}")
+    plan = faults.FaultPlan([], seed=0)
+    with faults.active(plan):
+        _append_session(out)
+    return plan.hits(point)
+
+
+@pytest.mark.parametrize("point", ["stream.append", "ingest.write_shard"])
+def test_append_kill_at_every_journal_transition(tmp_path, point):
+    """Mirror of test_faults' kill-resume pattern, over EVERY hit of
+    the append session's injection points (journal writes, the commit's
+    rename and journal-delete transitions, every staged shard write):
+    kill there, resume with the replayed batch stream, and the result
+    is row-set AND checksum identical to the one-shot reference, with
+    the journal gone."""
+    ref_j = _manifest_json(_one_shot(tmp_path))
+    hits = _count_hits(tmp_path, point)
+    assert hits >= 3, f"{point} fired only {hits} times — vacuous sweep"
+    for k in range(1, hits + 1):
+        out = _prefix(tmp_path, f"k_{point.replace('.', '_')}_{k}")
+        plan = faults.FaultPlan(
+            [faults.FaultRule(point=point, kind="kill", at_hit=k)],
+            seed=0)
+        with pytest.raises(faults.SimulatedKill):
+            with faults.active(plan):
+                _append_session(out)
+        m = _append_session(out, resume=True)
+        assert _manifest_json(m) == ref_j, f"{point} kill at hit {k}"
+        ds = open_dataset(out)
+        assert all(s == StreamStatus.OK for s in ds.validate())
+        assert not os.path.exists(os.path.join(out,
+                                               "ingest.journal.json"))
+
+
+def test_append_transient_journal_writes_are_retried(tmp_path):
+    ref_j = _manifest_json(_one_shot(tmp_path))
+    out = _prefix(tmp_path, "tr")
+    plan = faults.FaultPlan(
+        [faults.FaultRule(point="stream.append", kind="transient",
+                          max_hits=2)], seed=0)
+    with faults.active(plan):
+        m = _append_session(out)
+    assert _manifest_json(m) == ref_j
+
+
+def test_append_divergent_replay_rejected(tmp_path):
+    """The duplicate/divergent-append guard: a resumed session replaying
+    a batch whose content CRC differs from the journal ledger is an
+    AppendError, never silent corruption."""
+    out = _prefix(tmp_path, "div")
+    plan = faults.FaultPlan(
+        [faults.FaultRule(point="stream.append", kind="kill", at_hit=2)],
+        seed=0)
+    with pytest.raises(faults.SimulatedKill):
+        with faults.active(plan):
+            _append_session(out)
+    w = ShardWriter.open_append(out, resume=True)
+    a, b = BATCHES[0]
+    with pytest.raises(AppendError, match="divergent"):
+        w.append(X[a:b] + 1.0, Y[a:b])
+
+
+def test_append_resume_without_journal_is_fresh(tmp_path):
+    """No journal = nothing to resume (the house resume semantics): the
+    session starts fresh and appends normally."""
+    ref_j = _manifest_json(_one_shot(tmp_path))
+    out = _prefix(tmp_path, "fresh")
+    m = _append_session(out, resume=True)
+    assert _manifest_json(m) == ref_j
+
+
+def test_append_second_session_without_resume_refuses_journal(tmp_path):
+    out = _prefix(tmp_path, "ref2")
+    # kill at the SECOND journal transition, so the first journal write
+    # is durable and the directory is visibly a crash site
+    plan = faults.FaultPlan(
+        [faults.FaultRule(point="stream.append", kind="kill", at_hit=2)],
+        seed=0)
+    with pytest.raises(faults.SimulatedKill):
+        with faults.active(plan):
+            _append_session(out)
+    with pytest.raises(AppendError, match="resume=True"):
+        ShardWriter.open_append(out)
+
+
+def test_append_corrupt_staged_shard_detected_on_resume(tmp_path):
+    """A corrupt rule mangling a staged shard's bytes is caught by the
+    journal's checksum verification at resume, naming the shard."""
+    from tpusvm.stream import ShardError
+
+    out = _prefix(tmp_path, "cor")
+    plan = faults.FaultPlan([
+        faults.FaultRule(point="ingest.write_shard", kind="corrupt",
+                         at_hit=1),
+        faults.FaultRule(point="stream.append", kind="kill", at_hit=2),
+    ], seed=9)
+    with pytest.raises(faults.SimulatedKill):
+        with faults.active(plan):
+            _append_session(out)
+    with pytest.raises(ShardError):
+        ShardWriter.open_append(out, resume=True)
+
+
+def test_append_v1_journal_is_refused(tmp_path):
+    """A v1 (fresh-ingest) journal in the directory belongs to
+    `tpusvm ingest --resume`, not to an append session."""
+    from tpusvm.stream import ingest_blocks
+
+    out = str(tmp_path / "v1")
+    plan = faults.FaultPlan(
+        [faults.FaultRule(point="ingest.write_shard", kind="kill",
+                          at_hit=3)], seed=0)
+    with pytest.raises(faults.SimulatedKill):
+        with faults.active(plan):
+            ingest_blocks(out, [(X, Y)], rows_per_shard=64)
+    # no manifest yet (fresh ingest died) -> open_append refuses already
+    with pytest.raises(FileNotFoundError):
+        ShardWriter.open_append(out, resume=True)
+
+
+def test_append_feeds_refresh_prefix_contract(tmp_path):
+    """The closed loop's data half: a model deployed on the prefix
+    warm-refreshes on the append-grown dataset (deployed_seed's prefix
+    check passes because append IS a prefix extension)."""
+    import jax.numpy as jnp
+
+    from tpusvm.config import SVMConfig
+    from tpusvm.models import BinarySVC
+    from tpusvm.serve.refresh import refresh_fit
+
+    out = _prefix(tmp_path, "loop")
+    deployed = str(tmp_path / "dep.npz")
+    BinarySVC(SVMConfig(C=10.0, gamma=10.0),
+              dtype=jnp.float32).fit(X[:150], Y[:150]).save(deployed)
+    _append_session(out)
+    Xg, Yg = open_dataset(out).load_arrays()
+    model = refresh_fit(deployed, Xg, Yg,
+                        out_path=str(tmp_path / "re.npz"))
+    assert model.status_.name == "CONVERGED"
+    assert model.score(X, Y) > 0.8

@@ -1,0 +1,534 @@
+"""Closed-loop online learning: drift detectors, crash-safe supervisor
+state, the autopilot tick loop (breaker/hysteresis/cooldown/watchdog),
+and the OvR/SVR refresh satellites (tpusvm/autopilot/, serve/refresh.py,
+tune/warm.py)."""
+
+import dataclasses
+import json
+import os
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpusvm import faults
+from tpusvm.autopilot import (
+    Autopilot,
+    AutopilotConfig,
+    AutopilotState,
+    DriftThresholds,
+    evaluate,
+    load_state,
+    save_state,
+)
+from tpusvm.autopilot.drift import feature_drift, score_shift
+from tpusvm.config import SVMConfig
+from tpusvm.data import rings
+from tpusvm.models import BinarySVC
+from tpusvm.serve import ServeConfig, Server
+from tpusvm.status import AutopilotStatus
+from tpusvm.stream import ShardWriter, ingest_arrays, open_dataset
+
+X, Y = rings(n=400, seed=11)
+CFG = SVMConfig(C=10.0, gamma=10.0)
+
+
+def _deploy(tmp_path, n=240):
+    """Dataset dir over the prefix + a deployed artifact trained on it."""
+    data = str(tmp_path / "data")
+    ingest_arrays(data, X[:n], Y[:n], rows_per_shard=64)
+    deployed = str(tmp_path / "deployed.npz")
+    BinarySVC(CFG, dtype=jnp.float32).fit(X[:n], Y[:n]).save(deployed)
+    return data, deployed
+
+
+def _grow(data, start=240, end=400, step=40):
+    w = ShardWriter.open_append(data)
+    for s in range(start, end, step):
+        w.append(X[s:s + step], Y[s:s + step])
+    w.close()
+
+
+def _config(tmp_path, data, deployed, **kw):
+    base = dict(
+        data_dir=data, model_path=deployed,
+        out_path=str(tmp_path / "m.refresh.npz"), name="m",
+        thresholds=DriftThresholds(growth=0.5, feature=None,
+                                   score=None, jitter_frac=0.0),
+        hysteresis=1, cooldown_s=0.0, seed=3,
+    )
+    base.update(kw)
+    return AutopilotConfig(**base)
+
+
+# --------------------------------------------------------------- drift
+def test_drift_report_byte_reproducible_by_seed(tmp_path):
+    data, _ = _deploy(tmp_path)
+    ds = open_dataset(data)
+    kw = dict(manifest=ds.manifest, fitted_min=X.min(0),
+              fitted_max=X.max(0), rows_at_refresh=160,
+              since_refresh_s=12.5, score_baseline={"pos": 50, "neg": 50},
+              score_current={"pos": 80, "neg": 120},
+              thresholds=DriftThresholds(jitter_frac=0.2), seed=9, tick=4)
+    a = evaluate(**kw).to_json_bytes()
+    b = evaluate(**kw).to_json_bytes()
+    assert a == b
+    # a different seed jitters the thresholds differently
+    c = evaluate(**{**kw, "seed": 10}).to_json_bytes()
+    assert c != a
+    # the report is schema-versioned JSON
+    obj = json.loads(a)
+    assert obj["schema_version"] == 1 and obj["seed"] == 9
+
+
+def test_feature_drift_math(tmp_path):
+    data, _ = _deploy(tmp_path)
+    ds = open_dataset(data)
+    # fitted range = the full data's range: nothing escapes
+    fd = feature_drift(ds.manifest, X.min(0), X.max(0), 0)
+    assert fd["score"] == 0.0 and fd["appended_rows"] == ds.n_rows
+    # shrink the fitted max by half the range: escapes are relative
+    lo, hi = X.min(0), X.max(0)
+    mid = lo + 0.5 * (hi - lo)
+    fd = feature_drift(ds.manifest, lo, mid, 0)
+    assert fd["score"] > 0.9 and fd["frac_escaped"] == 1.0
+    # no appended shards -> exact zero, no bytes read
+    fd = feature_drift(ds.manifest, lo, hi, ds.n_rows)
+    assert fd == {"score": 0.0, "frac_escaped": 0.0, "appended_rows": 0}
+
+
+def test_score_shift_windows_delta_counts():
+    base = {"pos": 60, "neg": 40}
+    # post-baseline traffic flipped to 20% positive: shift = 0.4
+    cur = {"pos": 60 + 20, "neg": 40 + 80}
+    ss = score_shift(base, cur)
+    assert ss["window"] == 100
+    assert ss["rate_base"] == pytest.approx(0.6)
+    assert ss["rate_now"] == pytest.approx(0.2)
+    assert ss["score"] == pytest.approx(0.4)
+    # no post-baseline traffic: no shift claimed
+    assert score_shift(base, base)["score"] == 0.0
+
+
+def test_staleness_and_min_new_rows_gating(tmp_path):
+    data, _ = _deploy(tmp_path)
+    ds = open_dataset(data)
+    thr = DriftThresholds(feature=None, growth=None, score=None,
+                          staleness_s=100.0, min_new_rows=10)
+    r = evaluate(manifest=ds.manifest, fitted_min=None, fitted_max=None,
+                 rows_at_refresh=ds.n_rows, since_refresh_s=50.0,
+                 score_baseline=None, score_current=None,
+                 thresholds=thr, seed=0, tick=1)
+    assert not r.decision
+    # staleness may trigger WITHOUT new rows (its whole point)
+    r = evaluate(manifest=ds.manifest, fitted_min=None, fitted_max=None,
+                 rows_at_refresh=ds.n_rows, since_refresh_s=150.0,
+                 score_baseline=None, score_current=None,
+                 thresholds=thr, seed=0, tick=1)
+    assert r.decision and "staleness" in r.reason
+    # growth triggers are suppressed below min_new_rows
+    thr2 = DriftThresholds(feature=None, growth=0.0001, score=None,
+                           min_new_rows=10 ** 6)
+    r = evaluate(manifest=ds.manifest, fitted_min=None, fitted_max=None,
+                 rows_at_refresh=1, since_refresh_s=0.0,
+                 score_baseline=None, score_current=None,
+                 thresholds=thr2, seed=0, tick=1)
+    assert not r.decision and "min_new_rows" in r.reason
+
+
+# --------------------------------------------------------------- state
+def test_state_roundtrip_crc_and_version_gates(tmp_path):
+    p = str(tmp_path / "s.json")
+    st = AutopilotState(seed=7, tick=3, rows_at_refresh=240,
+                        stage="fitting", stage_rows=400,
+                        model_path="m.npz",
+                        score_baseline={"pos": 1, "neg": 2},
+                        breaker={"state": "closed", "consecutive": 0,
+                                 "opened_at": 0.0})
+    save_state(p, st)
+    back = load_state(p)
+    assert back == st
+    # CRC catches a torn/hand-edited file
+    obj = json.load(open(p))
+    obj["rows_at_refresh"] = 9999
+    json.dump(obj, open(p, "w"))
+    with pytest.raises(ValueError, match="CRC"):
+        load_state(p)
+    # version gate names the problem
+    obj = {"state_version": 99}
+    json.dump(obj, open(p, "w"))
+    with pytest.raises(ValueError, match="version"):
+        load_state(p)
+    json.dump({"x": 1}, open(p, "w"))
+    with pytest.raises(ValueError, match="state_version"):
+        load_state(p)
+
+
+def test_breaker_snapshot_restore_replays_cooldown():
+    clock = [100.0]
+    b = faults.CircuitBreaker(threshold=2, cooldown_s=50.0,
+                              clock=lambda: clock[0])
+    b.record_failure()
+    b.record_failure()
+    assert b.state == "open"
+    snap = b.snapshot()
+    b2 = faults.CircuitBreaker(threshold=2, cooldown_s=50.0,
+                               clock=lambda: clock[0])
+    b2.restore(snap)
+    assert b2.state == "open" and not b2.allow()
+    clock[0] = 151.0
+    assert b2.state == "half_open" and b2.allow()
+    with pytest.raises(ValueError, match="breaker state"):
+        b2.restore({"state": "bogus", "consecutive": 0, "opened_at": 0})
+
+
+# ----------------------------------------------------------- tick loop
+def test_tick_hysteresis_cooldown_and_refresh(tmp_path):
+    data, deployed = _deploy(tmp_path)
+    clock = [1000.0]
+    with Server(ServeConfig(max_batch=8), dtype=jnp.float32) as srv:
+        srv.load_model("m", deployed)
+        srv.warmup()
+        pilot = Autopilot(
+            _config(tmp_path, data, deployed, hysteresis=2,
+                    cooldown_s=30.0),
+            server=srv, clock=lambda: clock[0], log_fn=lambda m: None)
+        assert pilot.tick()["status"] == AutopilotStatus.WATCHING
+        _grow(data)
+        out = pilot.tick()
+        assert out["status"] == AutopilotStatus.TRIGGERED_HYSTERESIS
+        out = pilot.tick()
+        assert out["status"] == AutopilotStatus.REFRESHED
+        assert srv.registry.generation("m") == 2
+        # served scores == the refreshed artifact's offline scores
+        scores, _ = srv.predict_direct("m", X[:8])
+        offline = BinarySVC.load(pilot.cfg.out_path, dtype=jnp.float32)
+        assert np.array_equal(
+            scores, np.asarray(offline.decision_function(X[:8])))
+        # a fresh trigger inside the cooldown window: the first tick is
+        # hysteresis 1/2, the second would refresh but the cooldown
+        # suppresses it
+        w = ShardWriter.open_append(data)
+        w.append(np.tile(X[:200], (2, 1))[:400], np.tile(Y[:200], 2)[:400])
+        w.close()
+        clock[0] += 10.0
+        assert pilot.tick()["status"] \
+            == AutopilotStatus.TRIGGERED_HYSTERESIS
+        out = pilot.tick()
+        assert out["status"] == AutopilotStatus.SUPPRESSED_COOLDOWN
+        # past the cooldown it refreshes again
+        clock[0] += 30.0
+        out = pilot.tick()
+        assert out["status"] == AutopilotStatus.REFRESHED
+        assert srv.registry.generation("m") == 3
+
+
+def test_refresh_failure_loop_cannot_hot_loop(tmp_path):
+    """The acceptance pin: with refreshes failing persistently, the
+    breaker trips after `breaker_threshold` attempts and every
+    subsequent eligible tick is SUPPRESSED_BREAKER (no further refresh
+    attempts) until the injectable clock passes the cooldown, when
+    exactly one half-open probe is admitted."""
+    data, deployed = _deploy(tmp_path)
+    clock = [0.0]
+    pilot = Autopilot(
+        _config(tmp_path, data, deployed, breaker_threshold=2,
+                breaker_cooldown_s=100.0),
+        server=None, clock=lambda: clock[0], log_fn=lambda m: None)
+    _grow(data)
+    plan = faults.FaultPlan(
+        [faults.FaultRule(point="autopilot.refresh", kind="transient",
+                          p=1.0)], seed=0)
+    with faults.active(plan):
+        s1 = pilot.tick()["status"]
+        s2 = pilot.tick()["status"]
+        assert (s1, s2) == (AutopilotStatus.REFRESH_FAILED,) * 2
+        attempts_at_trip = plan.hits("autopilot.refresh")
+        assert attempts_at_trip == 2
+        # breaker is OPEN: ticks keep watching, refresh NEVER attempted
+        for _ in range(5):
+            assert pilot.tick()["status"] \
+                == AutopilotStatus.SUPPRESSED_BREAKER
+        assert plan.hits("autopilot.refresh") == attempts_at_trip
+        assert pilot.state.failures == 2
+        # past the cooldown: exactly one half-open probe goes out
+        clock[0] = 101.0
+        assert pilot.tick()["status"] == AutopilotStatus.REFRESH_FAILED
+        assert plan.hits("autopilot.refresh") == attempts_at_trip + 1
+        assert pilot.tick()["status"] \
+            == AutopilotStatus.SUPPRESSED_BREAKER
+    # faults cleared + cooldown passed: the probe succeeds and the
+    # loop recovers (artifact-drop mode: no server to swap into)
+    clock[0] = 202.0
+    assert pilot.tick()["status"] == AutopilotStatus.REFRESHED
+    assert os.path.exists(pilot.cfg.out_path)
+
+
+def test_watchdog_timeout_then_resume_bit_identical(tmp_path):
+    """A hung (here: deadline-zero) fit is stopped at a checkpointed
+    segment boundary; the next eligible tick RESUMES it from its own
+    checkpoint, and the final artifact is bit-identical to an
+    uninterrupted refresh."""
+    from tpusvm.serve.refresh import refresh_fit
+
+    data, deployed = _deploy(tmp_path)
+    clock = [0.0]
+    pilot = Autopilot(
+        _config(tmp_path, data, deployed,
+                checkpoint_path=str(tmp_path / "ck.npz"),
+                checkpoint_every=1, deadline_s=0.0,
+                breaker_threshold=100, breaker_cooldown_s=0.0),
+        server=None, clock=lambda: clock[0], log_fn=lambda m: None)
+    _grow(data)
+    # deadline 0 + checkpoint_every 1 => the FIRST durable segment
+    # checkpoint trips the watchdog
+    out = pilot.tick()
+    assert out["status"] == AutopilotStatus.REFRESH_TIMEOUT
+    assert pilot.state.stage == "fitting"
+    assert os.path.exists(str(tmp_path / "ck.npz"))
+    # lift the deadline: the resumed fit completes from the checkpoint
+    pilot.cfg = dataclasses.replace(pilot.cfg, deadline_s=None)
+    out = pilot.tick()
+    assert out["status"] == AutopilotStatus.REFRESHED
+    refreshed = BinarySVC.load(pilot.cfg.out_path)
+    # uninterrupted control with the SAME config, same data
+    Xg, Yg = open_dataset(data).load_arrays()
+    plain = refresh_fit(deployed, Xg, Yg,
+                        out_path=str(tmp_path / "plain.npz"))
+    assert refreshed.sv_alpha_.tobytes() == plain.sv_alpha_.tobytes()
+    assert np.array_equal(refreshed.sv_ids_, plain.sv_ids_)
+    assert refreshed.b_ == plain.b_
+
+
+def test_kill_mid_refresh_resume_replays_decisions(tmp_path):
+    """Kill the supervisor at the refresh stage; a resumed supervisor
+    (same seed, same state file) finishes the SAME refresh and the
+    artifact is bit-identical to an uninterrupted run's."""
+    from tpusvm.serve.refresh import refresh_fit
+
+    data, deployed = _deploy(tmp_path)
+    cfg = _config(tmp_path, data, deployed,
+                  checkpoint_path=str(tmp_path / "ck.npz"),
+                  checkpoint_every=1)
+    pilot = Autopilot(cfg, server=None, log_fn=lambda m: None)
+    _grow(data)
+    plan = faults.FaultPlan(
+        [faults.FaultRule(point="solver.outer_checkpoint", kind="kill",
+                          at_hit=1)], seed=0)
+    with pytest.raises(faults.SimulatedKill):
+        with faults.active(plan):
+            pilot.tick()
+    # the killed supervisor's state froze mid-stage
+    st = load_state(cfg.resolved().state_path)
+    assert st.stage == "fitting" and st.stage_rows == 400
+    pilot2 = Autopilot(cfg, server=None, resume=True,
+                       log_fn=lambda m: None)
+    out = pilot2.tick()
+    assert out["status"] == AutopilotStatus.REFRESHED
+    assert pilot2.state.rows_at_refresh == 400
+    Xg, Yg = open_dataset(data).load_arrays()
+    plain = refresh_fit(deployed, Xg, Yg,
+                        out_path=str(tmp_path / "plain.npz"))
+    got = BinarySVC.load(pilot2.cfg.out_path)
+    assert got.sv_alpha_.tobytes() == plain.sv_alpha_.tobytes()
+    assert np.array_equal(got.sv_ids_, plain.sv_ids_)
+    assert got.b_ == plain.b_
+
+
+def test_resume_seed_mismatch_refused(tmp_path):
+    data, deployed = _deploy(tmp_path)
+    cfg = _config(tmp_path, data, deployed, seed=1)
+    Autopilot(cfg, log_fn=lambda m: None)   # writes the state file
+    with pytest.raises(ValueError, match="seed"):
+        Autopilot(dataclasses.replace(cfg, seed=2), resume=True,
+                  log_fn=lambda m: None)
+
+
+def test_autopilot_obs_counters_and_trace_events(tmp_path):
+    from tpusvm.obs.registry import default_registry, reset_default_registry
+
+    reset_default_registry()
+    events = []
+    faults.set_event_sink(lambda name, **attrs: events.append((name, attrs)))
+    try:
+        data, deployed = _deploy(tmp_path)
+        pilot = Autopilot(_config(tmp_path, data, deployed),
+                          log_fn=lambda m: None)
+        pilot.tick()
+        _grow(data)
+        pilot.tick()
+        reg = default_registry()
+        snap = {(e["name"], tuple(sorted(e["labels"].items()))): e
+                for e in reg.snapshot()["metrics"]}
+        assert snap[("autopilot.ticks", ())]["value"] == 2
+        assert snap[("autopilot.refreshes_triggered", ())]["value"] == 1
+        assert ("autopilot.drift_score",
+                (("detector", "row_growth"),)) in snap
+        assert snap[("autopilot.data_staleness_rows", ())]["value"] \
+            == 160.0
+        drift_events = [a for n, a in events if n == "autopilot.drift"]
+        assert len(drift_events) == 2
+        assert drift_events[1]["decision"] is True
+        assert drift_events[1]["report"]["schema_version"] == 1
+    finally:
+        faults.set_event_sink(None)
+        reset_default_registry()
+
+
+def test_report_renders_autopilot_section():
+    from tpusvm.obs.report import autopilot_rows, format_autopilot_table
+
+    recs = [
+        {"kind": "event", "name": "autopilot.drift",
+         "attrs": {"tick": 1, "decision": False, "reason": "no",
+                   "report": {"detectors": [
+                       {"name": "row_growth", "score": 0.1,
+                        "threshold": 0.5, "triggered": False}]}}},
+        {"kind": "event", "name": "autopilot.drift",
+         "attrs": {"tick": 2, "decision": True,
+                   "reason": "triggered: row_growth",
+                   "report": {"detectors": [
+                       {"name": "row_growth", "score": 0.7,
+                        "threshold": 0.5, "triggered": True}]}}},
+        {"kind": "span", "name": "x", "t0": 0, "t1": 1, "dur_s": 1,
+         "attrs": {}},
+    ]
+    rows = autopilot_rows(recs)
+    assert len(rows) == 2
+    table = format_autopilot_table(rows)
+    assert "REFRESH" in table and "row_growth=0.7/0.5*" in table
+    assert "triggered: row_growth" in table
+    assert format_autopilot_table([]) \
+        == "no autopilot decisions in this trace"
+
+
+# ----------------------------------------- served-score drift plumbing
+def test_serve_score_sign_counters_feed_score_shift(tmp_path):
+    data, deployed = _deploy(tmp_path)
+    with Server(ServeConfig(max_batch=8), dtype=jnp.float32) as srv:
+        srv.load_model("m", deployed)
+        srv.warmup()
+        base = srv.score_stats("m")
+        assert base == {"pos": 0, "neg": 0}
+        for i in range(24):
+            r = srv.submit("m", X[i])
+            assert r.ok
+        cur = srv.score_stats("m")
+        assert cur["pos"] + cur["neg"] == 24
+        assert cur["pos"] > 0 and cur["neg"] > 0  # rings has both signs
+        ss = score_shift(base, cur)
+        assert ss["window"] == 24
+
+
+# --------------------------------------------- OvR / SVR refresh tasks
+def test_refresh_ovr_warm_parity_and_savings(tmp_path):
+    from tpusvm.data.synthetic import mnist_like_multiclass
+    from tpusvm.models import OneVsRestSVC
+    from tpusvm.serve.refresh import refresh_fit
+
+    Xm, Ym = mnist_like_multiclass(n=300, d=24, seed=5)
+    cfg = SVMConfig(C=10.0, gamma=0.5)
+    dep = str(tmp_path / "ovr.npz")
+    OneVsRestSVC(cfg, solver="blocked").fit(Xm[:200], Ym[:200]).save(dep)
+    warm = refresh_fit(dep, Xm, Ym, out_path=str(tmp_path / "w.npz"))
+    cold = refresh_fit(dep, Xm, Ym, out_path=str(tmp_path / "c.npz"),
+                       warm=False)
+    # parity at the solution level: same SV union, same accuracy,
+    # every head converged — and the warm seed does real work
+    assert np.array_equal(warm.sv_ids_, cold.sv_ids_)
+    assert warm.score(Xm, Ym) == cold.score(Xm, Ym)
+    assert all(s == 1 for s in warm.statuses_)
+    assert int(warm.n_iter_.sum()) < int(cold.n_iter_.sum())
+    # the refreshed artifact round-trips sv_ids (the new state field)
+    back = OneVsRestSVC.load(str(tmp_path / "w.npz"))
+    assert np.array_equal(back.sv_ids_, warm.sv_ids_)
+
+
+def test_refresh_ovr_artifact_without_sv_ids_needs_cold(tmp_path):
+    """Pre-0.18 OvR artifacts (no sv_ids) refresh cold with a named
+    error on the warm path."""
+    from tpusvm.models import OneVsRestSVC
+    from tpusvm.serve.refresh import refresh_fit
+
+    Xm = X[:200]
+    Ym = np.where(Y[:200] > 0, 3, 7)
+    cfg = SVMConfig(C=10.0, gamma=10.0)
+    dep = str(tmp_path / "old.npz")
+    m = OneVsRestSVC(cfg, solver="blocked").fit(Xm, Ym)
+    m.sv_ids_ = None   # simulate a pre-0.18 artifact
+    m.save(dep)
+    with pytest.raises(ValueError, match="sv_ids"):
+        refresh_fit(dep, Xm, Ym, out_path=str(tmp_path / "w.npz"))
+    cold = refresh_fit(dep, Xm, Ym, out_path=str(tmp_path / "c.npz"),
+                       warm=False)
+    assert cold.score(Xm, Ym) > 0.8
+
+
+def test_refresh_svr_warm_parity_and_savings(tmp_path):
+    from tpusvm.data.synthetic import svr_sine
+    from tpusvm.models import EpsilonSVR
+    from tpusvm.serve.refresh import refresh_fit
+
+    Xs, t = svr_sine(n=300, d=2, seed=5)
+    cfg = SVMConfig(C=10.0, gamma=1.0, epsilon=0.1)
+    dep = str(tmp_path / "svr.npz")
+    EpsilonSVR(cfg).fit(Xs[:200], t[:200]).save(dep)
+    warm = refresh_fit(dep, Xs, t, out_path=str(tmp_path / "w.npz"))
+    cold = refresh_fit(dep, Xs, t, out_path=str(tmp_path / "c.npz"),
+                       warm=False)
+    assert warm.status_.name == "CONVERGED"
+    assert np.array_equal(warm.sv_ids_, cold.sv_ids_)
+    assert warm.n_iter_ < cold.n_iter_
+    assert warm.score(Xs, t) > 0.9
+    back = EpsilonSVR.load(str(tmp_path / "w.npz"))
+    assert np.array_equal(back.sv_ids_, warm.sv_ids_)
+
+
+def test_refresh_ovr_svr_reject_checkpoint_by_name(tmp_path):
+    from tpusvm.models import OneVsRestSVC
+    from tpusvm.serve.refresh import refresh_fit
+
+    Xm = X[:160]
+    Ym = np.where(Y[:160] > 0, 1, 2)
+    dep = str(tmp_path / "ovr.npz")
+    OneVsRestSVC(SVMConfig(C=10.0, gamma=10.0),
+                 solver="blocked").fit(Xm, Ym).save(dep)
+    with pytest.raises(ValueError, match="future PR"):
+        refresh_fit(dep, Xm, Ym, out_path=str(tmp_path / "o.npz"),
+                    checkpoint_path=str(tmp_path / "ck.npz"))
+
+
+def test_deployed_seed_ovr_and_svr_constructions():
+    from tpusvm.tune.warm import deployed_seed_ovr, deployed_seed_svr
+
+    # OvR: |coef| scatters per head, feasible per head's labels
+    ids = np.array([0, 2])
+    coef = np.array([[1.0, -1.0], [-2.0, 2.0]])
+    labels = np.array([5, 7, 5, 7])
+    seeds = deployed_seed_ovr(ids, coef, 4, labels,
+                              np.array([5, 7]), C=10.0)
+    assert seeds.shape == (2, 4)
+    for k, c in enumerate([5, 7]):
+        yk = np.where(labels == c, 1, -1)
+        assert float(np.sum(seeds[k] * yk)) == pytest.approx(0.0)
+    with pytest.raises(ValueError, match="prefix"):
+        deployed_seed_ovr(np.array([9]), coef[:, :1], 4, labels,
+                          np.array([5, 7]), C=10.0)
+    # SVR: the doubling inverts sign-exactly and stays feasible
+    beta = deployed_seed_svr(np.array([0, 1]), np.array([1.5, -2.0]),
+                             3, C=10.0)
+    assert beta.shape == (6,)
+    Y2 = np.concatenate([np.ones(3), -np.ones(3)])
+    assert float(np.sum(beta * Y2)) == pytest.approx(0.0)
+    assert beta[0] > 0 and beta[4] > 0 and beta[1] == 0.0
+    with pytest.raises(ValueError, match="prefix"):
+        deployed_seed_svr(np.array([5]), np.array([1.0]), 3, C=10.0)
+
+
+def test_ovr_warm_seeds_requires_blocked_solver():
+    from tpusvm.models import OneVsRestSVC
+
+    m = OneVsRestSVC(SVMConfig(), solver="pair")
+    with pytest.raises(ValueError, match="blocked"):
+        m.fit(X[:64], np.where(Y[:64] > 0, 1, 2),
+              warm_seeds=np.zeros((2, 64)))

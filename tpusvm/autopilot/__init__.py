@@ -1,0 +1,53 @@
+"""tpusvm.autopilot — the closed-loop online-learning supervisor.
+
+Ties the stream-side half (crash-safe tail-shard appends,
+stream/append.py) to the serving-side half PR 14 landed (warm-started
+checkpointed refresh + atomic hot-swap) with a supervised daemon:
+
+  drift.py   deterministic, mergeable drift/staleness detectors over
+             on-disk artifacts; schema-versioned DriftReport whose JSON
+             is byte-identical for identical (inputs, seed)
+  state.py   crash-safe autopilot_state.json (atomic, format-versioned,
+             CRC-fingerprinted) — decisions and the in-flight refresh
+             stage replay across kills
+  loop.py    the tick loop: ingest-watch -> drift decision ->
+             refresh_fit -> atomic save -> swap, hardened with
+             hysteresis, cooldown, a refresh CircuitBreaker and a
+             checkpointed fit watchdog
+
+CLI: `tpusvm autopilot`; chaos gate: `python -m tpusvm.faults
+autopilot-chaos-smoke`.
+"""
+
+from tpusvm.autopilot.drift import (
+    DRIFT_SCHEMA_VERSION,
+    DetectorResult,
+    DriftReport,
+    DriftThresholds,
+    evaluate,
+    feature_drift,
+    score_shift,
+)
+from tpusvm.autopilot.loop import Autopilot, AutopilotConfig
+from tpusvm.autopilot.state import (
+    STATE_VERSION,
+    AutopilotState,
+    load_state,
+    save_state,
+)
+
+__all__ = [
+    "DRIFT_SCHEMA_VERSION",
+    "STATE_VERSION",
+    "Autopilot",
+    "AutopilotConfig",
+    "AutopilotState",
+    "DetectorResult",
+    "DriftReport",
+    "DriftThresholds",
+    "evaluate",
+    "feature_drift",
+    "load_state",
+    "save_state",
+    "score_shift",
+]

@@ -506,16 +506,18 @@ def _build_parser() -> argparse.ArgumentParser:
     rf = sub.add_parser(
         "refresh", parents=[common],
         help="crash-safe online refresh: warm-start a refit from a "
-        "DEPLOYED model's alphas, checkpoint it, save atomically, and "
-        "hot-swap it into a running `tpusvm serve` (tpusvm.serve."
-        "refresh)")
-    add_data_source(rf, sharded=False)
+        "DEPLOYED model's duals (binary/OvR/SVR), checkpoint it, save "
+        "atomically, and hot-swap it into a running `tpusvm serve` "
+        "(tpusvm.serve.refresh); --data DIR reads an (append-grown) "
+        "sharded dataset")
+    add_data_source(rf)
     rf.set_defaults(multiclass=False, task="svc")
     rf.add_argument("--model", metavar="NPZ",
                     help="the deployed artifact to refresh (required "
-                    "unless --smoke); its config and alphas seed the "
+                    "unless --smoke); its config and duals seed the "
                     "refit — the new data must keep its training rows "
-                    "as a prefix (appended micro-batches)")
+                    "as a prefix (appended micro-batches; binary, OvR "
+                    "and SVR artifacts dispatch automatically)")
     rf.add_argument("--save", metavar="NPZ",
                     help="refreshed artifact output (atomic write; "
                     "required unless --smoke) — drop it in a serve "
@@ -547,6 +549,109 @@ def _build_parser() -> argparse.ArgumentParser:
                     "asserts convergence, warm update savings, and "
                     "bit-identical served scores post-swap")
     rf.add_argument("-q", "--quiet", action="store_true")
+
+    ap = sub.add_parser(
+        "autopilot", parents=[common],
+        help="supervised closed-loop online learning: watch an "
+        "append-grown dataset, decide retrains off deterministic drift "
+        "detectors, and drive crash-safe refresh + hot-swap unattended "
+        "(tpusvm.autopilot)")
+    ap.add_argument("--data", metavar="DIR",
+                    help="the sharded dataset to watch (grown by "
+                    "stream appends; required unless --smoke)")
+    ap.add_argument("--model", metavar="NPZ",
+                    help="the deployed artifact the first refresh "
+                    "warm-starts from (required unless --smoke); later "
+                    "refreshes chain from the last swapped artifact")
+    ap.add_argument("--save", metavar="NPZ", default=None,
+                    help="refreshed-artifact output (atomic replace; "
+                    "default: <model>.refresh.npz) — point a serve "
+                    "--watch dir here for zero-coordination deploys")
+    ap.add_argument("--state", metavar="JSON", default=None,
+                    help="crash-safe supervisor state (atomic, "
+                    "versioned, CRC-fingerprinted; default: "
+                    "DATA/autopilot_state.json); --resume replays it")
+    ap.add_argument("--resume", action="store_true",
+                    help="resume a killed supervisor from --state: "
+                    "decisions replay identically and an in-flight "
+                    "refresh continues from its own checkpoint")
+    ap.add_argument("--name", default=None,
+                    help="hosted model name for swaps (default: the "
+                    "--save file stem)")
+    ap.add_argument("--swap", metavar="URL", dest="swap_url",
+                    help="POST /admin/swap on this running serve "
+                    "frontend after each refresh (omit for "
+                    "artifact-drop mode: serve --watch picks up --save)")
+    ap.add_argument("--interval-s", type=float, default=30.0,
+                    help="tick period (default 30)")
+    ap.add_argument("--max-ticks", type=int, default=None,
+                    help="stop after N ticks (default: run forever)")
+    det = ap.add_argument_group("drift detectors (None/off when unset)")
+    det.add_argument("--growth-threshold", type=float, default=0.25,
+                     help="refresh when appended rows exceed this "
+                     "fraction of the rows at the last refresh "
+                     "(default 0.25; -1 disables)")
+    det.add_argument("--feature-threshold", type=float, default=0.10,
+                     help="refresh when appended shards' min/max "
+                     "escapes the deployed scaler's fitted range by "
+                     "this relative fraction (default 0.10; -1 "
+                     "disables)")
+    det.add_argument("--score-threshold", type=float, default=0.20,
+                     help="refresh when the served-score positive-rate "
+                     "since the last refresh shifts this much vs the "
+                     "baseline (needs --swap-less in-process serving "
+                     "or smoke mode; -1 disables; default 0.20)")
+    det.add_argument("--staleness-s", type=float, default=None,
+                     help="refresh after this many seconds regardless "
+                     "of drift (default: off)")
+    det.add_argument("--min-new-rows", type=int, default=1,
+                     help="suppress non-staleness refreshes until this "
+                     "many rows appended (default 1)")
+    det.add_argument("--jitter-frac", type=float, default=0.0,
+                     help="seeded +/- threshold jitter fraction (the "
+                     "fleet de-synchronizer; default 0 = exact)")
+    det.add_argument("--seed", type=int, default=0,
+                     help="decision seed (reports are byte-reproducible "
+                     "per seed)")
+    gate = ap.add_argument_group("retrain gating")
+    gate.add_argument("--hysteresis", type=int, default=1,
+                      help="consecutive triggered ticks required "
+                      "(default 1)")
+    gate.add_argument("--cooldown-s", type=float, default=0.0,
+                      help="post-refresh quiet period (default 0)")
+    gate.add_argument("--breaker-threshold", type=int, default=3,
+                      help="consecutive refresh failures that trip the "
+                      "refresh breaker into degraded-watch mode "
+                      "(default 3)")
+    gate.add_argument("--breaker-cooldown-s", type=float, default=60.0,
+                      help="open-breaker cooldown before a half-open "
+                      "refresh probe (default 60)")
+    fit = ap.add_argument_group("refresh fit")
+    fit.add_argument("--cold", action="store_true",
+                     help="cold refits (skip the warm seed)")
+    fit.add_argument("--checkpoint", metavar="NPZ", default=None,
+                     help="crash-safe refit checkpoints (binary "
+                     "artifacts; enables --deadline-s)")
+    fit.add_argument("--checkpoint-every", type=int, default=64,
+                     metavar="K")
+    fit.add_argument("--deadline-s", type=float, default=None,
+                     help="fit watchdog: stop a too-slow refit at a "
+                     "checkpointed segment boundary and resume it on a "
+                     "later tick (requires --checkpoint)")
+    ap.add_argument("--smoke", action="store_true",
+                    help="CI gate: ingest, deploy, append, run the "
+                    "supervisor in-process against a live server under "
+                    "any active fault plan; asserts a refresh lands, "
+                    "the swap serves the refreshed bytes, and drift "
+                    "reports are byte-reproducible")
+    ap.add_argument("--smoke-ticks", type=int, default=6,
+                    help="smoke tick budget (default 6)")
+    ap.add_argument("--trace", metavar="PATH",
+                    help="write drift decisions + refresh lifecycle "
+                    "events + metric snapshots to a JSONL trace")
+    ap.add_argument("--trace-max-bytes", type=int, default=None,
+                    metavar="N")
+    ap.add_argument("-q", "--quiet", action="store_true")
 
     tu = sub.add_parser(
         "tune", parents=[common],
@@ -1845,10 +1950,37 @@ def _cmd_refresh(args) -> int:
 
     say = (lambda msg: None) if args.quiet else print
     timer = PhaseTimer()
+    # the data loader needs to know the TASK the artifact was trained
+    # for (OvR keeps raw labels, SVR reads continuous targets) — sniff
+    # it from the deployed state instead of asking the operator
+    from tpusvm.models import model_task
+
+    try:
+        task = model_task(args.model)
+    except (OSError, ValueError) as e:
+        raise SystemExit(f"refresh: {e}")
+    args.multiclass = task == "ovr"
+    args.task = "svr" if task == "svr" else "svc"
     with timer.phase("data"):
-        X, Y, Xt, Yt = _load_train_data(args)
+        if getattr(args, "data", None):
+            # the append-grown sharded dataset (stream.open_append):
+            # refresh consumes the manifest's global row order, whose
+            # prefix is exactly the deployed run's rows
+            from tpusvm.stream import open_dataset
+
+            if task == "svr":
+                raise SystemExit("refresh: svr artifacts read CSV/"
+                                 "synthetic continuous targets; sharded "
+                                 "datasets store integer labels")
+            try:
+                X, Y = open_dataset(args.data).load_arrays()
+            except (OSError, ValueError) as e:
+                raise SystemExit(f"refresh: --data: {e}")
+            Xt = Yt = None
+        else:
+            X, Y, Xt, Yt = _load_train_data(args)
     say(f"refresh: {X.shape[0]} rows x {X.shape[1]} features "
-        f"(deployed: {args.model})")
+        f"({task} deployed: {args.model})")
     try:
         with timer.phase("training"):
             model = refresh_fit(
@@ -1859,13 +1991,20 @@ def _cmd_refresh(args) -> int:
             )
     except (OSError, ValueError) as e:
         raise SystemExit(f"refresh: {e}")
-    say(f"refreshed model: {model.n_support_} SVs, "
-        f"{model.n_iter_} updates, status {model.status_.name}, "
+    n_iter = (int(np.sum(model.n_iter_))
+              if np.ndim(model.n_iter_) else model.n_iter_)
+    status = (model.status_.name if hasattr(model, "status_")
+              else "per-head")
+    n_sv = (model.n_support_ if hasattr(model, "n_support_")
+            else len(model.X_sv_))
+    say(f"refreshed model: {n_sv} SVs, "
+        f"{n_iter} updates, status {status}, "
         f"saved to {args.save}")
     if Xt is not None and len(Xt):
         with timer.phase("prediction"):
             acc = model.score(Xt, Yt)
-        say(f"held-out accuracy = {acc:.4f}")
+        say(f"held-out {'r2' if task == 'svr' else 'accuracy'}"
+            f" = {acc:.4f}")
     if args.swap_url:
         name = args.swap_name or os.path.splitext(
             os.path.basename(args.save))[0]
@@ -1948,6 +2087,191 @@ def _refresh_smoke(args) -> int:
           f"{cold.n_iter_} updates "
           f"({1 - warm.n_iter_ / cold.n_iter_:.1%} saved), accuracy "
           f"{acc:.4f}, swap generation 2, served scores bit-identical")
+    return 0
+
+
+def _cmd_autopilot(args) -> int:
+    """The closed-loop online-learning supervisor (tpusvm.autopilot)."""
+    from tpusvm.autopilot import Autopilot, AutopilotConfig, DriftThresholds
+
+    tracer = _make_tracer(args, "autopilot")
+
+    def _finish(rc: int) -> int:
+        if tracer is not None:
+            from tpusvm.obs import default_registry
+
+            tracer.metrics_snapshot(default_registry().snapshot())
+        _close_tracer(tracer)
+        return rc
+
+    if args.smoke:
+        return _finish(_autopilot_smoke(args))
+    if not args.data or not args.model:
+        raise SystemExit("autopilot: --data DIR and --model NPZ are "
+                         "required (or --smoke)")
+    say = (lambda msg: None) if args.quiet else print
+
+    def thr(v):
+        return None if v is not None and v < 0 else v
+
+    cfg = AutopilotConfig(
+        data_dir=args.data,
+        model_path=args.model,
+        out_path=args.save,
+        state_path=args.state,
+        name=args.name,
+        interval_s=args.interval_s,
+        thresholds=DriftThresholds(
+            feature=thr(args.feature_threshold),
+            growth=thr(args.growth_threshold),
+            score=thr(args.score_threshold),
+            staleness_s=args.staleness_s,
+            min_new_rows=args.min_new_rows,
+            jitter_frac=args.jitter_frac,
+        ),
+        hysteresis=args.hysteresis,
+        cooldown_s=args.cooldown_s,
+        warm=not args.cold,
+        checkpoint_path=args.checkpoint,
+        checkpoint_every=args.checkpoint_every,
+        deadline_s=args.deadline_s,
+        breaker_threshold=args.breaker_threshold,
+        breaker_cooldown_s=args.breaker_cooldown_s,
+        seed=args.seed,
+    )
+    try:
+        pilot = Autopilot(cfg, swap_url=args.swap_url,
+                          resume=args.resume, log_fn=say)
+    except (OSError, ValueError) as e:
+        raise SystemExit(f"autopilot: {e}")
+    say(f"autopilot: watching {args.data} every {cfg.interval_s:g}s "
+        f"(state {pilot.cfg.state_path}, out {pilot.cfg.out_path})")
+    try:
+        out = pilot.run(max_ticks=args.max_ticks)
+    except KeyboardInterrupt:
+        out = {"ticks": pilot.state.tick,
+               "generation": pilot.state.generation,
+               "refreshes": pilot.state.refreshes,
+               "failures": pilot.state.failures}
+    say(f"autopilot: {out['ticks']} ticks, {out['refreshes']} "
+        f"refreshes ({out['failures']} failures), generation "
+        f"{out['generation']}")
+    return _finish(0)
+
+
+def _autopilot_smoke(args) -> int:
+    """CI gate: the whole closed loop in-process — ingest, deploy,
+    serve, append, supervise — tolerant of an active fault plan (the
+    chaos CI step runs it under tests/fixtures/chaos_plan.json, whose
+    autopilot rules inject a transient refresh failure the breaker
+    machinery must absorb and retry)."""
+    import tempfile
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tpusvm.autopilot import (
+        Autopilot,
+        AutopilotConfig,
+        DriftThresholds,
+        evaluate,
+    )
+    from tpusvm.config import SVMConfig
+    from tpusvm.data import rings
+    from tpusvm.models import BinarySVC
+    from tpusvm.serve import ServeConfig, Server
+    from tpusvm.status import AutopilotStatus
+    from tpusvm.stream import ShardWriter, ingest_arrays, open_dataset
+
+    failures = []
+    X, Y = rings(n=400, seed=11)
+    with tempfile.TemporaryDirectory() as td:
+        import os as _os
+
+        data = _os.path.join(td, "data")
+        ingest_arrays(data, X[:240], Y[:240], rows_per_shard=64)
+        deployed = _os.path.join(td, "deployed.npz")
+        BinarySVC(SVMConfig(C=10.0, gamma=10.0),
+                  dtype=jnp.float32).fit(X[:240], Y[:240]).save(deployed)
+        thresholds = DriftThresholds(growth=0.5, feature=0.10,
+                                     score=None, jitter_frac=0.0)
+        with Server(ServeConfig(max_batch=8), dtype=jnp.float32) as srv:
+            srv.load_model("m", deployed)
+            srv.warmup()
+            ref_old, _ = srv.predict_direct("m", X[:16])
+            cfg = AutopilotConfig(
+                data_dir=data, model_path=deployed,
+                out_path=_os.path.join(td, "m.refresh.npz"),
+                name="m", thresholds=thresholds, hysteresis=1,
+                checkpoint_path=_os.path.join(td, "refresh_ck.npz"),
+                checkpoint_every=8,
+                breaker_threshold=3, breaker_cooldown_s=0.1,
+                seed=20260805,
+            )
+            pilot = Autopilot(cfg, server=srv,
+                              log_fn=(lambda m: None) if args.quiet
+                              else print)
+            first = pilot.tick()
+            if first["status"] != AutopilotStatus.WATCHING:
+                failures.append(
+                    f"tick on unchanged data: {first['status'].name}")
+            # grow the dataset past the growth threshold (the appends
+            # run through the crash-safe tail writer)
+            w = ShardWriter.open_append(data)
+            for s in range(240, 400, 40):
+                w.append(X[s:s + 40], Y[s:s + 40])
+            w.close()
+            statuses = []
+            for _ in range(args.smoke_ticks):
+                statuses.append(pilot.tick()["status"])
+                if statuses[-1] == AutopilotStatus.REFRESHED:
+                    break
+            if AutopilotStatus.REFRESHED not in statuses:
+                failures.append(
+                    "no refresh landed in "
+                    f"{args.smoke_ticks} ticks: "
+                    f"{[s.name for s in statuses]}")
+            else:
+                scores, _ = srv.predict_direct("m", X[:16])
+                offline = BinarySVC.load(cfg.out_path, dtype=jnp.float32)
+                want = np.asarray(offline.decision_function(X[:16]))
+                if not np.array_equal(scores, want):
+                    failures.append("served scores after the autopilot "
+                                    "swap are not bit-identical to the "
+                                    "refreshed artifact")
+                if np.array_equal(scores, ref_old):
+                    failures.append("swap was a no-op (old == new "
+                                    "scores — the gate is vacuous)")
+                if srv.registry.generation("m") < 2:
+                    failures.append("registry generation did not "
+                                    "advance")
+            # determinism: same inputs + seed => byte-identical report
+            ds = open_dataset(data)
+            kw = dict(manifest=ds.manifest,
+                      fitted_min=np.zeros(2), fitted_max=np.ones(2),
+                      rows_at_refresh=240, since_refresh_s=1.0,
+                      score_baseline=None, score_current=None,
+                      thresholds=thresholds, seed=7, tick=3)
+            if evaluate(**kw).to_json_bytes() != \
+                    evaluate(**kw).to_json_bytes():
+                failures.append("drift report is not byte-reproducible")
+            # resumed supervisor must replay to the same state
+            pilot2 = Autopilot(cfg, server=srv, resume=True,
+                               log_fn=lambda m: None)
+            if pilot2.state.generation != pilot.state.generation \
+                    or pilot2.state.rows_at_refresh \
+                    != pilot.state.rows_at_refresh:
+                failures.append("resumed state diverged: "
+                                f"{pilot2.state} vs {pilot.state}")
+    if failures:
+        for f in failures:
+            print(f"AUTOPILOT SMOKE FAILED: {f}")
+        return 1
+    print(f"autopilot smoke ok: refresh landed in "
+          f"{len(statuses)} ticks "
+          f"({pilot.state.failures} absorbed failures), generation "
+          f"{pilot.state.generation}, served scores bit-identical, "
+          "drift reports byte-reproducible")
     return 0
 
 
@@ -2298,8 +2622,10 @@ def _cmd_report(args) -> int:
     is the cross-process wall envelope."""
     from tpusvm.obs import read_trace
     from tpusvm.obs.report import (
+        autopilot_rows,
         compile_rows,
         convergence_rows,
+        format_autopilot_table,
         format_compile_table,
         format_convergence_table,
         merge_trace_files,
@@ -2340,6 +2666,11 @@ def _cmd_report(args) -> int:
     print("convergence (b_low - b_high per outer round):")
     print(format_convergence_table(conv, max_rows=args.max_rows))
     print()
+    auto = autopilot_rows(records)
+    if auto:
+        print("autopilot (drift decisions per tick):")
+        print(format_autopilot_table(auto, max_rows=args.max_rows))
+        print()
     counters = nonzero_counters(records)
     if counters:
         print("counters:")
@@ -2433,7 +2764,7 @@ def main(argv=None) -> int:
         jax.distributed.initialize(**kw)
     return {"train": _cmd_train, "ingest": _cmd_ingest,
             "predict": _cmd_predict, "serve": _cmd_serve,
-            "refresh": _cmd_refresh,
+            "refresh": _cmd_refresh, "autopilot": _cmd_autopilot,
             "tune": _cmd_tune, "info": _cmd_info,
             "report": _cmd_report,
             "benchdiff": _cmd_benchdiff}[args.command](args)

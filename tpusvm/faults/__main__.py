@@ -23,6 +23,26 @@ Commands:
                       the injected causes, every failed stage rolled
                       back to a serving old generation with /healthz
                       degraded, and a subsequent clean swap recovers.
+  autopilot-chaos-smoke
+                      The closed-loop online-learning CI gate (kill at
+                      EVERY stage): while client threads stream
+                      requests, micro-batches append to the dataset and
+                      the autopilot supervisor retrains/swaps — under a
+                      seeded plan that kills the append journal/commit,
+                      kills the refresh stage and the solver
+                      checkpoint, corrupts a staged swap artifact, and
+                      delays scoring/ticks. Every kill is "recovered"
+                      by rebuilding the writer/supervisor with
+                      resume=True, exactly as a restarted process
+                      would. Asserts: the final dataset is
+                      row-for-row, manifest-byte identical to an
+                      uninterrupted control (zero rows lost or
+                      duplicated — the journal audit), every served
+                      response bitwise-matches a complete generation,
+                      the corrupt staged swap rolled back with healthz
+                      degraded, and the post-recovery refreshed model
+                      is BIT-IDENTICAL (alpha bytes / SV ids / b) to
+                      the uninterrupted control run's.
 """
 
 from __future__ import annotations
@@ -270,6 +290,260 @@ def _swap_chaos_smoke() -> int:
     return 0
 
 
+def _autopilot_chaos_smoke() -> int:
+    import os
+    import threading
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tpusvm import faults
+    from tpusvm.autopilot import Autopilot, AutopilotConfig, DriftThresholds
+    from tpusvm.config import SVMConfig
+    from tpusvm.data import rings
+    from tpusvm.models import BinarySVC
+    from tpusvm.serve import ServeConfig, Server
+    from tpusvm.status import AutopilotStatus
+    from tpusvm.stream import ShardWriter, ingest_arrays, open_dataset
+
+    failures = []
+    X, Y = rings(n=400, seed=11)
+    BATCHES = [(s, s + 40) for s in range(240, 400, 40)]
+    Xq = X[:24]
+
+    def setup(td):
+        """One complete closed loop: dataset, deployed artifact, server,
+        supervisor config. Identical for control and chaos arms."""
+        data = os.path.join(td, "data")
+        ingest_arrays(data, X[:240], Y[:240], rows_per_shard=64)
+        deployed = os.path.join(td, "deployed.npz")
+        BinarySVC(SVMConfig(C=10.0, gamma=10.0),
+                  dtype=jnp.float32).fit(X[:240], Y[:240]).save(deployed)
+        srv = Server(ServeConfig(max_batch=8), dtype=jnp.float32)
+        srv.load_model("m", deployed)
+        srv.warmup()
+        cfg = AutopilotConfig(
+            data_dir=data, model_path=deployed,
+            out_path=os.path.join(td, "m.refresh.npz"),
+            name="m",
+            thresholds=DriftThresholds(growth=0.55, feature=None,
+                                       score=None, jitter_frac=0.0),
+            hysteresis=1, cooldown_s=0.0,
+            checkpoint_path=os.path.join(td, "refresh_ck.npz"),
+            checkpoint_every=1,
+            breaker_threshold=5, breaker_cooldown_s=0.05,
+            seed=20260805,
+        )
+        return data, deployed, srv, cfg
+
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as td:
+        # ---------------- control arm: uninterrupted closed loop
+        cdir = os.path.join(td, "control")
+        os.makedirs(cdir)
+        data_c, deployed_c, srv_c, cfg_c = setup(cdir)
+        with srv_c:
+            refA, _ = srv_c.predict_direct("m", Xq)
+            # the supervisor deploys BEFORE the data grows: its state
+            # records the deployed model's 240-row provenance
+            pilot = Autopilot(cfg_c, server=srv_c, log_fn=lambda m: None)
+            w = ShardWriter.open_append(data_c)
+            for a, b in BATCHES:
+                w.append(X[a:b], Y[a:b])
+            w.close()
+            out = pilot.tick()
+            if out["status"] != AutopilotStatus.REFRESHED:
+                print(f"AUTOPILOT CHAOS SMOKE FAILED: control arm did "
+                      f"not refresh ({out['status'].name})")
+                return 1
+            # the served scores of BOTH complete generations: the chaos
+            # arm's torn-read oracle (its refit is gated bit-identical
+            # to this control artifact, so these are the only two score
+            # vectors any chaos response may bitwise-match)
+            refB, _ = srv_c.predict_direct("m", Xq)
+        control = BinarySVC.load(cfg_c.out_path)
+        ds_c = open_dataset(data_c)
+        control_manifest = ds_c.manifest.to_json()
+
+        # ---------------- chaos arm: same loop, kills at every stage
+        hdir = os.path.join(td, "chaos")
+        os.makedirs(hdir)
+        data_h, deployed_h, srv_h, cfg_h = setup(hdir)
+        # one fault on every stage of the closed loop: the append's
+        # journal commit, the raw shard write, the refresh entry, the
+        # solver checkpoint (kill at its FIRST write — the warm fit
+        # converges within a couple of segments), a staged-swap failure
+        # (transient — deterministic rollback; corrupt staged BYTES are
+        # swap-chaos-smoke's dedicated gate), a corrupt artifact read on
+        # the retry, and latency on scoring and ticks
+        plan = faults.FaultPlan([
+            faults.FaultRule(point="stream.append", kind="kill",
+                             at_hit=2),
+            faults.FaultRule(point="ingest.write_shard", kind="kill",
+                             at_hit=3),
+            faults.FaultRule(point="autopilot.refresh", kind="kill",
+                             at_hit=1),
+            faults.FaultRule(point="solver.outer_checkpoint",
+                             kind="kill", at_hit=1),
+            faults.FaultRule(point="serve.swap", kind="transient",
+                             at_hit=1),
+            faults.FaultRule(point="registry.load", kind="corrupt",
+                             at_hit=2),
+            faults.FaultRule(point="serve.score", kind="latency",
+                             p=0.3, delay_ms=2.0, max_hits=16),
+            faults.FaultRule(point="autopilot.tick", kind="latency",
+                             p=0.5, delay_ms=1.0, max_hits=8),
+        ], seed=20260805)
+
+        with srv_h:
+            refA_h, _ = srv_h.predict_direct("m", Xq)
+            if not np.array_equal(refA_h, refA):
+                failures.append("chaos deployed generation does not "
+                                "serve the control's scores — arms are "
+                                "not comparable")
+            if np.array_equal(refA, refB):
+                failures.append("deployed and refreshed models are "
+                                "indistinguishable — the torn-"
+                                "generation check is vacuous")
+            stop = threading.Event()
+            bad = []
+            bad_lock = threading.Lock()
+
+            def client(t):
+                i = t
+                while not stop.is_set():
+                    r = srv_h.submit("m", Xq[i % 24], timeout_s=10.0)
+                    if r.ok:
+                        s = np.asarray(r.scores)
+                        if s != refA[i % 24] and s != refB[i % 24]:
+                            with bad_lock:
+                                bad.append(("torn", i % 24, float(s)))
+                    elif r.status.name not in ("TIMEOUT",):
+                        with bad_lock:
+                            bad.append(("status", r.status.name))
+                    i += 1
+
+            threads = [threading.Thread(target=client, args=(t,))
+                       for t in range(4)]
+            kills = 0
+            degraded_seen = False
+            # deploy the supervisor before the data grows (and before
+            # the chaos starts): its crash-safe state file is what every
+            # restarted incarnation resumes from
+            pilot = Autopilot(cfg_h, server=srv_h, log_fn=lambda m: None)
+            with faults.active(plan):
+                for t in threads:
+                    t.start()
+                # appends with restart-on-kill (the killed "process" is
+                # rebuilt with resume=True and replays its batch stream)
+                for attempt in range(12):
+                    try:
+                        w = ShardWriter.open_append(
+                            data_h, resume=attempt > 0)
+                        for a, b in BATCHES:
+                            w.append(X[a:b], Y[a:b])
+                        w.close()
+                        break
+                    except faults.SimulatedKill:
+                        kills += 1
+                else:
+                    failures.append("append never completed within the "
+                                    "restart budget")
+                # supervise with restart-on-kill until the refresh lands
+                statuses = []
+                for attempt in range(24):
+                    try:
+                        out = pilot.tick()
+                    except faults.SimulatedKill:
+                        kills += 1
+                        pilot = Autopilot(cfg_h, server=srv_h,
+                                          resume=True,
+                                          log_fn=lambda m: None)
+                        continue
+                    statuses.append(out["status"])
+                    if out["status"] == AutopilotStatus.REFRESH_FAILED \
+                            and srv_h.health()["status"] == "degraded":
+                        degraded_seen = True
+                    if out["status"] == AutopilotStatus.REFRESHED:
+                        s, _ = srv_h.predict_direct("m", Xq)
+                        if not np.array_equal(s, refB):
+                            failures.append(
+                                "post-swap served scores do not "
+                                "bitwise-match the control "
+                                "generation")
+                        break
+                else:
+                    failures.append(
+                        "no refresh landed within the tick budget: "
+                        f"{[s.name for s in statuses]}")
+                stop.set()
+                for t in threads:
+                    t.join(10.0)
+            faults.deactivate()
+
+            # ---------------- the gates
+            if kills == 0:
+                failures.append("no kill rule ever fired — the chaos "
+                                "arm degenerated to the control arm")
+            if not degraded_seen:
+                failures.append(
+                    "the failed staged swap never rolled back to a "
+                    "degraded-health old generation "
+                    f"(serve.swap hits {plan.hits('serve.swap')}, "
+                    f"registry.load hits {plan.hits('registry.load')})")
+            if bad:
+                failures.append(f"client anomalies under chaos: "
+                                f"{bad[:5]} ({len(bad)} total)")
+            if srv_h.health()["status"] != "ok":
+                failures.append(
+                    f"health did not recover: {srv_h.health()}")
+
+        # journal audit: zero rows lost or duplicated
+        ds_h = open_dataset(data_h)
+        if ds_h.manifest.to_json() != control_manifest:
+            failures.append("chaos dataset manifest differs from the "
+                            "uninterrupted control (rows lost, "
+                            "duplicated, or mis-sharded)")
+        if os.path.exists(os.path.join(data_h, "ingest.journal.json")):
+            failures.append("append journal survived the commit")
+        Xc, Yc = ds_c.load_arrays()
+        Xh, Yh = ds_h.load_arrays()
+        if not (np.array_equal(Xc, Xh) and np.array_equal(Yc, Yh)):
+            failures.append("chaos dataset rows differ from control")
+
+        # the refit is bit-identical to the uninterrupted control
+        if os.path.exists(cfg_h.out_path):
+            chaos = BinarySVC.load(cfg_h.out_path)
+            if chaos.sv_alpha_.tobytes() != control.sv_alpha_.tobytes() \
+                    or not np.array_equal(chaos.sv_ids_,
+                                          control.sv_ids_) \
+                    or chaos.b_ != control.b_:
+                failures.append(
+                    "post-recovery refreshed model is NOT bit-identical "
+                    f"to the control run ({len(chaos.sv_ids_)} vs "
+                    f"{len(control.sv_ids_)} SVs, b {chaos.b_!r} vs "
+                    f"{control.b_!r})")
+        else:
+            failures.append("chaos arm never produced a refreshed "
+                            "artifact")
+
+    if failures:
+        for f in failures:
+            print(f"AUTOPILOT CHAOS SMOKE FAILED: {f}")
+        return 1
+    print(f"autopilot chaos smoke ok: {kills} kills absorbed "
+          "(append journal / shard write / refresh entry / solver "
+          "checkpoint), failed staged swap rolled back degraded then "
+          "recovered, 0 torn/lost responses, dataset journal-audited "
+          "equal, refreshed model bit-identical to the uninterrupted "
+          "control")
+    return 0
+
+
 def main(argv=None) -> int:
     argv = sys.argv[1:] if argv is None else argv
     if not argv or argv[0] in ("-h", "--help"):
@@ -280,6 +554,8 @@ def main(argv=None) -> int:
         return _kill_resume_smoke()
     if cmd == "swap-chaos-smoke":
         return _swap_chaos_smoke()
+    if cmd == "autopilot-chaos-smoke":
+        return _autopilot_chaos_smoke()
     if cmd == "validate":
         if len(rest) != 1:
             print("usage: python -m tpusvm.faults validate PLAN.json")

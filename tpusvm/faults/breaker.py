@@ -134,3 +134,34 @@ class CircuitBreaker:
                 "threshold": self.threshold,
                 "cooldown_s": self.cooldown_s,
             }
+
+    # ------------------------------------------------------- persistence
+    def snapshot(self) -> dict:
+        """Raw machine state for crash-safe supervisors (the autopilot's
+        `autopilot_state.json`): unlike describe(), this captures the
+        STORED state (not the lazily-advanced effective one) plus the
+        open timestamp in the breaker's own clock domain, so restore()
+        replays cooldown arithmetic exactly."""
+        with self._lock:
+            return {
+                "state": self._state,
+                "consecutive": self._consecutive,
+                "opened_at": self._opened_at,
+                "trips": self.trips,
+                "recoveries": self.recoveries,
+            }
+
+    def restore(self, snap: dict) -> None:
+        """Reinstall a snapshot() — the resumed supervisor's breaker
+        makes the same allow() decisions the killed one would have (the
+        caller supplies the same injectable clock domain)."""
+        state = snap["state"]
+        if state not in (CLOSED, OPEN, HALF_OPEN):
+            raise ValueError(f"unknown breaker state {state!r}")
+        with self._lock:
+            self._state = state
+            self._consecutive = int(snap["consecutive"])
+            self._opened_at = float(snap["opened_at"])
+            self._probe_out = False
+            self.trips = int(snap.get("trips", 0))
+            self.recoveries = int(snap.get("recoveries", 0))

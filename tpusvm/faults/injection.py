@@ -40,6 +40,15 @@ rejected at load time):
                               (parallel/cascade.py)
   ``solver.outer_checkpoint`` the solver-state checkpoint write
                               (solver/checkpoint.py)
+  ``stream.append``           the append-session journal write and the
+                              close() commit transition — kills here
+                              exercise the exactly-once tail-append
+                              resume (stream/append.py)
+  ``autopilot.tick``          the supervisor's per-tick entry
+                              (autopilot/loop.py)
+  ``autopilot.refresh``       the supervisor's refresh stage — fit,
+                              save, swap happen behind this point
+                              (autopilot/loop.py)
 
 Kill semantics: :class:`SimulatedKill` subclasses ``BaseException`` (like
 ``KeyboardInterrupt``), so no ``except Exception`` recovery path — not
@@ -72,6 +81,9 @@ POINTS = frozenset({
     "cache.read",
     "cascade.round",
     "solver.outer_checkpoint",
+    "stream.append",
+    "autopilot.tick",
+    "autopilot.refresh",
 })
 
 KINDS = ("transient", "latency", "corrupt", "kill")

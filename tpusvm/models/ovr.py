@@ -129,6 +129,7 @@ class OneVsRestSVC:
         self.n_features_in_: Optional[int] = None
         self.X_sv_: Optional[np.ndarray] = None   # union of SVs across classes
         self.coef_: Optional[np.ndarray] = None   # (K, n_sv_union) alpha*y
+        self.sv_ids_: Optional[np.ndarray] = None  # union SV row ids
         self.b_: Optional[np.ndarray] = None      # (K,)
         self.n_iter_: Optional[np.ndarray] = None
         self.statuses_: Optional[np.ndarray] = None
@@ -139,9 +140,20 @@ class OneVsRestSVC:
         # states its effective process geometry (VERDICT r3 weak #1)
         self.class_mesh_: Optional[dict] = None
 
-    def fit(self, X: np.ndarray, labels: np.ndarray) -> "OneVsRestSVC":
+    def fit(self, X: np.ndarray, labels: np.ndarray,
+            warm_seeds: Optional[np.ndarray] = None) -> "OneVsRestSVC":
+        """warm_seeds: optional (K, n) per-head alpha0 seeds (already
+        projected feasible per head — tune.warm.deployed_seed_ovr), the
+        OvR refresh warm start. Blocked solver only: the pair solver's
+        vmapped lockstep and the fleet's batched launch have no per-head
+        seed surface yet."""
         cfg = self.config
         t0 = time.perf_counter()
+        if warm_seeds is not None and self.solver != "blocked":
+            raise ValueError(
+                "warm_seeds requires solver='blocked' (per-head "
+                f"sequential solves); got solver={self.solver!r}"
+            )
         # "auto" -> f64 accumulators (enables x64); see config.resolve_accum_dtype
         accum_dtype = resolve_accum_dtype(self.accum_dtype)
         X = np.asarray(X)
@@ -194,12 +206,13 @@ class OneVsRestSVC:
             # while_loop streams X once per class per 2-alpha update)
             from tpusvm.solver.blocked import blocked_smo_solve
 
-            def solve_one(y):
+            def solve_one(y, **warm_kw):
                 return blocked_smo_solve(
                     Xd, y, sn=sn_shared, C=cfg.C, gamma=cfg.gamma,
                     eps=cfg.eps, tau=cfg.tau, max_iter=cfg.max_iter,
                     kernel=cfg.kernel, degree=cfg.degree, coef0=cfg.coef0,
-                    accum_dtype=accum_dtype, **self.solver_opts,
+                    accum_dtype=accum_dtype, **warm_kw,
+                    **self.solver_opts,
                 )
         elif self.solver == "fleet":
             pass  # one batched launch below — no per-class solve_one
@@ -326,7 +339,22 @@ class OneVsRestSVC:
             iters = np.asarray(res.n_iter)
             statuses = np.asarray(res.status)
         else:
-            outs = [solve_one(jnp.asarray(y)) for y in Ys]
+            if warm_seeds is not None:
+                warm_seeds = np.asarray(warm_seeds, np.float64)
+                if warm_seeds.shape != Ys.shape:
+                    raise ValueError(
+                        f"warm_seeds shape {warm_seeds.shape} != "
+                        f"(K, n) = {Ys.shape}"
+                    )
+            outs = []
+            for k, y in enumerate(Ys):
+                kw = {}
+                if warm_seeds is not None and warm_seeds[k].any():
+                    # an all-zero seed is a cold start — skip the
+                    # warm-start f reconstruction for it
+                    kw = {"alpha0": jnp.asarray(warm_seeds[k]),
+                          "warm_start": True}
+                outs.append(solve_one(jnp.asarray(y), **kw))
             alphas = np.stack([np.asarray(o.alpha) for o in outs])
             bs = np.asarray([float(o.b) for o in outs])
             iters = np.asarray([int(o.n_iter) for o in outs])
@@ -341,6 +369,7 @@ class OneVsRestSVC:
         )
         self.X_sv_ = Xs[sv_idx]
         self.coef_ = alphas_sv * Ys[:, sv_idx]
+        self.sv_ids_ = sv_idx.astype(np.int32)
         self.b_ = bs
         self.n_iter_ = iters
         self.statuses_ = statuses
@@ -415,6 +444,11 @@ class OneVsRestSVC:
             "b": self.b_,
             "scale": self.scale,
         }
+        if self.sv_ids_ is not None:
+            # union SV row ids (absent in pre-0.18 artifacts, and in
+            # re-saves of models loaded from them): the OvR refresh warm
+            # seed scatters per-head duals back to these positions
+            state["sv_ids"] = self.sv_ids_
         if self.scale:
             state["scaler_min"] = self.scaler_.min_val
             state["scaler_max"] = self.scaler_.max_val
@@ -430,6 +464,7 @@ class OneVsRestSVC:
         model.classes_ = state["classes"]
         model.X_sv_ = state["sv_X"]
         model.coef_ = state["coef"]
+        model.sv_ids_ = state["sv_ids"] if "sv_ids" in state else None
         model.b_ = state["b"]
         if model.scale:
             model.scaler_ = MinMaxScaler(

@@ -189,6 +189,44 @@ def format_compile_table(rows: List[dict]) -> str:
     return "\n".join(out)
 
 
+def autopilot_rows(records: Iterable[dict]) -> List[dict]:
+    """The autopilot.drift decision events, in tick order."""
+    return [r["attrs"] for r in records
+            if r["kind"] == "event" and r["name"] == "autopilot.drift"]
+
+
+def format_autopilot_table(rows: List[dict], max_rows: int = 40) -> str:
+    """Per-tick drift-decision table: tick, decision, per-detector
+    scores vs their (jittered) thresholds, and the reason string. Long
+    runs elide the middle like the convergence table — the interesting
+    structure is the warm-up and the ticks around a triggered refresh."""
+    if not rows:
+        return "no autopilot decisions in this trace"
+    out = [" tick  decision  detector scores (score/threshold)",
+           " ----  --------  ---------------------------------"]
+    idx = list(range(len(rows)))
+    if len(idx) > max_rows:
+        k = max_rows // 2
+        idx = idx[:k] + [None] + idx[-k:]
+    for i in idx:
+        if i is None:
+            out.append(f"  ... {len(rows) - 2 * (max_rows // 2)} "
+                       "ticks elided ...")
+            continue
+        r = rows[i]
+        rep = r.get("report", {})
+        dets = "  ".join(
+            f"{d['name']}={d['score']:.3g}/{d['threshold']:.3g}"
+            + ("*" if d.get("triggered") else "")
+            for d in rep.get("detectors", []))
+        out.append(f"{r.get('tick', i + 1):>5}  "
+                   f"{'REFRESH' if r.get('decision') else 'watch':>8}  "
+                   f"{dets}")
+        if r.get("decision"):
+            out.append(f"       reason: {r.get('reason', '?')}")
+    return "\n".join(out)
+
+
 def nonzero_counters(records: Iterable[dict]) -> List[str]:
     """`name{labels} value` lines for every non-zero counter/gauge in
     embedded metrics snapshots (merged when several are present)."""
@@ -227,6 +265,10 @@ def render_report(records: List[dict]) -> str:
     conv = convergence_rows(records)
     parts += ["convergence (b_low - b_high per outer round):",
               format_convergence_table(conv), ""]
+    auto = autopilot_rows(records)
+    if auto:
+        parts += ["autopilot (drift decisions per tick):",
+                  format_autopilot_table(auto), ""]
     counters = nonzero_counters(records)
     if counters:
         parts += ["counters:"] + ["  " + line for line in counters] + [""]

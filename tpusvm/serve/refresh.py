@@ -4,17 +4,28 @@ The online-learning loop's missing middle (ROADMAP "Online learning"):
 data arrives, the deployed model goes stale, and until this module the
 only move was a cold retrain + full server restart. A refresh instead:
 
-  1. loads the DEPLOYED artifact and seeds the refit from its alphas
-     (`tune.warm.deployed_seed`: scatter sv_alpha back to full length,
-     zero the appended rows, project feasible — the measured 43.8%
-     update saving of warm vs cold from the tune round, applied to the
-     deployment loop). The refresh training set must keep the deployed
-     run's rows as a prefix (appended micro-batches, the ShardWriter
-     tail contract);
+  1. loads the DEPLOYED artifact and seeds the refit from its duals.
+     The seed construction dispatches on the artifact's task:
+       svc  scatter (sv_ids, sv_alpha) to full length
+            (`tune.warm.deployed_seed`);
+       ovr  per-head |coef| scattered to the union sv_ids, projected
+            feasible against each head's one-vs-rest labels, all heads
+            sharing one hoisted row-norms precompute
+            (`tune.warm.deployed_seed_ovr` + `OneVsRestSVC.fit(
+            warm_seeds=...)`);
+       svr  the doubled-variable inversion beta = [max(coef,0);
+            max(-coef,0)] (`tune.warm.deployed_seed_svr`).
+     In every case the refresh training set must keep the deployed
+     run's rows as a prefix (appended micro-batches — the
+     stream.ShardWriter.open_append tail contract);
   2. runs the fit through `checkpointed_blocked_solve` when a
      checkpoint path is given — a killed refresh resumes BIT-IDENTICAL
-     to an uninterrupted one (the PR 7 carry-snapshot machinery; the
-     kill-at-every-checkpoint test extends to this surface);
+     to an uninterrupted one (the PR 7 carry-snapshot machinery), and
+     an optional `watchdog` deadline callable stops a too-slow fit at a
+     checkpointed segment boundary (solver.checkpoint.WatchdogTimeout)
+     so a supervisor can resume it later. Binary classifiers only — the
+     OvR/SVR outer drivers have no checkpoint surface yet and reject
+     those flags by name;
   3. saves the result atomically (save_model: temp + os.replace — a
      `--watch` directory never sees a torn artifact);
   4. hands the artifact to the running server: in-process
@@ -22,8 +33,8 @@ only move was a cold retrain + full server restart. A refresh instead:
      either way the staged-flip semantics apply and a failed stage
      leaves the old generation serving.
 
-Exact binary classifiers only for now: the warm seed is a dual-space
-object, so approx-primal / OvR / SVR refreshes are rejected by name.
+Approx-primal artifacts are rejected by name for every task: the warm
+seed is a dual-space object.
 """
 
 from __future__ import annotations
@@ -35,6 +46,18 @@ from typing import Optional
 import numpy as np
 
 
+def _reject_approx(cfg, model_path: str) -> None:
+    from tpusvm.config import APPROX_FAMILIES
+
+    if cfg.kernel in APPROX_FAMILIES:
+        raise ValueError(
+            f"refresh warm-starts the DUAL solve; {model_path!r} was "
+            f"trained in the approximate primal regime ({cfg.kernel}) — "
+            "retrain it with `tpusvm train --kernel "
+            f"{cfg.kernel}` on the grown dataset instead"
+        )
+
+
 def refresh_fit(model_path: str, X: np.ndarray, Y: np.ndarray, *,
                 out_path: str,
                 checkpoint_path: Optional[str] = None,
@@ -43,33 +66,49 @@ def refresh_fit(model_path: str, X: np.ndarray, Y: np.ndarray, *,
                 warm: bool = True,
                 dtype=None,
                 accum_dtype="auto",
-                solver_opts: Optional[dict] = None):
+                solver_opts: Optional[dict] = None,
+                watchdog=None):
     """Warm-started (optionally checkpointed) refit of a deployed model.
 
-    Returns the fitted BinarySVC (already saved to `out_path`). `warm=
-    False` is the control arm — the cold refit the warm path's update
-    savings are measured against."""
+    Dispatches on the artifact's task (svc | ovr | svr); Y is labels for
+    the classifiers and continuous targets for SVR. Returns the fitted
+    estimator (already saved to `out_path`). `warm=False` is the control
+    arm — the cold refit the warm path's update savings are measured
+    against. `watchdog` (requires a checkpoint path) is a zero-arg
+    deadline callable: truthy between solve segments raises
+    WatchdogTimeout with the checkpoint durable."""
+    from tpusvm.models import model_task
+
+    if watchdog is not None and checkpoint_path is None:
+        raise ValueError(
+            "watchdog needs checkpoint_path: the deadline stops the fit "
+            "at a checkpointed segment boundary so it can resume"
+        )
+    task = model_task(model_path)
+    if task == "ovr":
+        fit = _refresh_ovr
+    elif task == "svr":
+        fit = _refresh_svr
+    else:
+        fit = _refresh_svc
+    return fit(model_path, X, Y, out_path=out_path,
+               checkpoint_path=checkpoint_path,
+               checkpoint_every=checkpoint_every, resume=resume,
+               warm=warm, dtype=dtype, accum_dtype=accum_dtype,
+               solver_opts=solver_opts, watchdog=watchdog)
+
+
+def _refresh_svc(model_path, X, Y, *, out_path, checkpoint_path,
+                 checkpoint_every, resume, warm, dtype, accum_dtype,
+                 solver_opts, watchdog):
     import jax.numpy as jnp
 
-    from tpusvm.config import APPROX_FAMILIES
-    from tpusvm.models import BinarySVC, model_task
+    from tpusvm.models import BinarySVC
     from tpusvm.tune.warm import deployed_seed
 
-    task = model_task(model_path)
-    if task != "svc":
-        raise ValueError(
-            f"refresh supports binary classifiers; {model_path!r} is a "
-            f"{task!r} artifact (OvR/SVR refresh is a future PR)"
-        )
     base = BinarySVC.load(model_path)
     cfg = base.config
-    if cfg.kernel in APPROX_FAMILIES:
-        raise ValueError(
-            f"refresh warm-starts the DUAL solve; {model_path!r} was "
-            f"trained in the approximate primal regime ({cfg.kernel}) — "
-            "retrain it with `tpusvm train --kernel "
-            f"{cfg.kernel}` on the grown dataset instead"
-        )
+    _reject_approx(cfg, model_path)
     n = int(np.asarray(X).shape[0])
     opts = dict(solver_opts or {})
     if warm:
@@ -78,6 +117,10 @@ def refresh_fit(model_path: str, X: np.ndarray, Y: np.ndarray, *,
         if a0.any():
             opts["alpha0"] = jnp.asarray(a0)
             opts["warm_start"] = True
+    if watchdog is not None:
+        # checkpointed_blocked_solve pops this named kwarg; guarded at
+        # refresh_fit entry so it can never leak into a plain solve
+        opts["watchdog"] = watchdog
     model = BinarySVC(
         config=cfg,
         dtype=dtype if dtype is not None else jnp.float32,
@@ -88,6 +131,85 @@ def refresh_fit(model_path: str, X: np.ndarray, Y: np.ndarray, *,
     )
     model.fit(X, Y, checkpoint_path=checkpoint_path,
               checkpoint_every=checkpoint_every, resume=resume)
+    model.save(out_path)
+    return model
+
+
+def _reject_checkpoint(task: str, checkpoint_path) -> None:
+    if checkpoint_path is not None:
+        raise ValueError(
+            f"checkpointed {task} refresh is a future PR (the {task} "
+            "outer driver has no per-head checkpoint surface yet); drop "
+            "--checkpoint or refresh a binary artifact"
+        )
+
+
+def _refresh_ovr(model_path, X, Y, *, out_path, checkpoint_path,
+                 checkpoint_every, resume, warm, dtype, accum_dtype,
+                 solver_opts, watchdog):
+    import jax.numpy as jnp
+
+    from tpusvm.models import OneVsRestSVC
+    from tpusvm.tune.warm import deployed_seed_ovr
+
+    _reject_checkpoint("OvR", checkpoint_path)
+    base = OneVsRestSVC.load(model_path)
+    cfg = base.config
+    _reject_approx(cfg, model_path)
+    seeds = None
+    if warm:
+        if base.sv_ids_ is None:
+            raise ValueError(
+                f"{model_path!r} predates per-head OvR refresh (no "
+                "sv_ids in the artifact); retrain and re-save it, or "
+                "run a cold refresh (warm=False / --cold)"
+            )
+        seeds = deployed_seed_ovr(base.sv_ids_, base.coef_,
+                                  int(np.asarray(X).shape[0]),
+                                  np.asarray(Y), base.classes_, cfg.C)
+        if not seeds.any():
+            seeds = None
+    model = OneVsRestSVC(
+        config=cfg,
+        dtype=dtype if dtype is not None else jnp.float32,
+        scale=base.scale,
+        accum_dtype=accum_dtype,
+        solver="blocked",
+        solver_opts=dict(solver_opts or {}),
+    )
+    model.fit(X, Y, warm_seeds=seeds)
+    model.save(out_path)
+    return model
+
+
+def _refresh_svr(model_path, X, Y, *, out_path, checkpoint_path,
+                 checkpoint_every, resume, warm, dtype, accum_dtype,
+                 solver_opts, watchdog):
+    import jax.numpy as jnp
+
+    from tpusvm.models.svr import EpsilonSVR
+    from tpusvm.tune.warm import deployed_seed_svr
+
+    _reject_checkpoint("SVR", checkpoint_path)
+    base = EpsilonSVR.load(model_path)
+    cfg = base.config
+    _reject_approx(cfg, model_path)
+    opts = dict(solver_opts or {})
+    if warm:
+        beta0 = deployed_seed_svr(base.sv_ids_, base.sv_coef_,
+                                  int(np.asarray(X).shape[0]), cfg.C)
+        if beta0.any():
+            opts["alpha0"] = jnp.asarray(beta0)
+            opts["warm_start"] = True
+    model = EpsilonSVR(
+        config=cfg,
+        dtype=dtype if dtype is not None else jnp.float32,
+        scale=base.scale,
+        accum_dtype=accum_dtype,
+        solver="blocked",
+        solver_opts=opts,
+    )
+    model.fit(X, Y)
     model.save(out_path)
     return model
 

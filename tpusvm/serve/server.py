@@ -361,6 +361,16 @@ class _ModelWorker:
         self.breaker.record_success()
         for bucket, rows in chunks:
             self.metrics.observe_batch(bucket, rows)
+        if self._gen.entry.kind == "binary":
+            # served-score sign tallies: the drift detector's input
+            # (autopilot.drift.score_shift compares the positive-rate of
+            # the traffic since the last refresh against the baseline
+            # recorded at swap time). Registry counters, not snapshot()
+            # keys — the legacy snapshot schema is frozen by parity tests.
+            pos = int(np.count_nonzero(labels > 0))
+            reg = self.metrics.registry
+            reg.counter("serve.scores_pos").inc(pos)
+            reg.counter("serve.scores_neg").inc(len(labels) - pos)
         return scores, labels
 
     def drain(self, timeout_s: float = 10.0) -> bool:
@@ -587,6 +597,14 @@ class Server:
     # ------------------------------------------------------------ status
     def metrics(self, name: str) -> dict:
         return self._worker(name).metrics.snapshot()
+
+    def score_stats(self, name: str) -> dict:
+        """Cumulative served-score sign tallies for a binary model —
+        the autopilot's score-shift drift input. Both counters are 0
+        for ovr/svr models (no sign semantics)."""
+        reg = self._worker(name).metrics.registry
+        return {"pos": reg.counter("serve.scores_pos").value,
+                "neg": reg.counter("serve.scores_neg").value}
 
     def metrics_text(self) -> str:
         from tpusvm.obs.registry import escape_label_value

@@ -60,6 +60,23 @@ _FP_KEYS = ("C", "gamma", "eps", "tau", "max_iter", "q", "max_outer",
 _STATE_FIELDS = _OuterState._fields
 
 
+class WatchdogTimeout(RuntimeError):
+    """A checkpointed solve exceeded its supervisor's deadline and was
+    stopped BETWEEN segments — after its latest checkpoint was written,
+    so a resume=True re-run continues bit-identically from that carry.
+    The honest in-process "kill a hung fit": XLA segments cannot be
+    interrupted mid-flight, but the segment boundary is a safe,
+    checkpointed stop the autopilot can resume from."""
+
+    def __init__(self, path: str, n_outer: int):
+        self.checkpoint_path = path
+        self.n_outer = n_outer
+        super().__init__(
+            f"solve stopped by watchdog at outer round {n_outer}; resume "
+            f"from checkpoint {path!r}"
+        )
+
+
 def solve_fingerprint(X: np.ndarray, Y: np.ndarray, accum_dtype,
                       solver_kwargs: dict) -> dict:
     """JSON-able identity of a solve: shapes, dtypes, data CRC, config."""
@@ -146,6 +163,7 @@ def checkpointed_blocked_solve(
     checkpoint_every: int = 64,
     resume: bool = False,
     keep_checkpoint: bool = False,
+    watchdog=None,
     accum_dtype=None,
     **solver_kwargs,
 ) -> SMOResult:
@@ -170,6 +188,11 @@ def checkpointed_blocked_solve(
     that the carry supersedes on resume (alpha0/valid/targets are still
     honoured on the FRESH segments). max_iter/max_outer semantics are
     unchanged — they live inside the loop body.
+
+    watchdog: optional zero-arg callable consulted after each segment's
+    checkpoint is durable; returning truthy raises WatchdogTimeout — the
+    supervisor's deadline enforcement (a later resume=True run continues
+    bit-identically from the checkpoint just written).
     """
     if checkpoint_every < 1:
         raise ValueError(
@@ -199,3 +222,5 @@ def checkpointed_blocked_solve(
                 os.remove(checkpoint_path)
             return res
         save_solver_state(checkpoint_path, state, fp, retry=retry)
+        if watchdog is not None and watchdog():
+            raise WatchdogTimeout(checkpoint_path, int(state.n_outer))

@@ -114,6 +114,41 @@ class StreamStatus(enum.IntEnum):
     READ_FAILED = 5
 
 
+class AutopilotStatus(enum.IntEnum):
+    """Per-tick outcome codes for the online-learning supervisor
+    (tpusvm.autopilot). Every tick ends in exactly one of these, so
+    "why did (or didn't) the autopilot retrain" is always an explicit
+    code the tests, the obs counters and `tpusvm report` branch on:
+
+      WATCHING             no detector triggered; nothing to do
+      TRIGGERED_HYSTERESIS a detector triggered but fewer than
+                           `hysteresis` consecutive ticks have — a noisy
+                           detector can't thrash retrains
+      SUPPRESSED_COOLDOWN  triggered, but the post-refresh cooldown has
+                           not elapsed
+      SUPPRESSED_BREAKER   triggered, but the refresh circuit breaker is
+                           OPEN (repeated refresh failures tripped it) —
+                           degraded-watch mode instead of hot-looping a
+                           poisoned batch
+      REFRESHED            refresh fit + save + swap all succeeded; the
+                           new generation is live
+      REFRESH_FAILED       the refresh stage raised (fit error, swap
+                           rollback, injected fault); counted by the
+                           breaker, retried on a later tick
+      REFRESH_TIMEOUT      the watchdog deadline stopped the fit at a
+                           checkpointed segment boundary; the next
+                           eligible tick resumes it from its checkpoint
+    """
+
+    WATCHING = 0
+    TRIGGERED_HYSTERESIS = 1
+    SUPPRESSED_COOLDOWN = 2
+    SUPPRESSED_BREAKER = 3
+    REFRESHED = 4
+    REFRESH_FAILED = 5
+    REFRESH_TIMEOUT = 6
+
+
 class TuneStatus(enum.IntEnum):
     """Per-grid-point outcome codes for hyperparameter search (tpusvm.tune).
 

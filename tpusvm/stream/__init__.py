@@ -22,12 +22,17 @@ consumer streams:
               manifest; shard-streamed Partition construction; row
               gathering for tune folds
   infer.py    predict_stream / evaluate_stream over prefetched batches
+  append.py   crash-safe tail append: ShardWriter.open_append reopens a
+              committed dataset and grows it bit-identically to a
+              one-shot ingest of the concatenation, exactly-once under
+              kill (per-batch CRC journal ledger)
 
 CLI: `tpusvm ingest` writes a dataset; `tpusvm train --data`,
 `tpusvm predict --data`, `tpusvm tune --data`, and `tpusvm info <dir>`
 consume one.
 """
 
+from tpusvm.stream.append import AppendError, AppendWriter, append_blocks
 from tpusvm.stream.assign import (
     RowAssignment,
     assign_rows,
@@ -58,6 +63,8 @@ from tpusvm.stream.stats import (
 )
 
 __all__ = [
+    "AppendError",
+    "AppendWriter",
     "FORMAT_VERSION",
     "Manifest",
     "RowAssignment",
@@ -67,6 +74,7 @@ __all__ = [
     "ShardStats",
     "ShardWriter",
     "ShardedDataset",
+    "append_blocks",
     "assign_rows",
     "compute_stats",
     "evaluate_stream",

@@ -237,6 +237,21 @@ class ShardWriter:
         if resume:
             self._load_journal()
 
+    @classmethod
+    def open_append(cls, out_dir: str,
+                    rows_per_shard: Optional[int] = None,
+                    resume: bool = False):
+        """Reopen a COMMITTED dataset directory and append to its tail.
+
+        Returns a stream.append.AppendWriter: the grown dataset is
+        bit-identical (shard layout, stats, manifest) to a one-shot
+        ingest of the concatenated data, with exactly-once crash safety
+        journaled per batch (see tpusvm/stream/append.py)."""
+        from tpusvm.stream.append import AppendWriter
+
+        return AppendWriter(out_dir, rows_per_shard=rows_per_shard,
+                            resume=resume)
+
     # ------------------------------------------------------- crash safety
     @property
     def rows_durable(self) -> int:
@@ -280,6 +295,9 @@ class ShardWriter:
             raise ValueError(
                 f"unsupported ingest journal version "
                 f"{obj.get('journal_version')!r} in {jp!r}"
+                + (" — this is an APPEND-session journal; resume it "
+                   "with ShardWriter.open_append(dir, resume=True)"
+                   if obj.get("mode") == "append" else "")
             )
         for key, have in (("rows_per_shard", self.rows_per_shard),
                           ("binary", self.binary),

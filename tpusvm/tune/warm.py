@@ -87,6 +87,64 @@ def deployed_seed(sv_ids: np.ndarray, sv_alpha: np.ndarray, n_rows: int,
     return feasible_seed(a, Y, C)
 
 
+def deployed_seed_ovr(sv_ids: np.ndarray, coef: np.ndarray, n_rows: int,
+                      labels: np.ndarray, classes: np.ndarray,
+                      C: float) -> np.ndarray:
+    """(K, n) per-head alpha0 seeds for an OvR refresh, from the
+    deployed artifact's signed coefficients.
+
+    The OvR state stores coef = alpha * y per head over the SV union
+    (models/ovr.py), so each head's duals recover as |coef[k]| (alpha is
+    non-negative and y carries the sign). Every head scatters to its
+    original row positions (the shared prefix-extension contract) and is
+    projected feasible against ITS one-vs-rest labels — the heads share
+    rows but not label vectors, so the equality-constraint repair is
+    per-head."""
+    ids = np.asarray(sv_ids, np.int64)
+    if ids.size and int(ids.max()) >= n_rows:
+        raise ValueError(
+            f"deployed OvR model's SV ids reach row {int(ids.max())} but "
+            f"the refresh training set has only {n_rows} rows — refresh "
+            "requires the deployed run's rows as a prefix of the new data"
+        )
+    coef = np.asarray(coef, np.float64)
+    labels = np.asarray(labels)
+    seeds = np.zeros((len(classes), n_rows), np.float64)
+    for k, c in enumerate(classes):
+        a = np.zeros(n_rows, np.float64)
+        a[ids] = np.abs(coef[k])
+        yk = np.where(labels == c, 1, -1).astype(np.int32)
+        seeds[k] = feasible_seed(a, yk, C)
+    return seeds
+
+
+def deployed_seed_svr(sv_ids: np.ndarray, sv_coef: np.ndarray,
+                      n_rows: int, C: float) -> np.ndarray:
+    """Doubled-variable beta0 seed (length 2n) for an SVR refresh.
+
+    The SVR state stores signed coef_i = alpha_i - alpha*_i; at any SMO
+    optimum the twin duals never overlap (alpha_i * alpha*_i == 0), so
+    the doubling inverts exactly: beta_i = max(coef_i, 0) on the +1 half
+    and beta_{n+i} = max(-coef_i, 0) on the -1 half
+    (tpusvm.kernels.svr.doubled_problem's label convention). Projected
+    feasible against the doubled labels — sum(coef) == 0 at the donor
+    optimum, so the repair only bites after box clipping."""
+    ids = np.asarray(sv_ids, np.int64)
+    if ids.size and int(ids.max()) >= n_rows:
+        raise ValueError(
+            f"deployed SVR model's SV ids reach row {int(ids.max())} but "
+            f"the refresh training set has only {n_rows} rows — refresh "
+            "requires the deployed run's rows as a prefix of the new data"
+        )
+    coef = np.asarray(sv_coef, np.float64)
+    beta = np.zeros(2 * n_rows, np.float64)
+    beta[ids] = np.maximum(coef, 0.0)
+    beta[n_rows + ids] = np.maximum(-coef, 0.0)
+    Y2 = np.concatenate([np.ones(n_rows, np.int32),
+                         -np.ones(n_rows, np.int32)])
+    return feasible_seed(beta, Y2, C)
+
+
 class WarmStore:
     """Per-fold memory of solved points' alphas, queried by log-space
     nearest neighbour.
