@@ -117,7 +117,7 @@ def main() -> int:
         X, Y = mnist_like(n=n, d=d, seed=587)
     cfg = SVMConfig(C=10.0, gamma=(10.0 if args.smoke else 1.0 / d))
 
-    out = open(args.jsonl, "w") if args.jsonl else None
+    out = open(args.jsonl + ".tmp", "w") if args.jsonl else None
 
     def row(rec):
         rec = {"bench": "cold_start", "smoke": bool(args.smoke),
@@ -170,6 +170,7 @@ def main() -> int:
             })
     if out:
         out.close()
+        os.replace(args.jsonl + ".tmp", args.jsonl)
     if failures:
         for f in failures:
             log(f"COLD-START GATE FAILED: {f}")

@@ -1,0 +1,43 @@
+# tpusvm: durable-protocol=kill-safe
+"""Known-good durability corpus: everything the JXD rules demand —
+staged temps committed with fsync_replace in the target's directory,
+every commit behind a registered fault point, the version field gated
+by the reader, and the journal deleted only after its artifact lands."""
+
+import json
+import os
+
+from tpusvm import faults
+from tpusvm.utils.durable import fsync_replace
+
+STATE_VERSION = 2
+
+
+def save_state(path, payload):
+    faults.point("autopilot.state", path=path)
+    obj = {"state_version": STATE_VERSION, **payload}
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(obj, f)
+        f.write("\n")
+    fsync_replace(tmp, path)
+
+
+def load_state(path):
+    with open(path) as f:
+        obj = json.load(f)
+    if obj.get("state_version") != STATE_VERSION:
+        raise ValueError(f"unsupported state_version in {path!r}")
+    return obj
+
+
+def commit_session(out_dir, manifest):
+    faults.point("stream.journal", commit=True)
+    tmp = os.path.join(out_dir, "manifest.json.tmp")
+    with open(tmp, "w") as f:
+        json.dump(manifest, f)
+    fsync_replace(tmp, os.path.join(out_dir, "manifest.json"))
+    # the manifest supersedes the journal: delete last
+    journal_path = os.path.join(out_dir, "journal.json")
+    if os.path.exists(journal_path):
+        os.remove(journal_path)

@@ -1,0 +1,12 @@
+"""JXD301 corpus: a durable artifact written straight onto its final
+path. A kill (or ENOSPC) mid-`json.dump` leaves a torn half-file under
+the committed name — the next reader gets a JSONDecodeError instead of
+the previous complete artifact."""
+
+import json
+
+
+def save_report(path, payload):
+    with open(path, "w") as f:  # BAD: no staged temp + os.replace
+        json.dump(payload, f)
+        f.write("\n")

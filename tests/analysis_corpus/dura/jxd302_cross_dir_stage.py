@@ -1,0 +1,16 @@
+"""JXD302 corpus: the temp is staged under tempfile's directory while
+the rename target lives in the caller's output directory. When /tmp and
+the data volume are different filesystems, os.replace raises EXDEV —
+and any fallback degrades to copy+delete, which is not atomic."""
+
+import json
+import os
+import tempfile
+
+
+def commit_report(out_dir, payload):
+    tmp = os.path.join(tempfile.gettempdir(), "report.json.tmp")
+    with open(tmp, "w") as f:
+        json.dump(payload, f)
+    # BAD: staged in tempfile's dir, committed into out_dir
+    os.replace(tmp, os.path.join(out_dir, "report.json"))

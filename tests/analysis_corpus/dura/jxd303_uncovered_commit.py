@@ -1,0 +1,22 @@
+# tpusvm: durable-protocol
+"""JXD303 corpus: a durable-state commit with no faults.point in its
+enclosing function (the chaos plans and the derived crash-window matrix
+cannot see it), plus a point literal naming an unregistered point."""
+
+import json
+import os
+
+from tpusvm import faults
+
+
+def commit_state(path, payload):
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(payload, f)
+    os.replace(tmp, path)  # BAD: no faults.point guards this commit
+
+
+def tick():
+    # BAD: not in faults/injection.py POINTS — an active plan would
+    # reject it at the call site
+    faults.point("no.such.point")

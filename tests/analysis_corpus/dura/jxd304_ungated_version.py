@@ -1,0 +1,28 @@
+# tpusvm: durable-protocol
+"""JXD304 corpus: the writer stamps format_version but the module's
+reader never gates it — a file written by a different build half-parses
+(or KeyErrors on whichever field moved) instead of failing with a
+version error that names the mismatch."""
+
+import json
+import os
+
+from tpusvm import faults
+
+FORMAT_VERSION = 3
+
+
+def save_table(path, rows):
+    faults.point("models.save", path=path)
+    # BAD: "format_version" is written but load_table never checks it
+    obj = {"format_version": FORMAT_VERSION, "rows": rows}
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(obj, f)
+    os.replace(tmp, path)
+
+
+def load_table(path):
+    with open(path) as f:
+        obj = json.load(f)
+    return obj["rows"]

@@ -1,0 +1,16 @@
+"""JXD305 corpus: the recovery journal is deleted BEFORE the artifact
+it covers is committed. A kill between the delete and the rename leaves
+an uncommitted directory whose journal — the only way to resume — is
+already gone: commit first, delete the journal last."""
+
+import json
+import os
+
+
+def commit_session(out_dir, manifest):
+    journal_path = os.path.join(out_dir, "journal.json")
+    os.remove(journal_path)  # BAD: journal gone, commit still pending
+    tmp = os.path.join(out_dir, "manifest.json.tmp")
+    with open(tmp, "w") as f:
+        json.dump(manifest, f)
+    os.replace(tmp, os.path.join(out_dir, "manifest.json"))

@@ -1,0 +1,19 @@
+# tpusvm: durable-protocol=kill-safe
+"""JXD306 corpus: a kill-safe protocol committing with a bare
+os.replace. The filesystem may journal the rename before the staged
+file's data blocks reach disk, so a power loss can commit the NAME of
+a hollow file — flush+fsync the staged bytes first (the sanctioned
+spelling is tpusvm.utils.durable.fsync_replace)."""
+
+import json
+import os
+
+from tpusvm import faults
+
+
+def commit_journal(path, obj):
+    faults.point("stream.journal", path=path)
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(obj, f)
+    os.replace(tmp, path)  # BAD: rename can outrun the staged bytes

@@ -331,7 +331,8 @@ def test_ci_lint_sweep_covers_all_roots():
     lint_lines = [ln for ln in ci.splitlines()
                   if "python -m tpusvm.analysis" in ln
                   and "ir-audit" not in ln
-                  and "analysis conc" not in ln]
+                  and "analysis conc" not in ln
+                  and "analysis dura" not in ln]
     assert lint_lines, "CI has no tpusvm-lint invocation"
     sweep = " ".join(lint_lines)
     for root in ("tpusvm/", "benchmarks/", "scripts/", "bench.py"):
@@ -347,6 +348,16 @@ def test_ci_lint_sweep_covers_all_roots():
     for root in ("tpusvm/", "benchmarks/", "scripts/", "bench.py"):
         assert root in conc_sweep, (
             f"CI conc sweep is missing the {root} root: {conc_sweep!r}")
+    # and the durability linter (tpusvm/analysis/dura) sweeps the SAME
+    # roots again — a root missing here would let unstaged final-path
+    # writes land unlinted (test_dura.py pins the rest of the dura CI
+    # wiring, including the derived crash-window matrix smoke)
+    dura_lines = [ln for ln in ci.splitlines()
+                  if "tpusvm.analysis dura " in ln]
+    dura_sweep = " ".join(dura_lines)
+    for root in ("tpusvm/", "benchmarks/", "scripts/", "bench.py"):
+        assert root in dura_sweep, (
+            f"CI dura sweep is missing the {root} root: {dura_sweep!r}")
 
 
 def test_ci_self_corpus_expects_every_rule():

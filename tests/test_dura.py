@@ -1,0 +1,499 @@
+"""Tests for tpusvm.analysis.dura — the two-armed durability auditor.
+
+Static arm: every JXD rule fires on its known-bad corpus snippet under
+tests/analysis_corpus/dura/ (and nothing else fires there), the
+write-protocol model extraction is right, durable-by suppressions
+document their invariant, the baseline grandfathers, the AST-parsed
+fault-point universe matches the runtime registry, and the repo itself
+lints JXD-clean against the committed EMPTY baseline.
+
+Dynamic arm: the derived point universe is fully claimed by the
+recovery scenarios, the generated kill-window plan is byte-identical
+per seed, a real kill window recovers to the control digest, and the
+journal/commit hot paths fsync their staged bytes before renaming
+(pinned with a monkeypatched os.fsync).
+"""
+
+import json
+from pathlib import Path
+
+import pytest
+
+from tpusvm.analysis.dura import (
+    DURA_RULE_SUMMARIES,
+    DURABLE_MODULES,
+    all_dura_rules,
+    dura_lint_file,
+    dura_lint_paths,
+    dura_lint_source,
+    registered_points,
+)
+
+REPO = Path(__file__).resolve().parent.parent
+CORPUS = REPO / "tests" / "analysis_corpus" / "dura"
+DURA_RULE_IDS = ("JXD301", "JXD302", "JXD303", "JXD304", "JXD305",
+                 "JXD306")
+
+
+# ---------------------------------------------------------------- registry
+def test_registry_has_all_dura_rules():
+    rules = all_dura_rules()
+    assert tuple(sorted(rules)) == DURA_RULE_IDS
+    for rid, rule in rules.items():
+        assert rule.id == rid
+        assert rule.summary
+    assert set(DURA_RULE_SUMMARIES) == set(DURA_RULE_IDS)
+
+
+def test_unknown_select_is_rejected():
+    with pytest.raises(ValueError, match="unknown dura rule"):
+        dura_lint_source("x = 1\n", select={"JXD999"})
+
+
+def test_durable_module_registry_names_real_files():
+    for suffix in DURABLE_MODULES:
+        assert (REPO / suffix).exists(), (
+            f"DURABLE_MODULES names {suffix}, which does not exist — "
+            "keep the registry in step with the tree"
+        )
+
+
+# ------------------------------------------------------------------ corpus
+@pytest.mark.parametrize("rule_id", DURA_RULE_IDS)
+def test_rule_fires_on_its_corpus_snippet(rule_id):
+    matches = sorted(CORPUS.glob(f"{rule_id.lower()}_*.py"))
+    assert matches, f"no dura corpus file for {rule_id}"
+    findings, _ = dura_lint_file(matches[0])
+    fired = {f.rule for f in findings}
+    assert rule_id in fired, (
+        f"{rule_id} did not fire on {matches[0].name}; got {fired}"
+    )
+    # single-hazard by construction: a precision regression in ANY rule
+    # shows up as an extra id here
+    assert fired == {rule_id}, (
+        f"extra rules fired on {matches[0].name}: {fired - {rule_id}}"
+    )
+
+
+def test_clean_corpus_is_clean():
+    findings, suppressed = dura_lint_file(CORPUS / "clean.py")
+    assert findings == []
+    assert suppressed == []
+
+
+def test_corpus_findings_are_located():
+    for f in CORPUS.glob("jxd*.py"):
+        findings, _ = dura_lint_file(f)
+        for finding in findings:
+            assert finding.line >= 1 and finding.col >= 1
+            assert finding.snippet
+            assert finding.fingerprint and len(finding.fingerprint) == 12
+
+
+def test_parse_failure_is_a_finding():
+    findings, _ = dura_lint_source("def broken(:\n")
+    assert [f.rule for f in findings] == ["JXD300"]
+
+
+# ----------------------------------------------------------- model extraction
+def _model(src: str, path: str = "<test>"):
+    from tpusvm.analysis.context import ModuleContext
+    from tpusvm.analysis.dura.model import DuraModel
+
+    return DuraModel(ModuleContext(path, src))
+
+
+_MODEL_SRC = '''
+import io
+import json
+import os
+
+from tpusvm import faults
+
+VERSION = 2
+
+
+def commit(path, payload):
+    faults.point("models.save", path=path)
+    obj = {"format_version": VERSION, "rows": payload}
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(obj, f)
+    os.replace(tmp, path)
+
+
+def read(path):
+    with open(path) as f:
+        obj = json.load(f)
+    if obj.get("format_version") != VERSION:
+        raise ValueError(path)
+    return obj["rows"]
+
+
+def buffered():
+    import numpy as np
+    buf = io.BytesIO()
+    np.savez(buf, x=1)
+'''
+
+
+def test_model_extraction():
+    m = _model(_MODEL_SRC)
+    by_name = {s.name: s for s in m.scopes}
+    commit = by_name["commit"]
+    # one staged write + one replace; open(path) in read() has mode "r"
+    assert len(commit.writes) == 1 and commit.writes[0].mode == "w"
+    assert len(commit.replaces) == 1 and not commit.replaces[0].fsynced
+    assert by_name["read"].writes == []
+    # the version field is written AND gated
+    assert ("format_version", ) == tuple(k for k, _ in m.version_writes)
+    assert "format_version" in m.read_keys
+    assert m.has_readers
+    # the point literal is extracted; the commit site is covered
+    assert [lit for _, lit in m.point_calls] == ["models.save"]
+    assert m.point_covered(commit.replaces[0].node)
+    # savez onto a BytesIO is not a durable write
+    assert by_name["buffered"].writes == []
+    # the staged write is recognised as covered by the rename protocol
+    assert m.write_is_staged(commit.writes[0], commit)
+
+
+def test_durable_status_registry_and_pragma():
+    from tpusvm.analysis.dura.model import durable_status
+
+    assert durable_status("tpusvm/stream/format.py", "") == (True, True)
+    assert durable_status("tpusvm/serve/cache.py", "") == (True, False)
+    assert durable_status("x.py", "# tpusvm: durable-protocol\n") == \
+        (True, False)
+    assert durable_status(
+        "x.py", "# tpusvm: durable-protocol=kill-safe\n") == (True, True)
+    assert durable_status("x.py", "") == (False, False)
+
+
+def test_dir_identity_shapes():
+    src = (
+        "import os\nimport tempfile\n\n"
+        "def f(out_dir, path):\n"
+        "    a = os.path.join(out_dir, 'x.tmp')\n"
+        "    b = os.path.join(tempfile.gettempdir(), 'x.tmp')\n"
+        "    c = path + '.tmp'\n"
+        "    os.replace(a, os.path.join(out_dir, 'x'))\n"
+        "    os.replace(b, os.path.join(out_dir, 'y'))\n"
+        "    os.replace(c, path)\n"
+    )
+    m = _model(src)
+    scope = {s.name: s for s in m.scopes}["f"]
+    idents = [
+        (m.dir_identity(r.src, scope), m.dir_identity(r.dst, scope))
+        for r in sorted(scope.replaces, key=lambda r: r.node.lineno)
+    ]
+    assert idents[0][0] == idents[0][1] == ("join", "out_dir")
+    assert idents[1][0][0] == "tempfile" and idents[1][1][0] == "join"
+    assert idents[2][0] == idents[2][1] == ("sibling", "dir(path)")
+
+
+# ------------------------------------------------------------ suppressions
+_BAD = ("import json\n\n"
+        "def save(path, obj):\n"
+        "    with open(path, 'w') as f:\n"
+        "        json.dump(obj, f)\n")
+
+
+def test_durable_by_annotation_suppresses_and_documents():
+    src = _BAD.replace(
+        "    with open(path, 'w') as f:",
+        "    # tpusvm: durable-by=single-writer scratch file, re-derived"
+        " on any read error\n"
+        "    with open(path, 'w') as f:")
+    active, suppressed = dura_lint_source(src)
+    assert active == []
+    assert [f.rule for f in suppressed] == ["JXD301"]
+
+
+def test_empty_durable_by_does_not_suppress():
+    src = _BAD.replace(
+        "    with open(path, 'w') as f:",
+        "    # tpusvm: durable-by=\n"
+        "    with open(path, 'w') as f:")
+    active, _ = dura_lint_source(src)
+    assert [f.rule for f in active] == ["JXD301"]
+
+
+def test_disable_comment_also_works():
+    src = _BAD.replace(
+        "    with open(path, 'w') as f:",
+        "    with open(path, 'w') as f:  # tpusvm: disable=JXD301")
+    active, suppressed = dura_lint_source(src)
+    assert active == []
+    assert [f.rule for f in suppressed] == ["JXD301"]
+
+
+# ---------------------------------------------------------------- baseline
+def test_baseline_grandfathers_dura_findings(tmp_path):
+    from tpusvm.analysis.baseline import load_baseline, write_baseline
+
+    target = CORPUS / "jxd301_unstaged_write.py"
+    findings, _ = dura_lint_file(target)
+    assert findings
+    bl = tmp_path / "dura_bl.json"
+    write_baseline(bl, findings)
+    result = dura_lint_paths([str(target)], baseline=load_baseline(bl))
+    assert result.findings == []
+    assert len(result.baselined) == len(findings)
+    assert result.exit_code == 0
+
+
+def test_committed_dura_baseline_is_empty():
+    from tpusvm.analysis.baseline import load_baseline
+
+    path = REPO / ".tpusvm-dura-baseline.json"
+    assert path.exists(), "committed dura baseline is missing"
+    assert load_baseline(path) == set(), (
+        "the dura baseline must stay EMPTY — fix findings or suppress "
+        "them with a documented durable-by annotation"
+    )
+
+
+# ---------------------------------------------------------- repo dura gate
+def test_repo_lints_dura_clean():
+    """The CI dura gate, in-process: the repo's own trees produce zero
+    unsuppressed JXD findings (the trace rotation and the fsync_replace
+    helper itself carry documented durable-by annotations)."""
+    result = dura_lint_paths(
+        [str(REPO / "tpusvm"), str(REPO / "benchmarks"),
+         str(REPO / "scripts"), str(REPO / "bench.py")])
+    assert result.findings == [], "\n".join(
+        f.render() for f in result.findings)
+    assert result.files_scanned > 50
+    assert len(result.suppressed) >= 2
+
+
+# ----------------------------------------------------- fault-point universe
+def test_registered_points_parse_matches_runtime():
+    """The lint arm AST-parses POINTS so it never imports numpy; this
+    pins the parse against the imported runtime set (drift here would
+    silently disable the JXD303 cross-check)."""
+    from tpusvm.faults.injection import POINTS
+
+    assert registered_points() == POINTS
+
+
+def test_new_commit_points_are_registered():
+    from tpusvm.faults.injection import POINTS
+
+    for point in ("stream.journal", "models.save", "serve.state_write",
+                  "autopilot.state", "cascade.checkpoint"):
+        assert point in POINTS
+
+
+def test_uncovered_commit_in_durable_module_fires():
+    src = ("# tpusvm: durable-protocol\n"
+           "import json\nimport os\n\n"
+           "def commit(path, obj):\n"
+           "    tmp = path + '.tmp'\n"
+           "    with open(tmp, 'w') as f:\n"
+           "        json.dump(obj, f)\n"
+           "    os.replace(tmp, path)\n")
+    active, _ = dura_lint_source(src, select={"JXD303"})
+    assert [f.rule for f in active] == ["JXD303"]
+
+
+# ------------------------------------------------------------- dynamic arm
+def test_derived_points_are_claimed_by_scenarios():
+    """The coverage contract: every write-guarding point the static
+    model derives must be claimed by some recovery scenario — adding a
+    guarded durable write without matrix coverage fails here (and in
+    derive_plan, and in CI)."""
+    from tpusvm.analysis.dura.matrix import SCENARIOS, derive_points
+
+    derived = derive_points()
+    assert set(derived) == {
+        "ingest.write_shard", "stream.journal", "stream.append",
+        "solver.outer_checkpoint", "models.save", "serve.state_write",
+        "autopilot.state", "cascade.checkpoint",
+    }, "write-guarding point universe drifted — update the scenarios"
+    claimed = set()
+    for sc in SCENARIOS.values():
+        claimed |= sc.points
+    assert set(derived) <= claimed
+    # read-side points never produce kill windows
+    assert "cache.read" not in derived
+    assert "stream.read_shard" not in derived
+
+
+def test_derive_plan_is_deterministic_by_seed():
+    """Same seed => byte-identical rendered plan (the reproduce-by-seed
+    contract). Uses the cheap pure-python scenarios to keep the control
+    runs fast."""
+    from tpusvm.analysis.dura.matrix import derive_plan, render_plan
+
+    names = ["autopilot_state", "serve_state"]
+    a = render_plan(derive_plan(seed=7, scenarios=names))
+    b = render_plan(derive_plan(seed=7, scenarios=names))
+    assert a == b
+    doc = json.loads(a)
+    assert doc["kind"] == "tpusvm-dura-matrix-plan"
+    assert doc["seed"] == 7
+    assert doc["windows"], "control runs derived no kill windows"
+    for w in doc["windows"]:
+        assert w["at_hit"] >= 1 and w["point"] in doc["derived_points"]
+
+
+def test_matrix_window_kills_and_recovers():
+    """One real window end-to-end: the generated kill rule fires, the
+    recovery run completes, and the recovered digest equals control."""
+    from tpusvm.analysis.dura.matrix import derive_plan, run_matrix
+
+    plan = derive_plan(seed=3, scenarios=["autopilot_state"],
+                       max_windows=1)
+    report = run_matrix(plan)
+    assert report.results, "no windows ran"
+    assert report.ok, report.render()
+    assert "recovered == control" in report.render()
+
+
+def test_matrix_scenario_docs_and_points():
+    from tpusvm.analysis.dura.matrix import SCENARIOS
+
+    for name, sc in SCENARIOS.items():
+        assert sc.name == name
+        assert sc.points and sc.doc
+
+
+# ----------------------------------------------------- fsync-before-rename
+def test_fsync_replace_syncs_before_renaming(tmp_path, monkeypatch):
+    """The helper's contract: the staged fd is fsync'd, then renamed —
+    pinned by spying both syscalls and asserting the order."""
+    import os
+
+    from tpusvm.utils.durable import fsync_replace
+
+    events = []
+    real_fsync, real_replace = os.fsync, os.replace
+    monkeypatch.setattr(
+        os, "fsync", lambda fd: (events.append("fsync"), real_fsync(fd)))
+    monkeypatch.setattr(
+        os, "replace",
+        lambda a, b: (events.append("replace"), real_replace(a, b)))
+    tmp = tmp_path / "x.tmp"
+    tmp.write_text("payload")
+    fsync_replace(str(tmp), str(tmp_path / "x"))
+    assert events == ["fsync", "replace"]
+    assert (tmp_path / "x").read_text() == "payload"
+    assert not tmp.exists()
+
+
+def test_journal_hot_paths_fsync(tmp_path, monkeypatch):
+    """The satellite pin: the ingest journal, the append commit and the
+    autopilot state write all flush+fsync their staged bytes before the
+    rename (a bare os.replace here regresses JXD306 kill-safety)."""
+    import os
+
+    import numpy as np
+
+    from tpusvm.autopilot.state import AutopilotState, save_state
+    from tpusvm.stream.append import append_blocks
+    from tpusvm.stream.format import ingest_arrays
+
+    counts = {"n": 0}
+    real_fsync = os.fsync
+    monkeypatch.setattr(
+        os, "fsync",
+        lambda fd: (counts.__setitem__("n", counts["n"] + 1),
+                    real_fsync(fd))[1])
+
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(48, 3))
+    Y = np.where(rng.random(48) < 0.5, 1, -1)
+    ds = tmp_path / "ds"
+    ingest_arrays(str(ds), X, Y, rows_per_shard=16)
+    after_ingest = counts["n"]
+    assert after_ingest > 0, "fresh ingest never fsync'd"
+
+    append_blocks(str(ds), [(X[:8], Y[:8])])
+    after_append = counts["n"]
+    assert after_append > after_ingest, "append commit never fsync'd"
+
+    save_state(str(tmp_path / "ap.json"), AutopilotState(seed=1))
+    assert counts["n"] > after_append, "autopilot state never fsync'd"
+
+
+# --------------------------------------------------------------------- CLI
+def test_cli_dura_dispatch_and_exit_codes(capsys):
+    from tpusvm.analysis.cli import main
+
+    rc = main(["dura", str(CORPUS / "jxd301_unstaged_write.py"),
+               "--no-baseline"])
+    assert rc == 1
+    assert "JXD301" in capsys.readouterr().out
+    rc = main(["dura", str(CORPUS / "clean.py"), "--no-baseline"])
+    assert rc == 0
+
+
+def test_cli_dura_json_schema(capsys):
+    from tpusvm.analysis.cli import main
+
+    rc = main(["dura", str(CORPUS / "jxd305_journal_before_commit.py"),
+               "--format", "json", "--no-baseline"])
+    assert rc == 1
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["tool"] == "tpusvm.analysis.dura"
+    assert set(doc["rules"]) == set(DURA_RULE_IDS)
+    assert doc["counts"]["JXD305"] == len(doc["findings"])
+    for f in doc["findings"]:
+        assert set(f) == {"rule", "path", "line", "col", "message",
+                          "snippet", "fingerprint"}
+
+
+def test_cli_dura_list_rules(capsys):
+    from tpusvm.analysis.cli import main
+
+    assert main(["dura", "--list-rules"]) == 0
+    out = capsys.readouterr().out
+    for rid in DURA_RULE_IDS:
+        assert rid in out
+
+
+def test_cli_main_list_rules_includes_dura(capsys):
+    from tpusvm.analysis.cli import main
+
+    assert main(["--list-rules"]) == 0
+    out = capsys.readouterr().out
+    assert "JXD301" in out and "[dura]" in out
+
+
+def test_cli_dura_matrix_list_scenarios(capsys):
+    from tpusvm.analysis.cli import main
+
+    assert main(["dura-matrix", "--list-scenarios"]) == 0
+    out = capsys.readouterr().out
+    for name in ("ingest", "append", "checkpoint", "model_save",
+                 "serve_state", "autopilot_state", "cascade_ckpt"):
+        assert name in out
+
+
+def test_cli_dura_matrix_unknown_scenario_is_usage_error(capsys):
+    from tpusvm.analysis.cli import main
+
+    rc = main(["dura-matrix", "--scenario", "nope", "--list-windows"])
+    assert rc == 2
+    assert "unknown scenario" in capsys.readouterr().err
+
+
+def test_ci_has_dura_lint_and_matrix_steps():
+    """The dura gates must be wired: a dura lint sweep over every Python
+    root (empty-baseline diff), dura --list-rules in the no-jax lint
+    job, the self-corpus derivation from all_dura_rules(), and the
+    derived crash-window matrix smoke in the test job."""
+    ci = (REPO / ".github" / "workflows" / "ci.yml").read_text(
+        encoding="utf-8")
+    dura_lines = [ln for ln in ci.splitlines()
+                  if "tpusvm.analysis dura " in ln]
+    sweep = " ".join(dura_lines)
+    for root in ("tpusvm/", "benchmarks/", "scripts/", "bench.py"):
+        assert root in sweep, (
+            f"CI dura lint sweep is missing the {root} root: {sweep!r}")
+    assert "dura --list-rules" in ci
+    assert "all_dura_rules" in ci
+    assert 'glob("tests/analysis_corpus/dura/*.py")' in ci
+    assert "dura-matrix --smoke" in ci

@@ -13,6 +13,7 @@ state this repo ships in.
 from __future__ import annotations
 
 import json
+import os
 from pathlib import Path
 from typing import List, Set, Tuple
 
@@ -51,5 +52,6 @@ def write_baseline(path, findings: List[Finding]) -> None:
                             key=lambda f: (f.path, f.line, f.rule))
         ],
     }
-    Path(path).write_text(json.dumps(doc, indent=2) + "\n",
-                          encoding="utf-8")
+    tmp = Path(str(path) + ".tmp")
+    tmp.write_text(json.dumps(doc, indent=2) + "\n", encoding="utf-8")
+    os.replace(tmp, path)

@@ -12,6 +12,12 @@ need jax and runs in the CI test job on JAX_PLATFORMS=cpu.
 linter (tpusvm.analysis.conc — rules JXC201-206, stdlib-only like this
 one); `conc-stress [...]` runs its seeded schedule-perturbation race
 harness against the real threaded objects (test-job, needs numpy/jax).
+
+`python -m tpusvm.analysis dura [...]` dispatches to the crash-safety &
+atomicity auditor (tpusvm.analysis.dura — rules JXD301-306, stdlib-only);
+`dura-matrix [...]` runs the derived crash-window matrix: kill windows
+enumerated from the static write-protocol model, executed through the
+recovery scenarios (test-job, needs numpy/jax).
 """
 
 from __future__ import annotations
@@ -86,6 +92,21 @@ def main(argv=None) -> int:
         from tpusvm.analysis.conc.cli import stress_main
 
         return stress_main(argv[1:])
+    if argv and argv[0] == "dura":
+        # the crash-safety & atomicity auditor (rules JXD301-306) —
+        # separate subcommand with its own baseline
+        # (.tpusvm-dura-baseline.json); pure stdlib, lint-job safe
+        from tpusvm.analysis.dura.cli import main as dura_main
+
+        return dura_main(argv[1:])
+    if argv and argv[0] == "dura-matrix":
+        # the dynamic arm: the machine-derived crash-window matrix —
+        # control runs + generated kill plans over the real durable
+        # writers (imports stream/solver/serve, so numpy + jax:
+        # test-job territory, like conc-stress)
+        from tpusvm.analysis.dura.cli import matrix_main
+
+        return matrix_main(argv[1:])
 
     args = build_parser().parse_args(argv)
 
@@ -103,6 +124,11 @@ def main(argv=None) -> int:
 
         for rid, summary in sorted(CONC_RULE_SUMMARIES.items()):
             print(f"{rid}  {summary}  [conc]")
+        # and the durability rules (the `dura` subcommand)
+        from tpusvm.analysis.dura.rules import DURA_RULE_SUMMARIES
+
+        for rid, summary in sorted(DURA_RULE_SUMMARIES.items()):
+            print(f"{rid}  {summary}  [dura]")
         return 0
 
     select = _parse_rule_list(args.select) or None

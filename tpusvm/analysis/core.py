@@ -22,6 +22,16 @@ Comment conventions (documented in README "Static analysis"):
                                      on the line (or the line below when
                                      the comment stands alone); empty
                                      invariant text is rejected
+  # tpusvm: durable-by=<invariant>   durability-auditor suppression that
+                                     DOCUMENTS the crash-safety invariant
+                                     (e.g. "rotation: source survives a
+                                     torn rename; reader rejects torn
+                                     tails") — suppresses JXD rules the
+                                     same way; empty invariant text is
+                                     rejected
+  # tpusvm: durable-protocol         opt a file into the durable-module
+                                     rules (JXD303); `=kill-safe` also
+                                     claims kill-safety (JXD306)
 """
 
 from __future__ import annotations
@@ -46,6 +56,7 @@ DEFAULT_EXCLUDE_DIRS = frozenset(
 
 _DISABLE_RE = re.compile(r"#\s*tpusvm:\s*disable=([A-Za-z0-9_,\s]+)")
 _GUARDED_BY_RE = re.compile(r"#\s*tpusvm:\s*guarded-by=(.*)$")
+_DURABLE_BY_RE = re.compile(r"#\s*tpusvm:\s*durable-by=(.*)$")
 _DISABLE_FILE_RE = re.compile(r"#\s*tpusvm:\s*disable-file=([A-Za-z0-9_,\s]+)")
 _KERNEL_PRAGMA_RE = re.compile(r"#\s*tpusvm:\s*kernel-path\b")
 _COMMENT_ONLY_RE = re.compile(r"^\s*#")
@@ -146,6 +157,22 @@ def guarded_by_annotation(lines: List[str], lineno: int) -> Optional[str]:
         if not (0 <= idx < len(lines)):
             continue
         m = _GUARDED_BY_RE.search(lines[idx])
+        if m and (idx == lineno - 1 or _COMMENT_ONLY_RE.match(lines[idx])):
+            text = m.group(1).strip()
+            if text:
+                return text
+    return None
+
+
+def durable_by_annotation(lines: List[str], lineno: int) -> Optional[str]:
+    """The `# tpusvm: durable-by=<invariant>` text covering 1-based line
+    `lineno`, or None. Same placement and non-empty-text contract as
+    guarded_by_annotation: the durability auditor's suppressions must
+    NAME the crash-safety invariant they rely on."""
+    for idx in (lineno - 1, lineno - 2):
+        if not (0 <= idx < len(lines)):
+            continue
+        m = _DURABLE_BY_RE.search(lines[idx])
         if m and (idx == lineno - 1 or _COMMENT_ONLY_RE.match(lines[idx])):
             text = m.group(1).strip()
             if text:

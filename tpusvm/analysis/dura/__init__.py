@@ -1,0 +1,40 @@
+"""tpusvm.analysis.dura — the crash-safety & atomicity auditor.
+
+Static arm (`python -m tpusvm.analysis dura`, rules JXD301-306): an AST
+pass over the durable-state modules that models every write protocol —
+final-path writes, temp+os.replace pairs, journal transitions,
+format-version fields — and machine-checks the disciplines the chaos
+tests rely on. Pure stdlib like the JX/JXC linters (no jax, no numpy:
+even `faults/injection.py` is AST-parsed, not imported), so it runs in
+the no-jax CI lint job with its own empty committed baseline
+(`.tpusvm-dura-baseline.json`).
+
+Dynamic arm (`python -m tpusvm.analysis dura-matrix`): kill windows are
+DERIVED from the static model — every write-guarding fault point times
+every hit it takes in a control run becomes a generated FaultPlan kill
+rule — and the recovery scenarios run over that matrix, so chaos
+coverage can never lag the code (test-job; needs numpy/jax).
+"""
+
+from tpusvm.analysis.dura.lint import (
+    dura_lint_file,
+    dura_lint_paths,
+    dura_lint_source,
+)
+from tpusvm.analysis.dura.model import (
+    DURABLE_MODULES,
+    DuraModel,
+    registered_points,
+)
+from tpusvm.analysis.dura.rules import DURA_RULE_SUMMARIES, all_dura_rules
+
+__all__ = [
+    "DURABLE_MODULES",
+    "DURA_RULE_SUMMARIES",
+    "DuraModel",
+    "all_dura_rules",
+    "dura_lint_file",
+    "dura_lint_paths",
+    "dura_lint_source",
+    "registered_points",
+]

@@ -1,0 +1,201 @@
+"""CLIs for the durability auditor.
+
+  python -m tpusvm.analysis dura [paths...]       the static arm
+                                                  (JXD301-306; pure
+                                                  stdlib ast, no jax —
+                                                  runs in the lint job)
+  python -m tpusvm.analysis dura-matrix [...]     the dynamic arm: kill
+                                                  windows derived from
+                                                  the static model, run
+                                                  through the recovery
+                                                  scenarios (needs
+                                                  numpy/jax — test job)
+
+Exit codes match the linter: 0 = clean (modulo baseline), 1 = findings /
+lost artifacts, 2 = usage error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from pathlib import Path
+
+from tpusvm.analysis.baseline import load_baseline, write_baseline
+from tpusvm.analysis.core import _parse_rule_list
+
+DEFAULT_DURA_BASELINE_NAME = ".tpusvm-dura-baseline.json"
+DEFAULT_PATHS = ("tpusvm", "benchmarks", "scripts", "bench.py")
+
+
+# ------------------------------------------------------------ static arm
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m tpusvm.analysis dura",
+        description=("crash-safety & atomicity auditor for the durable-"
+                     "state write protocols (rules JXD301-JXD306)"),
+    )
+    p.add_argument("paths", nargs="*", default=list(DEFAULT_PATHS),
+                   help="files/directories to lint "
+                        f"(default: {' '.join(DEFAULT_PATHS)})")
+    p.add_argument("--format", choices=("text", "json"), default="text")
+    p.add_argument("--select", default="",
+                   help="comma-separated JXD rule ids to run")
+    p.add_argument("--ignore", default="",
+                   help="comma-separated JXD rule ids to skip")
+    p.add_argument("--baseline", default=DEFAULT_DURA_BASELINE_NAME,
+                   help="baseline file of grandfathered findings "
+                        f"(default: {DEFAULT_DURA_BASELINE_NAME}; "
+                        "missing file = empty baseline)")
+    p.add_argument("--no-baseline", action="store_true")
+    p.add_argument("--write-baseline", action="store_true",
+                   help="write all current findings to the baseline "
+                        "file and exit 0")
+    p.add_argument("--list-rules", action="store_true")
+    return p
+
+
+def main(argv=None) -> int:
+    from tpusvm.analysis.dura.lint import dura_lint_paths
+    from tpusvm.analysis.dura.rules import all_dura_rules
+
+    args = build_parser().parse_args(argv)
+
+    if args.list_rules:
+        for rid, rule in all_dura_rules().items():
+            print(f"{rid}  {rule.summary}")
+        return 0
+
+    select = _parse_rule_list(args.select) or None
+    ignore = _parse_rule_list(args.ignore) or None
+    baseline = None
+    if not args.no_baseline and not args.write_baseline:
+        try:
+            baseline = load_baseline(args.baseline) or None
+        except ValueError as e:
+            print(f"tpusvm-dura: {e}", file=sys.stderr)
+            return 2
+
+    missing = [p for p in args.paths if not Path(p).exists()]
+    if missing:
+        print(f"tpusvm-dura: no such path(s): {', '.join(missing)}",
+              file=sys.stderr)
+        return 2
+
+    try:
+        result = dura_lint_paths(args.paths, select=select, ignore=ignore,
+                                 baseline=baseline)
+    except ValueError as e:
+        print(f"tpusvm-dura: {e}", file=sys.stderr)
+        return 2
+
+    if args.write_baseline:
+        write_baseline(args.baseline, result.findings)
+        print(f"tpusvm-dura: wrote {len(result.findings)} finding(s) to "
+              f"{args.baseline}")
+        return 0
+
+    if args.format == "json":
+        import json
+        from collections import Counter
+
+        counts = Counter(f.rule for f in result.findings)
+        print(json.dumps({
+            "version": 1,
+            "tool": "tpusvm.analysis.dura",
+            "files_scanned": result.files_scanned,
+            "rules": {rid: r.summary
+                      for rid, r in all_dura_rules().items()},
+            "findings": [f.to_dict() for f in result.findings],
+            "counts": dict(sorted(counts.items())),
+            "suppressed": len(result.suppressed),
+            "baselined": len(result.baselined),
+        }, indent=2))
+    else:
+        from tpusvm.analysis.report import render_text
+
+        print(render_text(result))
+    return result.exit_code
+
+
+# ----------------------------------------------------------- dynamic arm
+def build_matrix_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m tpusvm.analysis dura-matrix",
+        description=("derived crash-window matrix: every write-guarding "
+                     "fault point from the static model, killed at every "
+                     "hit a control run takes, with the recovery "
+                     "contract asserted after each kill"),
+    )
+    p.add_argument("--seed", type=int, default=0,
+                   help="scenario data seed; the generated plan names "
+                        "it, so any window reproduces (default 0)")
+    p.add_argument("--scenario", action="append", default=[],
+                   help="scenario to run (repeatable; default: all)")
+    p.add_argument("--list-scenarios", action="store_true")
+    p.add_argument("--list-windows", action="store_true",
+                   help="derive and print the kill-window plan without "
+                        "running the chaos arm")
+    p.add_argument("--max-windows", type=int, default=None,
+                   help="cap kill windows per (scenario, point) "
+                        "(default: unlimited; --smoke uses 2)")
+    p.add_argument("--out", default=None,
+                   help="write the generated plan document (JSON) here")
+    p.add_argument("--smoke", action="store_true",
+                   help="CI gate: every scenario, windows capped at 2 "
+                        "per point, zero lost/torn artifacts required")
+    return p
+
+
+def matrix_main(argv=None) -> int:
+    args = build_matrix_parser().parse_args(argv)
+    from tpusvm.analysis.dura.matrix import (
+        SCENARIOS,
+        derive_plan,
+        render_plan,
+        run_matrix,
+    )
+
+    if args.list_scenarios:
+        for name, sc in SCENARIOS.items():
+            print(f"{name}  points={','.join(sorted(sc.points))}  "
+                  f"{sc.doc}")
+        return 0
+
+    names = args.scenario or None
+    unknown = [s for s in (names or []) if s not in SCENARIOS]
+    if unknown:
+        print(f"tpusvm-dura-matrix: unknown scenario(s) {unknown}; "
+              f"known: {sorted(SCENARIOS)}", file=sys.stderr)
+        return 2
+
+    max_windows = args.max_windows
+    if args.smoke and max_windows is None:
+        max_windows = 2
+
+    try:
+        plan = derive_plan(seed=args.seed, scenarios=names,
+                           max_windows=max_windows)
+    except RuntimeError as e:
+        print(f"tpusvm-dura-matrix: {e}", file=sys.stderr)
+        return 1
+
+    if args.out:
+        import json
+        import os
+
+        from tpusvm.utils.durable import fsync_replace
+
+        tmp = args.out + ".tmp"
+        with open(tmp, "w") as f:
+            f.write(render_plan(plan))
+        fsync_replace(tmp, args.out)
+        print(f"tpusvm-dura-matrix: wrote plan to {args.out}")
+
+    if args.list_windows:
+        print(render_plan(plan))
+        return 0
+
+    report = run_matrix(plan)
+    print(report.render())
+    return 0 if report.ok else 1

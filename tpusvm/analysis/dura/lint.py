@@ -1,0 +1,97 @@
+"""Lint driver for the durability rules (JXD301-306).
+
+Mirrors tpusvm.analysis.conc.lint: shared Finding type, LintResult,
+fingerprints, file discovery, plus the `# tpusvm: durable-by=<invariant>`
+annotation on top of the shared disable comments. Durable-by
+suppressions require non-empty invariant text — the annotation exists
+to DOCUMENT why the site is crash-safe, so an empty one does not
+suppress.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Set, Tuple
+
+from tpusvm.analysis.context import ModuleContext
+from tpusvm.analysis.core import (
+    Finding,
+    durable_by_annotation,
+    file_suppressions,
+    fingerprint_findings,
+    is_suppressed,
+    iter_python_files,
+)
+from tpusvm.analysis.dura.model import DuraModel
+from tpusvm.analysis.dura.rules import all_dura_rules
+from tpusvm.analysis.lint import LintResult
+
+
+def _select(select: Optional[Set[str]], ignore: Optional[Set[str]]):
+    rules = all_dura_rules()
+    unknown = (set(select or ()) | set(ignore or ())) - set(rules)
+    if unknown:
+        raise ValueError(f"unknown dura rule id(s): {sorted(unknown)}; "
+                         f"known: {sorted(rules)}")
+    return [r for rid, r in rules.items()
+            if (not select or rid in select)
+            and (not ignore or rid not in ignore)]
+
+
+def dura_lint_source(source: str, path: str = "<string>",
+                     select: Optional[Set[str]] = None,
+                     ignore: Optional[Set[str]] = None,
+                     ) -> Tuple[List[Finding], List[Finding]]:
+    """Run the JXD rules on one source string -> (active, suppressed)."""
+    rules = _select(select, ignore)
+    try:
+        ctx = ModuleContext(path, source)
+    except SyntaxError as e:
+        return fingerprint_findings([Finding(
+            rule="JXD300", path=path, line=e.lineno or 1,
+            col=(e.offset or 0) + 1,
+            message=f"file does not parse: {e.msg}",
+        )]), []
+    model = DuraModel(ctx)
+    raw: List[Finding] = []
+    for rule in rules:
+        raw.extend(rule.check_model(model))
+    raw.sort(key=lambda f: (f.line, f.col, f.rule))
+    raw = fingerprint_findings(raw)
+    file_rules = file_suppressions(ctx.lines)
+    active, suppressed = [], []
+    for f in raw:
+        if is_suppressed(f, ctx.lines, file_rules) or \
+                durable_by_annotation(ctx.lines, f.line) is not None:
+            suppressed.append(f)
+        else:
+            active.append(f)
+    return active, suppressed
+
+
+def dura_lint_file(path, select=None, ignore=None):
+    with open(path, encoding="utf-8") as fh:
+        source = fh.read()
+    return dura_lint_source(source, str(path), select, ignore)
+
+
+def dura_lint_paths(paths, select=None, ignore=None,
+                    baseline: Optional[Set[Tuple[str, str, str]]] = None,
+                    ) -> LintResult:
+    """Lint every .py file under `paths` with the JXD rules; `baseline`
+    is the same (rule, path, fingerprint) grandfathering set the tracing
+    linter uses, read from .tpusvm-dura-baseline.json by the CLI."""
+    findings: List[Finding] = []
+    suppressed: List[Finding] = []
+    baselined: List[Finding] = []
+    files = iter_python_files(paths)
+    for f in files:
+        active, supp = dura_lint_file(f, select, ignore)
+        suppressed.extend(supp)
+        for finding in active:
+            key = (finding.rule, finding.path, finding.fingerprint)
+            if baseline and key in baseline:
+                baselined.append(finding)
+            else:
+                findings.append(finding)
+    return LintResult(findings=findings, suppressed=suppressed,
+                      baselined=baselined, files_scanned=len(files))

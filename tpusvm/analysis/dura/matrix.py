@@ -1,0 +1,499 @@
+"""The derived crash-window matrix (``python -m tpusvm.analysis dura-matrix``).
+
+The chaos tests that existed before this PR each hand-picked their kill
+points, so a new durable write path silently shipped with zero kill
+coverage until someone remembered to write a smoke for it. Here the
+windows are MACHINE-DERIVED from the same static model the JXD rules
+query:
+
+  1. ``derive_points()`` re-runs DuraModel over every registered durable
+     module and keeps each ``faults.point`` literal whose enclosing
+     scope also performs a durable write or rename-commit — the
+     *write-guarding* points. Read-side points (``cache.read``,
+     ``stream.read_shard``) fall out automatically.
+  2. Every derived point must be claimed by some recovery scenario
+     below; an unclaimed point is a hard error (``RuntimeError``), so
+     chaos coverage can never lag the code — adding a guarded write
+     path without teaching the matrix about it fails CI.
+  3. For each scenario a CONTROL run executes under an ACTIVE but
+     empty ``FaultPlan`` (rules=[]), which counts every point hit
+     without injecting anything. Each (point, hit-ordinal) pair becomes
+     one kill window: a generated ``FaultRule(kind="kill", at_hit=k)``.
+  4. ``run_matrix`` replays each window — run until ``SimulatedKill``,
+     then recover exactly as a restarted process would
+     (``execute(resume=True)``) — and asserts the recovered artifact
+     digest equals the control digest: zero lost or torn artifacts.
+
+Everything is parameterised by one seed; ``render_plan`` is
+byte-identical for a given seed, and any single window reproduces with
+``--scenario <name>`` plus the window's (point, at_hit) from the plan.
+
+This module needs numpy/jax at execute time (the recovery scenarios
+train and serialize for real) — it is the test-job arm; the lint job
+only ever imports the static arm.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import os
+import tempfile
+from pathlib import Path
+from typing import Callable, Dict, FrozenSet, List, Optional
+
+from tpusvm.analysis.dura.model import DURABLE_MODULES, DuraModel
+
+
+class MatrixError(AssertionError):
+    """A recovery contract was violated inside a scenario execute()."""
+
+
+# --------------------------------------------------------------- digests
+def _digest(obj) -> str:
+    """sha256 over a canonical JSON rendering (dicts sorted)."""
+    return hashlib.sha256(
+        json.dumps(obj, sort_keys=True).encode()
+    ).hexdigest()
+
+
+def _arr(a) -> str:
+    import numpy as np
+
+    a = np.asarray(a)
+    h = hashlib.sha256()
+    h.update(str(a.dtype).encode())
+    h.update(str(a.shape).encode())
+    h.update(np.ascontiguousarray(a).tobytes())
+    return h.hexdigest()
+
+
+# -------------------------------------------------- derived point universe
+def derive_points(root: Optional[Path] = None) -> Dict[str, List[str]]:
+    """Write-guarding fault points, derived from the static model.
+
+    Maps point name -> list of "module.py:line" sites. A point literal
+    counts when its innermost enclosing scope (function, else module)
+    also holds a durable write or a rename-commit — the static
+    definition of "this point guards a write protocol"."""
+    from tpusvm.analysis.context import ModuleContext
+
+    if root is None:
+        root = Path(__file__).resolve().parents[3]
+    out: Dict[str, List[str]] = {}
+    for suffix in sorted(DURABLE_MODULES):
+        path = Path(root) / suffix
+        try:
+            source = path.read_text(encoding="utf-8")
+            ctx = ModuleContext(str(path), source)
+        except (OSError, SyntaxError):
+            continue
+        model = DuraModel(ctx)
+        scope_by_id = {id(s.node): s for s in model.scopes}
+        for call, lit in model.point_calls:
+            if lit is None:
+                continue
+            chain = model.enclosing_functions(call)
+            owner = chain[0] if chain else model.ctx.tree
+            scope = scope_by_id.get(id(owner))
+            if scope is None or not (scope.writes or scope.replaces):
+                continue
+            out.setdefault(lit, []).append(f"{suffix}:{call.lineno}")
+    return out
+
+
+# ------------------------------------------------------------- scenarios
+@dataclasses.dataclass(frozen=True)
+class Scenario:
+    """One recovery contract: points it claims + an execute that either
+    completes and returns a state digest, or dies at an injected kill
+    and is re-run with resume=True the way a restarted process would."""
+
+    name: str
+    points: FrozenSet[str]
+    doc: str
+    execute: Callable[[str, int, bool], str]
+
+
+def _ingest_exec(workdir: str, seed: int, resume: bool) -> str:
+    import numpy as np
+
+    from tpusvm.status import StreamStatus
+    from tpusvm.stream.format import ingest_arrays, open_dataset
+
+    rng = np.random.default_rng(1000 + seed)
+    X = rng.normal(size=(120, 6)).astype(np.float64)
+    Y = np.where(rng.random(120) < 0.5, 1, -1).astype(np.int64)
+    ds = os.path.join(workdir, "ds")
+    ingest_arrays(ds, X, Y, rows_per_shard=32, resume=resume)
+    d = open_dataset(ds)
+    bad = [s.name for s in d.validate() if s != StreamStatus.OK]
+    if bad:
+        raise MatrixError(f"ingest recovery left torn shards: {bad}")
+    return _digest(d.manifest.to_json())
+
+
+def _append_exec(workdir: str, seed: int, resume: bool) -> str:
+    import numpy as np
+
+    from tpusvm.status import StreamStatus
+    from tpusvm.stream.append import append_blocks
+    from tpusvm.stream.format import ingest_arrays, open_dataset
+
+    rng = np.random.default_rng(2000 + seed)
+    Xb = rng.normal(size=(80, 5)).astype(np.float64)
+    Yb = np.where(rng.random(80) < 0.5, 1, -1).astype(np.int64)
+    batches = []
+    for _ in range(3):
+        Xa = rng.normal(size=(24, 5)).astype(np.float64)
+        Ya = np.where(rng.random(24) < 0.5, 1, -1).astype(np.int64)
+        batches.append((Xa, Ya))
+    ds = os.path.join(workdir, "ds")
+    if not resume:
+        # the committed base dataset the append session reopens; its own
+        # kill coverage is the ingest scenario's job
+        ingest_arrays(ds, Xb, Yb, rows_per_shard=32)
+    append_blocks(ds, batches, resume=resume)
+    d = open_dataset(ds)
+    bad = [s.name for s in d.validate() if s != StreamStatus.OK]
+    if bad:
+        raise MatrixError(f"append recovery left torn shards: {bad}")
+    if d.manifest.n_rows != 80 + 3 * 24:
+        raise MatrixError(
+            f"append recovery lost/duplicated rows: manifest says "
+            f"{d.manifest.n_rows}, expected {80 + 3 * 24}"
+        )
+    return _digest(d.manifest.to_json())
+
+
+def _checkpoint_exec(workdir: str, seed: int, resume: bool) -> str:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_enable_x64", True)
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tpusvm.data import MinMaxScaler, rings
+    from tpusvm.solver.checkpoint import checkpointed_blocked_solve
+    from tpusvm.status import Status
+
+    # the convergence-proven kill-resume-smoke problem (rings n=400):
+    # the scenario seed drives the PLAN, not the data — bit-identity of
+    # resumed vs. uninterrupted trajectories is the contract under test
+    X, Y = rings(n=400, seed=11)
+    Xs = jnp.asarray(MinMaxScaler().fit_transform(X), jnp.float32)
+    Yd = jnp.asarray(Y)
+    ck = os.path.join(workdir, "ck.npz")
+    res = checkpointed_blocked_solve(
+        Xs, Yd, checkpoint_path=ck, checkpoint_every=4, resume=resume,
+        C=10.0, gamma=10.0, q=16, accum_dtype=jnp.float64,
+    )
+    if Status(int(res.status)) != Status.CONVERGED:
+        raise MatrixError(
+            f"resumed solve ended {Status(int(res.status)).name}"
+        )
+    return _digest({
+        "alpha": _arr(np.asarray(res.alpha)),
+        "b": float(res.b),
+    })
+
+
+def _model_save_exec(workdir: str, seed: int, resume: bool) -> str:
+    import numpy as np
+
+    from tpusvm.config import SVMConfig
+    from tpusvm.models.serialization import load_model, save_model
+
+    path = os.path.join(workdir, "model.npz")
+    if resume and os.path.exists(path):
+        load_model(path)  # whatever survived the kill must parse whole
+    rng = np.random.default_rng(3000 + seed)
+    cfg = SVMConfig(C=2.0, gamma=0.25)
+    for rev in (1, 2):  # two commits -> two kill windows per control run
+        state = {
+            "alpha": rng.normal(size=32).astype(np.float64),
+            "sv_X": rng.normal(size=(32, 4)).astype(np.float32),
+            "sv_Y": np.where(rng.random(32) < 0.5, 1, -1).astype(np.int32),
+            "b": np.float64(0.125 * rev),
+        }
+        save_model(path, state, cfg)
+    got_state, got_cfg = load_model(path)
+    return _digest({
+        "state": {k: _arr(v) for k, v in sorted(got_state.items())},
+        "config": repr(got_cfg),
+    })
+
+
+def _serve_state_exec(workdir: str, seed: int, resume: bool) -> str:
+    from tpusvm.serve.cache import (
+        load_serve_state,
+        read_cache_manifest,
+        record_signatures,
+        save_serve_state,
+    )
+
+    cache_dir = os.path.join(workdir, "cache")
+    os.makedirs(cache_dir, exist_ok=True)
+    path = os.path.join(workdir, "serve_state.json")
+    if resume and os.path.exists(path):
+        load_serve_state(path)  # a torn registry must be impossible
+    record_signatures(cache_dir, [f"sig-a-{seed}"])
+    record_signatures(cache_dir, [f"sig-a-{seed}", f"sig-b-{seed}"])
+    for gen in (1, 2):
+        save_serve_state(
+            path,
+            {"m": {"path": None, "generation": gen}},
+            cache_dir=cache_dir,
+        )
+    state = load_serve_state(path)
+    manifest = read_cache_manifest(cache_dir)
+    return _digest({
+        "models": state["models"],
+        "signatures": sorted(manifest["signatures"]),
+    })
+
+
+def _autopilot_state_exec(workdir: str, seed: int, resume: bool) -> str:
+    from tpusvm.autopilot.state import AutopilotState, load_state, save_state
+
+    path = os.path.join(workdir, "autopilot.json")
+    if resume and os.path.exists(path):
+        load_state(path)  # CRC + version gate must pass on any survivor
+    for rev in (1, 2):
+        save_state(path, AutopilotState(seed=seed + rev))
+    got = dataclasses.asdict(load_state(path))
+    return _digest(got)
+
+
+def _cascade_ckpt_exec(workdir: str, seed: int, resume: bool) -> str:
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tpusvm.parallel.cascade import load_round_state, save_round_state
+    from tpusvm.parallel.svbuffer import SVBuffer
+
+    path = os.path.join(workdir, "round.npz")
+    if resume and os.path.exists(path):
+        load_round_state(path)  # version gate + shapes must parse whole
+    rng = np.random.default_rng(4000 + seed)
+    cap, dim = 16, 4
+    for rnd in (1, 2):
+        buf = SVBuffer(
+            X=jnp.asarray(rng.normal(size=(cap, dim)), jnp.float32),
+            Y=jnp.asarray(np.where(rng.random(cap) < 0.5, 1, -1)),
+            alpha=jnp.asarray(rng.random(cap), jnp.float32),
+            ids=jnp.arange(cap, dtype=jnp.int32),
+            valid=jnp.asarray(rng.random(cap) < 0.75),
+        )
+        save_round_state(path, buf, prev_ids={1, 2, 3}, rnd=rnd,
+                         b=0.5 * rnd, n_shards=4, topology="binary")
+    sv, prev_ids, next_round, b = load_round_state(path)
+    return _digest({
+        "sv": [_arr(np.asarray(x)) for x in sv],
+        "prev_ids": sorted(prev_ids),
+        "next_round": int(next_round),
+        "b": float(b),
+    })
+
+
+SCENARIOS: Dict[str, Scenario] = {
+    s.name: s for s in (
+        Scenario(
+            name="ingest",
+            points=frozenset({"ingest.write_shard", "stream.journal"}),
+            doc="fresh sharded ingest killed mid-shard/journal, resumed "
+                "from the v1 journal; manifest + shard checksums must "
+                "match an uninterrupted ingest",
+            execute=_ingest_exec,
+        ),
+        Scenario(
+            name="append",
+            points=frozenset({"stream.append"}),
+            doc="tail-shard append session killed at journal writes and "
+                "both commit transitions, resumed with the same batch "
+                "replay; exactly-once (no lost/duplicated rows)",
+            execute=_append_exec,
+        ),
+        Scenario(
+            name="checkpoint",
+            points=frozenset({"solver.outer_checkpoint"}),
+            doc="checkpointed blocked solve killed at checkpoint writes, "
+                "resumed; bit-identical alpha/b to an uninterrupted run",
+            execute=_checkpoint_exec,
+        ),
+        Scenario(
+            name="model_save",
+            points=frozenset({"models.save"}),
+            doc="model artifact saved twice, killed mid-commit; whatever "
+                "file survives must load whole (no torn npz)",
+            execute=_model_save_exec,
+        ),
+        Scenario(
+            name="serve_state",
+            points=frozenset({"serve.state_write"}),
+            doc="serve registry + cache-manifest writes killed "
+                "mid-commit; survivors parse whole and a re-run "
+                "converges to the control state",
+            execute=_serve_state_exec,
+        ),
+        Scenario(
+            name="autopilot_state",
+            points=frozenset({"autopilot.state"}),
+            doc="autopilot supervisor state killed mid-commit; the CRC "
+                "fingerprint + version gate must pass on any survivor",
+            execute=_autopilot_state_exec,
+        ),
+        Scenario(
+            name="cascade_ckpt",
+            points=frozenset({"cascade.checkpoint"}),
+            doc="cascade round checkpoint killed mid-commit; survivor "
+                "loads whole and a re-run matches the control rounds",
+            execute=_cascade_ckpt_exec,
+        ),
+    )
+}
+
+
+# ------------------------------------------------------------ derivation
+def derive_plan(seed: int = 0,
+                scenarios: Optional[List[str]] = None,
+                max_windows: Optional[int] = None,
+                root: Optional[Path] = None) -> dict:
+    """Control-run the scenarios and emit the kill-window plan.
+
+    Raises RuntimeError when the derived point universe is not fully
+    claimed by the scenario registry (coverage may never lag the code)
+    or when a claimed point takes zero hits in its scenario's control
+    run (a dead claim is as bad as a missing one)."""
+    from tpusvm import faults
+
+    derived = derive_points(root)
+    claimed = frozenset().union(*(s.points for s in SCENARIOS.values()))
+    unclaimed = sorted(set(derived) - claimed)
+    if unclaimed:
+        sites = {p: derived[p] for p in unclaimed}
+        raise RuntimeError(
+            f"write-guarding fault point(s) {unclaimed} have no recovery "
+            f"scenario (sites: {sites}); extend "
+            "tpusvm/analysis/dura/matrix.py SCENARIOS so the crash-window "
+            "matrix covers them"
+        )
+    names = list(scenarios) if scenarios else sorted(SCENARIOS)
+    windows: List[dict] = []
+    for name in names:
+        sc = SCENARIOS[name]
+        counter = faults.FaultPlan([], seed=seed)
+        with tempfile.TemporaryDirectory() as td:
+            with faults.active(counter):
+                sc.execute(td, seed, False)
+        for point in sorted(sc.points):
+            hits = counter.hits(point)
+            if hits <= 0:
+                raise RuntimeError(
+                    f"scenario {name!r} claims fault point {point!r} but "
+                    "its control run never hit it; the claim is stale — "
+                    "fix the scenario or the point registration"
+                )
+            cap = hits if max_windows is None else min(hits, max_windows)
+            for k in range(1, cap + 1):
+                windows.append({
+                    "scenario": name,
+                    "point": point,
+                    "at_hit": k,
+                    "control_hits": hits,
+                })
+    return {
+        "format_version": 1,
+        "kind": "tpusvm-dura-matrix-plan",
+        "seed": seed,
+        "derived_points": {p: sorted(v) for p, v in derived.items()},
+        "scenarios": names,
+        "windows": windows,
+    }
+
+
+def render_plan(plan: dict) -> str:
+    """Canonical (byte-stable per seed) rendering of a derived plan."""
+    return json.dumps(plan, indent=1, sort_keys=True) + "\n"
+
+
+# --------------------------------------------------------------- running
+@dataclasses.dataclass
+class WindowResult:
+    scenario: str
+    point: str
+    at_hit: int
+    ok: bool
+    detail: str
+
+
+@dataclasses.dataclass
+class MatrixReport:
+    seed: int
+    results: List[WindowResult]
+
+    @property
+    def ok(self) -> bool:
+        return all(r.ok for r in self.results)
+
+    def render(self) -> str:
+        lines = []
+        n_bad = sum(1 for r in self.results if not r.ok)
+        for r in self.results:
+            mark = "ok  " if r.ok else "FAIL"
+            lines.append(f"{mark} {r.scenario:<16} {r.point:<24} "
+                         f"at_hit={r.at_hit:<3} {r.detail}")
+        lines.append(
+            f"tpusvm-dura-matrix: {len(self.results)} kill window(s), "
+            f"{n_bad} failure(s), seed={self.seed}"
+        )
+        return "\n".join(lines)
+
+
+def run_matrix(plan: dict) -> MatrixReport:
+    """Replay every window in the plan: kill, recover, compare digests."""
+    from tpusvm import faults
+
+    seed = int(plan["seed"])
+    results: List[WindowResult] = []
+    by_scenario: Dict[str, List[dict]] = {}
+    for w in plan["windows"]:
+        by_scenario.setdefault(w["scenario"], []).append(w)
+    for name in sorted(by_scenario):
+        sc = SCENARIOS[name]
+        with tempfile.TemporaryDirectory() as td:
+            control = sc.execute(td, seed, False)
+        for w in by_scenario[name]:
+            rule = faults.FaultRule(point=w["point"], kind="kill",
+                                    at_hit=int(w["at_hit"]))
+            kill_plan = faults.FaultPlan([rule], seed=seed)
+            with tempfile.TemporaryDirectory() as td:
+                died = False
+                try:
+                    with faults.active(kill_plan):
+                        sc.execute(td, seed, False)
+                except faults.SimulatedKill:
+                    died = True
+                if not died:
+                    results.append(WindowResult(
+                        name, w["point"], int(w["at_hit"]), False,
+                        "kill rule never fired (control hits drifted)"))
+                    continue
+                try:
+                    recovered = sc.execute(td, seed, True)
+                except Exception as e:  # noqa: BLE001 — report, don't die
+                    results.append(WindowResult(
+                        name, w["point"], int(w["at_hit"]), False,
+                        f"recovery raised {type(e).__name__}: {e}"))
+                    continue
+                if recovered == control:
+                    results.append(WindowResult(
+                        name, w["point"], int(w["at_hit"]), True,
+                        "recovered == control"))
+                else:
+                    results.append(WindowResult(
+                        name, w["point"], int(w["at_hit"]), False,
+                        "recovered state digest diverged from control"))
+    return MatrixReport(seed=seed, results=results)

@@ -1,0 +1,482 @@
+"""Per-module write-protocol model for the durability rules.
+
+One DuraModel per file: every durable write (`open(p, "w")`, np.save /
+np.savez[_compressed], Path.write_text/write_bytes), every rename-commit
+(`os.replace` / `os.rename` / the sanctioned `fsync_replace`), every
+journal delete, every `faults.point(...)` call and every format-version
+field, grouped by lexical scope. The JXD rules are queries over this
+model, the way the JXC rules query ConcModel.
+
+Like the rest of the linter this is a LEXICAL approximation, tuned for a
+low false-positive rate on this repo rather than completeness:
+
+  * a write is "staged" when its target shares a path variable with some
+    replace-source in the same scope, or when the target spelling
+    carries a staging suffix (.tmp/.stage/.part/.new) — a tmp-named file
+    that is never renamed is invisible to us;
+  * directory identity (JXD302) is resolved through single in-scope
+    assignments and os.path.join/`+` shapes; paths we cannot resolve are
+    never reported;
+  * fault-point coverage (JXD303) is per replace site against the chain
+    of lexically enclosing functions — cross-function indirection (the
+    point lives in a helper the writer calls) is out of scope and is
+    exactly what the derived crash-window matrix (dura-matrix) covers
+    dynamically.
+
+Which modules own durable state is a REGISTRY here (DURABLE_MODULES),
+extended per-file by the `# tpusvm: durable-protocol[=kill-safe]` pragma
+(how the corpus cases opt in). The fault-point universe is AST-parsed
+out of tpusvm/faults/injection.py (`POINTS = frozenset({...})`) so the
+lint job never imports numpy; tests/test_dura.py pins the parse against
+the runtime set.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import re
+from pathlib import Path
+from typing import Dict, FrozenSet, List, Optional, Set, Tuple
+
+#: repo-relative posix path suffix -> claims kill-safety (JXD306 scope).
+#: These are the modules whose files a kill may land on mid-write; the
+#: True entries additionally promise flush-before-rename durability
+#: (journal/commit hot paths whose recovery contract is exactly-once).
+DURABLE_MODULES: Dict[str, bool] = {
+    "tpusvm/stream/format.py": True,
+    "tpusvm/stream/append.py": True,
+    "tpusvm/solver/checkpoint.py": True,
+    "tpusvm/autopilot/state.py": True,
+    "tpusvm/models/serialization.py": False,
+    "tpusvm/serve/cache.py": False,
+    "tpusvm/serve/refresh.py": False,
+    "tpusvm/serve/watch.py": False,
+    "tpusvm/obs/trace.py": False,
+    "tpusvm/parallel/cascade.py": False,
+}
+
+_DURABLE_PRAGMA_RE = re.compile(
+    r"#\s*tpusvm:\s*durable-protocol(=kill-safe)?\b"
+)
+_STAGED_SPELLING_RE = re.compile(r"\.(tmp|stage|part|new)\b")
+_VERSION_KEY_RE = re.compile(r"version", re.IGNORECASE)
+_VERSION_VALUE_RE = re.compile(r"VERSION")
+_JOURNAL_RE = re.compile(r"journal", re.IGNORECASE)
+
+_WRITE_MODES = frozenset("wxa")
+_SAVEZ_CALLS = frozenset(
+    {"numpy.save", "numpy.savez", "numpy.savez_compressed"}
+)
+_REPLACE_CALLS = frozenset({"os.replace", "os.rename"})
+_REMOVE_CALLS = frozenset({"os.remove", "os.unlink"})
+_JSON_READ_CALLS = frozenset({"json.load", "json.loads", "numpy.load"})
+
+
+def durable_status(path: str, source: str) -> Tuple[bool, bool]:
+    """(is_durable_module, claims_kill_safety) for one file.
+
+    Registry suffix match first; the `# tpusvm: durable-protocol` pragma
+    opts any file in (corpus cases), `=kill-safe` also claims JXD306."""
+    posix = Path(path).as_posix()
+    for suffix, kill_safe in DURABLE_MODULES.items():
+        if posix.endswith(suffix):
+            return True, kill_safe
+    m = _DURABLE_PRAGMA_RE.search(source)
+    if m:
+        return True, m.group(1) is not None
+    return False, False
+
+
+def registered_points(root: Optional[Path] = None
+                      ) -> Optional[FrozenSet[str]]:
+    """The fault-point universe, AST-parsed from faults/injection.py.
+
+    Parsed rather than imported so the no-jax lint job never pulls
+    numpy. Returns None when the file (or the POINTS assignment) cannot
+    be found — rules degrade to skipping the coverage cross-check rather
+    than guessing."""
+    if root is None:
+        root = Path(__file__).resolve().parents[2]
+    inj = Path(root) / "faults" / "injection.py"
+    try:
+        tree = ast.parse(inj.read_text(encoding="utf-8"))
+    except (OSError, SyntaxError):
+        return None
+    for node in tree.body:
+        if not (isinstance(node, ast.Assign) and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and node.targets[0].id == "POINTS"):
+            continue
+        v = node.value
+        if isinstance(v, ast.Call) and v.args:
+            v = v.args[0]
+        if isinstance(v, (ast.Set, ast.Tuple, ast.List)) and all(
+            isinstance(e, ast.Constant) and isinstance(e.value, str)
+            for e in v.elts
+        ):
+            return frozenset(e.value for e in v.elts)
+    return None
+
+
+def _safe_unparse(node: ast.AST) -> str:
+    try:
+        return ast.unparse(node)
+    except Exception:  # noqa: BLE001 — unparse of a synthetic node
+        return ""
+
+
+def _own_nodes(scope_node: ast.AST) -> List[ast.AST]:
+    """Descendants of a scope, stopping at nested function boundaries
+    (each nested def is a scope of its own)."""
+    out: List[ast.AST] = []
+    stack = list(ast.iter_child_nodes(scope_node))
+    while stack:
+        node = stack.pop()
+        out.append(node)
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+    return out
+
+
+def _path_tokens(expr: ast.AST) -> Set[str]:
+    """Identity tokens of a path expression: bare Names, whole attribute
+    chains (`self.out_dir`) and whole call spellings
+    (`self._journal_path()`) — but never the module root of a call's
+    func chain, so `os.path.join(d, x)` contributes {d, x}, not `os`."""
+    toks: Set[str] = set()
+
+    def visit(n: ast.AST) -> None:
+        if isinstance(n, ast.Call):
+            toks.add(_safe_unparse(n))
+            for a in n.args:
+                visit(a)
+            for kw in n.keywords:
+                visit(kw.value)
+            return
+        if isinstance(n, ast.Attribute):
+            toks.add(_safe_unparse(n))
+            return
+        if isinstance(n, ast.Name):
+            toks.add(n.id)
+            return
+        for c in ast.iter_child_nodes(n):
+            visit(c)
+
+    visit(expr)
+    toks.discard("")
+    return toks
+
+
+@dataclasses.dataclass
+class WriteSite:
+    """One durable write call (open-for-write / savez / write_text)."""
+
+    node: ast.Call
+    target: ast.AST                 # the path expression being written
+    kind: str                       # "open" | "savez" | "write_text"
+    mode: str                       # "w" | "x" | "a"
+
+
+@dataclasses.dataclass
+class ReplaceSite:
+    """One rename-commit call (os.replace / os.rename / fsync_replace)."""
+
+    node: ast.Call
+    src: Optional[ast.AST]
+    dst: Optional[ast.AST]
+    fsynced: bool                   # spelled as the sanctioned helper
+
+
+@dataclasses.dataclass
+class Scope:
+    """One lexical scope (module body or one function def)."""
+
+    node: ast.AST
+    name: str
+    writes: List[WriteSite] = dataclasses.field(default_factory=list)
+    replaces: List[ReplaceSite] = dataclasses.field(default_factory=list)
+    removes: List[ast.Call] = dataclasses.field(default_factory=list)
+    fsyncs: List[ast.Call] = dataclasses.field(default_factory=list)
+    #: single-assignment name -> value expr (ambiguous names excluded)
+    assignments: Dict[str, ast.AST] = dataclasses.field(default_factory=dict)
+
+
+class DuraModel:
+    """The write-protocol model of one module (see module docstring)."""
+
+    def __init__(self, ctx):
+        self.ctx = ctx
+        self.durable, self.kill_safe = durable_status(ctx.path, ctx.source)
+        self.scopes: List[Scope] = []
+        #: fault-point calls: (call node, literal point name or None)
+        self.point_calls: List[Tuple[ast.Call, Optional[str]]] = []
+        #: format-version fields written: (key, anchor node)
+        self.version_writes: List[Tuple[str, ast.AST]] = []
+        #: constant string keys read in gate positions (subscript, .get,
+        #: `in`/`not in` membership)
+        self.read_keys: Set[str] = set()
+        self.has_readers = False
+        # function parents chain for fault-point coverage (JXD303)
+        self._fn_parents: Dict[int, Optional[ast.AST]] = {}
+        self._build()
+
+    # -------------------------------------------------------- construction
+    def _build(self) -> None:
+        tree = self.ctx.tree
+        fn_nodes = [n for n in ast.walk(tree)
+                    if isinstance(n, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef))]
+        parents: Dict[int, ast.AST] = {}
+        for n in ast.walk(tree):
+            for c in ast.iter_child_nodes(n):
+                parents[id(c)] = n
+        for fn in fn_nodes:
+            p = parents.get(id(fn))
+            while p is not None and not isinstance(
+                p, (ast.FunctionDef, ast.AsyncFunctionDef)
+            ):
+                p = parents.get(id(p))
+            self._fn_parents[id(fn)] = p
+        self._parents = parents
+
+        self.scopes.append(self._scan_scope(tree, "<module>"))
+        for fn in fn_nodes:
+            self.scopes.append(self._scan_scope(fn, fn.name))
+        self._scan_versions(tree)
+
+    def _scan_scope(self, node: ast.AST, name: str) -> Scope:
+        scope = Scope(node=node, name=name)
+        assigned_counts: Dict[str, int] = {}
+        for n in _own_nodes(node):
+            if isinstance(n, ast.Assign) and len(n.targets) == 1 \
+                    and isinstance(n.targets[0], ast.Name):
+                nm = n.targets[0].id
+                assigned_counts[nm] = assigned_counts.get(nm, 0) + 1
+                scope.assignments[nm] = n.value
+            if not isinstance(n, ast.Call):
+                continue
+            resolved = self.ctx.resolve_call(n)
+            w = self._as_write(n, resolved)
+            if w is not None:
+                scope.writes.append(w)
+            elif resolved in _REPLACE_CALLS and len(n.args) >= 2:
+                scope.replaces.append(ReplaceSite(
+                    node=n, src=n.args[0], dst=n.args[1], fsynced=False))
+            elif resolved and resolved.split(".")[-1] == "fsync_replace":
+                scope.replaces.append(ReplaceSite(
+                    node=n,
+                    src=n.args[0] if n.args else None,
+                    dst=n.args[1] if len(n.args) > 1 else None,
+                    fsynced=True))
+            elif resolved in _REMOVE_CALLS and n.args:
+                scope.removes.append(n)
+            elif resolved == "os.fsync":
+                scope.fsyncs.append(n)
+            elif resolved in _JSON_READ_CALLS:
+                self.has_readers = True
+            if self._is_point_call(resolved):
+                lit = None
+                if n.args and isinstance(n.args[0], ast.Constant) \
+                        and isinstance(n.args[0].value, str):
+                    lit = n.args[0].value
+                self.point_calls.append((n, lit))
+        # ambiguous (multiply-assigned) names cannot be followed
+        for nm, count in assigned_counts.items():
+            if count > 1:
+                scope.assignments.pop(nm, None)
+        return scope
+
+    @staticmethod
+    def _is_point_call(resolved: Optional[str]) -> bool:
+        if not resolved:
+            return False
+        return bool(re.search(r"(?:^|\.)faults(?:\.injection)?\.point$",
+                              resolved))
+
+    def _as_write(self, call: ast.Call,
+                  resolved: Optional[str]) -> Optional[WriteSite]:
+        if resolved == "open" or (isinstance(call.func, ast.Name)
+                                  and call.func.id == "open"):
+            mode = "r"
+            if len(call.args) >= 2 and isinstance(call.args[1], ast.Constant) \
+                    and isinstance(call.args[1].value, str):
+                mode = call.args[1].value
+            for kw in call.keywords:
+                if kw.arg == "mode" and isinstance(kw.value, ast.Constant) \
+                        and isinstance(kw.value.value, str):
+                    mode = kw.value.value
+            if not (_WRITE_MODES & set(mode)) or not call.args:
+                return None
+            kind = "a" if "a" in mode else ("x" if "x" in mode else "w")
+            return WriteSite(node=call, target=call.args[0], kind="open",
+                             mode=kind)
+        if resolved in _SAVEZ_CALLS and call.args:
+            if self._is_buffer(call.args[0]):
+                return None
+            return WriteSite(node=call, target=call.args[0], kind="savez",
+                             mode="w")
+        if isinstance(call.func, ast.Attribute) \
+                and call.func.attr in ("write_text", "write_bytes"):
+            return WriteSite(node=call, target=call.func.value,
+                             kind="write_text", mode="w")
+        return None
+
+    def _is_buffer(self, target: ast.AST) -> bool:
+        """np.savez(buf, ...) onto an in-memory BytesIO is not a durable
+        write — the bytes land on disk through a later open()."""
+        if isinstance(target, ast.Call):
+            r = self.ctx.resolve(target.func)
+            return bool(r) and r.split(".")[-1] in ("BytesIO", "StringIO")
+        if isinstance(target, ast.Name):
+            # follow one assignment in the innermost scope owning it
+            for scope in self.scopes:
+                v = scope.assignments.get(target.id)
+                if isinstance(v, ast.Call):
+                    r = self.ctx.resolve(v.func)
+                    if r and r.split(".")[-1] in ("BytesIO", "StringIO"):
+                        return True
+            # also scan pending assignments lexically (scopes list may
+            # not include the current scope yet during construction)
+            for n in ast.walk(self.ctx.tree):
+                if isinstance(n, ast.Assign) and len(n.targets) == 1 \
+                        and isinstance(n.targets[0], ast.Name) \
+                        and n.targets[0].id == target.id \
+                        and isinstance(n.value, ast.Call):
+                    r = self.ctx.resolve(n.value.func)
+                    if r and r.split(".")[-1] in ("BytesIO", "StringIO"):
+                        return True
+        return False
+
+    def _scan_versions(self, tree: ast.AST) -> None:
+        for n in ast.walk(tree):
+            if isinstance(n, ast.Dict):
+                for k, v in zip(n.keys, n.values):
+                    if not (isinstance(k, ast.Constant)
+                            and isinstance(k.value, str)):
+                        continue
+                    if _VERSION_KEY_RE.search(k.value) or (
+                        isinstance(v, (ast.Name, ast.Attribute))
+                        and _VERSION_VALUE_RE.search(_safe_unparse(v))
+                    ):
+                        self.version_writes.append((k.value, k))
+            elif isinstance(n, ast.Call):
+                resolved = self.ctx.resolve_call(n)
+                if resolved in _SAVEZ_CALLS:
+                    for kw in n.keywords:
+                        if kw.arg and _VERSION_KEY_RE.search(kw.arg):
+                            self.version_writes.append((kw.arg, n))
+            if isinstance(n, ast.Subscript) \
+                    and isinstance(n.slice, ast.Constant) \
+                    and isinstance(n.slice.value, str):
+                self.read_keys.add(n.slice.value)
+            elif isinstance(n, ast.Call) \
+                    and isinstance(n.func, ast.Attribute) \
+                    and n.func.attr == "get" and n.args \
+                    and isinstance(n.args[0], ast.Constant) \
+                    and isinstance(n.args[0].value, str):
+                self.read_keys.add(n.args[0].value)
+            elif isinstance(n, ast.Compare) \
+                    and all(isinstance(op, (ast.In, ast.NotIn))
+                            for op in n.ops) \
+                    and isinstance(n.left, ast.Constant) \
+                    and isinstance(n.left.value, str):
+                self.read_keys.add(n.left.value)
+
+    # ------------------------------------------------------------- queries
+    def resolve_path(self, expr: ast.AST, scope: Scope,
+                     depth: int = 0) -> ast.AST:
+        """Follow a Name through single in-scope assignments (3 hops)."""
+        while isinstance(expr, ast.Name) and depth < 3 \
+                and expr.id in scope.assignments:
+            expr = scope.assignments[expr.id]
+            depth += 1
+        return expr
+
+    def write_is_staged(self, w: WriteSite, scope: Scope) -> bool:
+        """Is this write covered by the staged-temp + rename protocol?
+
+        Covered when the write target shares an identity token with some
+        replace SOURCE in the same scope, or when the (assignment-
+        resolved) target spelling carries a staging suffix."""
+        wt = _path_tokens(w.target)
+        for r in scope.replaces:
+            if r.src is not None and (_path_tokens(r.src) & wt):
+                return True
+        resolved = self.resolve_path(w.target, scope)
+        spelled = _safe_unparse(resolved) + " " + _safe_unparse(w.target)
+        return bool(_STAGED_SPELLING_RE.search(spelled))
+
+    def dir_identity(self, expr: ast.AST,
+                     scope: Scope) -> Optional[Tuple[str, str]]:
+        """(kind, identity) of the directory containing `expr`, or None.
+
+        kinds: "tempfile" (resolved through the tempfile module),
+        "join" (os.path.join(d, ...) -> identity of d), "sibling"
+        (path + suffix / dirname-of-variable -> identity dir(<path>)),
+        "const" (literal string). JXD302 only compares identities of the
+        SAME kind — mixed derivations are incomparable, not findings."""
+        expr = self.resolve_path(expr, scope)
+        if isinstance(expr, ast.Call):
+            r = self.ctx.resolve_call(expr)
+            if r and r.startswith("tempfile."):
+                return ("tempfile", r)
+            if r in ("os.path.join", "posixpath.join", "ntpath.join") \
+                    and expr.args:
+                d = self.resolve_path(expr.args[0], scope)
+                if isinstance(d, ast.Call):
+                    rd = self.ctx.resolve_call(d)
+                    if rd and rd.startswith("tempfile."):
+                        return ("tempfile", rd)
+                if isinstance(d, (ast.Name, ast.Attribute)):
+                    return ("join", _safe_unparse(d))
+                if isinstance(d, ast.Constant) and isinstance(d.value, str):
+                    return ("join", repr(d.value))
+                return None
+            return None
+        if isinstance(expr, ast.BinOp) and isinstance(expr.op, ast.Add):
+            left = expr.left
+            while isinstance(left, ast.BinOp) \
+                    and isinstance(left.op, ast.Add):
+                left = left.left
+            inner = self.dir_identity(left, scope)
+            if inner is not None:
+                # path + ".tmp" is a SIBLING of path: same directory
+                return inner
+            left = self.resolve_path(left, scope)
+            if isinstance(left, (ast.Name, ast.Attribute)):
+                return ("sibling", f"dir({_safe_unparse(left)})")
+            if isinstance(left, ast.Call):
+                return ("sibling", f"dir({_safe_unparse(left)})")
+            return None
+        if isinstance(expr, (ast.Name, ast.Attribute)):
+            return ("sibling", f"dir({_safe_unparse(expr)})")
+        if isinstance(expr, ast.Constant) and isinstance(expr.value, str):
+            head = expr.value.rsplit("/", 1)[0] if "/" in expr.value else "."
+            return ("const", head)
+        return None
+
+    def enclosing_functions(self, node: ast.AST) -> List[ast.AST]:
+        """Innermost-to-outermost FunctionDef chain containing `node`."""
+        chain: List[ast.AST] = []
+        p = self._parents.get(id(node))
+        while p is not None:
+            if isinstance(p, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                chain.append(p)
+            p = self._parents.get(id(p))
+        return chain
+
+    def point_covered(self, node: ast.AST) -> bool:
+        """Does any lexically enclosing function (including its nested
+        defs) call faults.point? Module-level sites check the whole
+        module."""
+        point_ids = {id(c) for c, _ in self.point_calls}
+        chain = self.enclosing_functions(node)
+        roots = chain if chain else [self.ctx.tree]
+        for root in roots:
+            for n in ast.walk(root):
+                if id(n) in point_ids:
+                    return True
+        return False

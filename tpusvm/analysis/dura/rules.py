@@ -1,0 +1,278 @@
+"""JXD301-306 — the crash-safety rules over the write-protocol model.
+
+PRs 7/14/15 made every durable artifact here crash-safe by HAND —
+staged-temp + os.replace writes, versioned journals, checkpointed
+solves — but the discipline was enforced only by the chaos tests that
+happened to exist. These rules machine-check it, the way JX001-010
+check tracing discipline and JXC201-206 check lock discipline:
+
+  JXD301  write to a committed final path without the staged-temp +
+          os.replace protocol (torn-file hazard)
+  JXD302  temp staged in a different directory than its replace target
+          (cross-device rename is copy+delete: atomicity lost)
+  JXD303  durable-state rename-commit site not covered by a registered
+          fault point (coverage cross-checked against faults/injection
+          POINTS — derived, not hand-listed); also any faults.point
+          literal naming an unregistered point
+  JXD304  format-versioned writer whose module reader never gates the
+          version field
+  JXD305  journal/commit ordering hazard: the journal deleted before
+          the artifact it covers is committed
+  JXD306  durable write without flush-before-rename where the module
+          claims kill-safety (the sanctioned spelling is
+          tpusvm.utils.durable.fsync_replace)
+
+Suppression: the shared ``# tpusvm: disable=JXD30x`` comments work, but
+the idiomatic form is ``# tpusvm: durable-by=<invariant>`` — it
+suppresses AND names the crash-safety invariant that makes the site
+safe (append-only with torn-tail-rejecting reader, best-effort rotation
+of already-persisted bytes, ...). An empty invariant is not a
+suppression.
+
+These rules live in their own registry (``all_dura_rules``) and run
+under ``python -m tpusvm.analysis dura`` with their own baseline
+(``.tpusvm-dura-baseline.json``). Pure stdlib, no jax/numpy — the
+no-jax CI lint job lists and runs it.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional
+
+from tpusvm.analysis.core import Finding, snippet_at
+from tpusvm.analysis.dura.model import (
+    _JOURNAL_RE,
+    DuraModel,
+    registered_points,
+)
+from tpusvm.analysis.registry import Rule
+
+DURA_RULES: Dict[str, Rule] = {}
+
+
+def dura_register(cls):
+    inst = cls()
+    if not inst.id:
+        raise ValueError(f"dura rule {cls.__name__} has no id")
+    if inst.id in DURA_RULES:
+        raise ValueError(f"duplicate dura rule id {inst.id}")
+    DURA_RULES[inst.id] = inst
+    return cls
+
+
+def all_dura_rules() -> Dict[str, Rule]:
+    return dict(sorted(DURA_RULES.items()))
+
+
+DURA_RULE_SUMMARIES = {
+    "JXD301": ("durable write straight onto a committed final path — no "
+               "staged-temp + os.replace, so a kill mid-write leaves a "
+               "torn file"),
+    "JXD302": ("temp file staged in a different directory than its "
+               "os.replace target (cross-device rename falls back to "
+               "copy+delete: atomicity lost)"),
+    "JXD303": ("durable-state commit site not covered by a registered "
+               "fault point (faults/injection.py POINTS), or a "
+               "faults.point literal naming an unregistered point"),
+    "JXD304": ("format-versioned writer whose module reader never gates "
+               "the version field (old files half-parse instead of "
+               "failing loudly)"),
+    "JXD305": ("journal deleted before the artifact it covers is "
+               "committed — a kill between the delete and the commit "
+               "strands an unrecoverable directory"),
+    "JXD306": ("os.replace on a kill-safe path without flush+fsync of "
+               "the staged bytes (use tpusvm.utils.durable."
+               "fsync_replace): rename can commit before data reaches "
+               "disk"),
+}
+
+
+def _finding(rule_id: str, model: DuraModel, node: ast.AST,
+             message: str) -> Finding:
+    ctx = model.ctx
+    return Finding(
+        rule=rule_id, path=ctx.path, line=node.lineno,
+        col=node.col_offset + 1, message=message,
+        snippet=snippet_at(ctx.lines, node.lineno),
+    )
+
+
+class DuraRule(Rule):
+    """A rule over one DuraModel (check_model, like the conc rules)."""
+
+    def check_model(self, model: DuraModel) -> List[Finding]:
+        raise NotImplementedError
+
+
+@dura_register
+class UnstagedDurableWrite(DuraRule):
+    id = "JXD301"
+    summary = DURA_RULE_SUMMARIES[id]
+
+    def check_model(self, model: DuraModel) -> List[Finding]:
+        out: List[Finding] = []
+        for scope in model.scopes:
+            for w in scope.writes:
+                if w.mode == "a":
+                    # append-only protocols are torn-TAIL territory; the
+                    # reader's job (read_trace rejects torn records)
+                    continue
+                if model.write_is_staged(w, scope):
+                    continue
+                out.append(_finding(
+                    self.id, model, w.node,
+                    "write lands directly on its final path (no staged "
+                    "temp + os.replace in this scope); a kill mid-write "
+                    "leaves a torn file where readers expect a committed "
+                    "artifact",
+                ))
+        return out
+
+
+@dura_register
+class CrossDirectoryStage(DuraRule):
+    id = "JXD302"
+    summary = DURA_RULE_SUMMARIES[id]
+
+    def check_model(self, model: DuraModel) -> List[Finding]:
+        out: List[Finding] = []
+        for scope in model.scopes:
+            for r in scope.replaces:
+                if r.src is None or r.dst is None:
+                    continue
+                src = model.dir_identity(r.src, scope)
+                dst = model.dir_identity(r.dst, scope)
+                if src is None or dst is None:
+                    continue
+                if src[0] == "tempfile" and dst[0] != "tempfile":
+                    out.append(_finding(
+                        self.id, model, r.node,
+                        "replace source is staged under tempfile's "
+                        "directory but the target lives elsewhere — "
+                        "os.replace across filesystems raises EXDEV (or "
+                        "degrades to copy+delete): stage the temp next "
+                        "to its target",
+                    ))
+                elif src[0] == dst[0] and src[1] != dst[1]:
+                    out.append(_finding(
+                        self.id, model, r.node,
+                        f"replace source directory ({src[1]}) differs "
+                        f"from target directory ({dst[1]}); a "
+                        "cross-device rename is not atomic — stage the "
+                        "temp in the target's directory",
+                    ))
+        return out
+
+
+@dura_register
+class UncoveredCommitSite(DuraRule):
+    id = "JXD303"
+    summary = DURA_RULE_SUMMARIES[id]
+
+    def check_model(self, model: DuraModel) -> List[Finding]:
+        out: List[Finding] = []
+        points = registered_points()
+        if points is not None:
+            for call, lit in model.point_calls:
+                if lit is not None and lit not in points:
+                    out.append(_finding(
+                        self.id, model, call,
+                        f"faults.point names {lit!r}, which is not in "
+                        "the registered POINTS set "
+                        "(tpusvm/faults/injection.py) — an active plan "
+                        "would reject it at the call site",
+                    ))
+        if not model.durable:
+            return out
+        for scope in model.scopes:
+            for r in scope.replaces:
+                if model.point_covered(r.node):
+                    continue
+                out.append(_finding(
+                    self.id, model, r.node,
+                    "durable-state commit (rename) site with no "
+                    "faults.point call in its enclosing function — this "
+                    "write protocol is invisible to every chaos plan "
+                    "and to the derived crash-window matrix "
+                    "(dura-matrix); register an injection point in "
+                    "faults/injection.py POINTS and call it on this "
+                    "path",
+                ))
+        return out
+
+
+@dura_register
+class UngatedVersionField(DuraRule):
+    id = "JXD304"
+    summary = DURA_RULE_SUMMARIES[id]
+
+    def check_model(self, model: DuraModel) -> List[Finding]:
+        if not model.durable or not model.has_readers:
+            return []
+        out: List[Finding] = []
+        seen = set()
+        for key, node in model.version_writes:
+            if key in model.read_keys or key in seen:
+                continue
+            seen.add(key)
+            out.append(_finding(
+                self.id, model, node,
+                f"writer stamps version field {key!r} but no reader in "
+                "this module ever gates it (subscript/.get/membership); "
+                "files from a different build will half-parse instead "
+                "of failing with a version error",
+            ))
+        return out
+
+
+@dura_register
+class JournalDeletedBeforeCommit(DuraRule):
+    id = "JXD305"
+    summary = DURA_RULE_SUMMARIES[id]
+
+    def check_model(self, model: DuraModel) -> List[Finding]:
+        out: List[Finding] = []
+        for scope in model.scopes:
+            if not scope.replaces:
+                continue
+            last_replace = max(r.node.lineno for r in scope.replaces)
+            for rm in scope.removes:
+                arg = ast.unparse(rm.args[0]) if rm.args else ""
+                if not _JOURNAL_RE.search(arg):
+                    continue
+                if rm.lineno < last_replace:
+                    out.append(_finding(
+                        self.id, model, rm,
+                        "journal removed BEFORE a later rename-commit "
+                        "in the same scope — a kill in between leaves "
+                        "an uncommitted artifact with its recovery "
+                        "journal already gone; commit first, delete "
+                        "the journal last",
+                    ))
+        return out
+
+
+@dura_register
+class RenameWithoutFsync(DuraRule):
+    id = "JXD306"
+    summary = DURA_RULE_SUMMARIES[id]
+
+    def check_model(self, model: DuraModel) -> List[Finding]:
+        if not (model.durable and model.kill_safe):
+            return []
+        out: List[Finding] = []
+        for scope in model.scopes:
+            has_fsync = bool(scope.fsyncs)
+            for r in scope.replaces:
+                if r.fsynced or has_fsync:
+                    continue
+                out.append(_finding(
+                    self.id, model, r.node,
+                    "kill-safe protocol commits with a bare os.replace: "
+                    "the rename can reach disk before the staged bytes "
+                    "do, so a power loss commits a hollow file — spell "
+                    "it tpusvm.utils.durable.fsync_replace (or fsync "
+                    "the staged fd first)",
+                ))
+        return out

@@ -14,6 +14,7 @@ EMPTY, so any finding fails the build.
 from __future__ import annotations
 
 import argparse
+import os
 import sys
 
 from tpusvm.analysis.baseline import load_baseline, write_baseline
@@ -104,8 +105,10 @@ def main(argv=None) -> int:
         return 0
 
     if args.json_out:
-        with open(args.json_out, "w", encoding="utf-8") as fh:
+        tmp = args.json_out + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as fh:
             fh.write(render_audit_json(result))
+        os.replace(tmp, args.json_out)
 
     if args.format == "json":
         print(render_audit_json(result), end="")

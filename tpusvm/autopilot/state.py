@@ -24,6 +24,9 @@ import dataclasses
 import json
 import os
 import zlib
+
+from tpusvm import faults
+from tpusvm.utils.durable import fsync_replace
 from typing import Dict, Optional
 
 STATE_VERSION = 1
@@ -71,11 +74,12 @@ def save_state(path: str, state: AutopilotState) -> None:
     payload = state.to_json()
     obj = {"crc32": zlib.crc32(_canonical(payload)) & 0xFFFFFFFF,
            **payload}
+    faults.point("autopilot.state", path=path, stage=state.stage)
     tmp = path + ".tmp"
     with open(tmp, "w") as f:
         json.dump(obj, f, indent=1, sort_keys=True)
         f.write("\n")
-    os.replace(tmp, path)
+    fsync_replace(tmp, path)
 
 
 def load_state(path: str) -> AutopilotState:

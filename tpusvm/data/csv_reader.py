@@ -20,6 +20,7 @@ vector<double>/vector<int>.
 
 from __future__ import annotations
 
+import os
 from typing import Iterator, Optional, Tuple
 
 import numpy as np
@@ -142,7 +143,9 @@ def read_csv_blocks(
 def write_csv(filename: str, X: np.ndarray, Y: np.ndarray) -> None:
     """Write (X, Y) in the format read_csv expects (header + last-column label)."""
     n, d = X.shape
-    with open(filename, "w") as f:
+    tmp = filename + ".tmp"
+    with open(tmp, "w") as f:
         f.write(",".join([f"f{j}" for j in range(d)] + ["label"]) + "\n")
         for i in range(n):
             f.write(",".join(repr(float(v)) for v in X[i]) + f",{int(Y[i])}\n")
+    os.replace(tmp, filename)

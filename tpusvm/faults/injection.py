@@ -49,6 +49,17 @@ rejected at load time):
   ``autopilot.refresh``       the supervisor's refresh stage — fit,
                               save, swap happen behind this point
                               (autopilot/loop.py)
+  ``stream.journal``          the fresh-ingest v1 journal write and the
+                              close() manifest/journal commit
+                              transitions (stream/format.py)
+  ``models.save``             the model-artifact atomic save
+                              (models/serialization.py)
+  ``serve.state_write``       the serve-registry manifest and compile-
+                              cache manifest commits (serve/cache.py)
+  ``autopilot.state``         the supervisor's CRC-fingerprinted state
+                              commit (autopilot/state.py)
+  ``cascade.checkpoint``      the cascade inter-round checkpoint write
+                              (parallel/cascade.py)
 
 Kill semantics: :class:`SimulatedKill` subclasses ``BaseException`` (like
 ``KeyboardInterrupt``), so no ``except Exception`` recovery path — not
@@ -84,6 +95,11 @@ POINTS = frozenset({
     "stream.append",
     "autopilot.tick",
     "autopilot.refresh",
+    "stream.journal",
+    "models.save",
+    "serve.state_write",
+    "autopilot.state",
+    "cascade.checkpoint",
 })
 
 KINDS = ("transient", "latency", "corrupt", "kill")

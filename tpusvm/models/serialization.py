@@ -45,6 +45,7 @@ from typing import Any, Dict
 
 import numpy as np
 
+from tpusvm import faults
 from tpusvm.config import KERNEL_FAMILIES, SVMConfig
 
 _FORMAT_VERSION = 4
@@ -109,6 +110,7 @@ def save_model(path: str, state: Dict[str, Any], config: SVMConfig) -> None:
     previous complete artifact or none, never a truncated .npz that a
     serve --watch loop would then try to stage."""
     out = _norm(path)
+    faults.point("models.save", path=out)
     tmp = out + ".tmp.npz"
     np.savez_compressed(
         tmp,

@@ -102,6 +102,7 @@ class Tracer:
             with open(backup) as f:
                 dropped = sum(1 for line in f if line.strip())
         self._f.close()
+        # tpusvm: durable-by=rotation renames already-persisted bytes; either name stays readable and read_trace rejects a torn tail
         os.replace(self.path, backup)
         self._f = open(self.path, "a")
         self._size = 0

@@ -96,6 +96,7 @@ def save_round_state(path: str, global_sv: SVBuffer, prev_ids, rnd: int,
         extra["n_shards"] = n_shards
     if topology is not None:
         extra["topology"] = topology
+    faults.point("cascade.checkpoint", path=path, round=rnd)
     tmp = path + ".tmp"
     np.savez_compressed(
         tmp,

@@ -188,11 +188,14 @@ def read_cache_manifest(cache_dir: str) -> dict:
 def record_signatures(cache_dir: str, signatures) -> dict:
     """Merge `signatures` (iterable of bucket_signature strings) into the
     manifest and write it atomically; returns the merged manifest."""
+    from tpusvm import faults
+
     manifest = read_cache_manifest(cache_dir)
     for sig in signatures:
         manifest["signatures"].setdefault(sig, _versions())
     manifest["versions"] = _versions()
     path = os.path.join(cache_dir, CACHE_MANIFEST_NAME)
+    faults.point("serve.state_write", path=path)
     tmp = path + ".tmp"
     with open(tmp, "w") as f:
         json.dump(manifest, f, sort_keys=True, indent=1)
@@ -209,11 +212,14 @@ def save_serve_state(path: str, models: Dict[str, dict],
     path-backed entries can be restored (in-process add_model entries
     have no durable source and are recorded with path=None so the
     restore names what it cannot bring back)."""
+    from tpusvm import faults
+
     state = {
         "format_version": SERVE_STATE_VERSION,
         "cache_dir": cache_dir,
         "models": models,
     }
+    faults.point("serve.state_write", path=path)
     tmp = path + ".tmp"
     with open(tmp, "w") as f:
         json.dump(state, f, sort_keys=True, indent=1)

@@ -37,6 +37,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from tpusvm import faults
+from tpusvm.utils.durable import fsync_replace
 from tpusvm.solver.blocked import _OuterState, blocked_smo_solve
 from tpusvm.solver.smo import SMOResult
 from tpusvm.status import Status
@@ -112,7 +113,7 @@ def save_solver_state(path: str, state: _OuterState, fingerprint: dict,
         np.savez(tmp, ckpt_version=SOLVER_CKPT_VERSION,
                  fingerprint=json.dumps(fingerprint, sort_keys=True),
                  **arrays)
-        os.replace(tmp + ".npz", path)  # np.savez appends .npz
+        fsync_replace(tmp + ".npz", path)  # np.savez appends .npz
 
     if retry is None:
         retry = faults.Retry(faults.DEFAULT_IO_POLICY,

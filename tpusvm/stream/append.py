@@ -51,6 +51,7 @@ import numpy as np
 
 from tpusvm import faults
 from tpusvm.status import StreamStatus
+from tpusvm.utils.durable import fsync_replace
 from tpusvm.stream.format import (
     JOURNAL_NAME,
     MANIFEST_NAME,
@@ -225,7 +226,7 @@ class AppendWriter(ShardWriter):
         with open(tmp, "w") as f:
             json.dump(obj, f, indent=1)
             f.write("\n")
-        os.replace(tmp, self._journal_path())
+        fsync_replace(tmp, self._journal_path())
 
     def _load_append_journal(self) -> Optional[dict]:
         jp = self._journal_path()
@@ -409,8 +410,8 @@ class AppendWriter(ShardWriter):
                 staged = os.path.join(self.out_dir,
                                       info.filename + ".stage")
                 if os.path.exists(staged):
-                    os.replace(staged,
-                               os.path.join(self.out_dir, info.filename))
+                    fsync_replace(staged,
+                                  os.path.join(self.out_dir, info.filename))
             manifest = Manifest(
                 n_rows=self._row_start,
                 n_features=int(self._n_features),
@@ -422,7 +423,7 @@ class AppendWriter(ShardWriter):
             with open(tmp, "w") as f:
                 json.dump(manifest.to_json(), f, indent=1)
                 f.write("\n")
-            os.replace(tmp, os.path.join(self.out_dir, MANIFEST_NAME))
+            fsync_replace(tmp, os.path.join(self.out_dir, MANIFEST_NAME))
             # manifest durable, journal not yet removed — a kill exactly
             # here is what the resume path's already-committed detection
             # recovers (idempotent re-close)

@@ -42,6 +42,7 @@ import numpy as np
 
 from tpusvm import faults
 from tpusvm.data.csv_reader import read_csv_blocks
+from tpusvm.utils.durable import fsync_replace
 from tpusvm.status import StreamStatus
 from tpusvm.stream.stats import (
     ShardStats,
@@ -233,6 +234,8 @@ class ShardWriter:
         self._closed = False
         self._retry = faults.Retry(faults.DEFAULT_IO_POLICY,
                                    op="ingest.write_shard")
+        self._journal_retry = faults.Retry(faults.DEFAULT_IO_POLICY,
+                                           op="stream.journal")
         os.makedirs(out_dir, exist_ok=True)
         if resume:
             self._load_journal()
@@ -264,7 +267,14 @@ class ShardWriter:
 
     def _write_journal(self) -> None:
         """Atomically record the durable shard table (one rewrite per
-        shard — O(shards^2) JSON total, noise next to the shard bytes)."""
+        shard — O(shards^2) JSON total, noise next to the shard bytes),
+        under the shared I/O retry: transients re-run the whole write,
+        kills at the ``stream.journal`` point leave the previous journal
+        (and the shard it described) intact for resume."""
+        self._journal_retry(self._write_journal_once)
+
+    def _write_journal_once(self) -> None:
+        faults.point("stream.journal", shards=len(self._shards))
         obj = {
             "journal_version": JOURNAL_VERSION,
             "rows_per_shard": self.rows_per_shard,
@@ -277,7 +287,7 @@ class ShardWriter:
         with open(tmp, "w") as f:
             json.dump(obj, f, indent=1)
             f.write("\n")
-        os.replace(tmp, self._journal_path())
+        fsync_replace(tmp, self._journal_path())
 
     def _load_journal(self) -> None:
         """Adopt a crashed ingest's durable prefix (resume=True).
@@ -394,7 +404,7 @@ class ShardWriter:
         tmp = path + ".tmp"
         with open(tmp, "wb") as f:
             f.write(payload)
-        os.replace(tmp, path)
+        fsync_replace(tmp, path)
 
     def _flush_shard(self, n: int) -> None:
         X, Y = self._take(n)
@@ -428,11 +438,18 @@ class ShardWriter:
             binary=self.binary,
             positive_label=self.positive_label,
         )
+        # commit transition 1: journal durable, manifest about to land —
+        # a kill here resumes by adopting every journaled shard and
+        # idempotently rewriting this manifest
+        faults.point("stream.journal", commit=True)
         tmp = os.path.join(self.out_dir, MANIFEST_NAME + ".tmp")
         with open(tmp, "w") as f:
             json.dump(self.manifest.to_json(), f, indent=1)
             f.write("\n")
-        os.replace(tmp, os.path.join(self.out_dir, MANIFEST_NAME))
+        fsync_replace(tmp, os.path.join(self.out_dir, MANIFEST_NAME))
+        # commit transition 2: manifest durable, journal not yet gone —
+        # a kill here is the already-committed case (resume re-closes)
+        faults.point("stream.journal", committed=True)
         # the manifest supersedes the journal: a committed dataset is no
         # longer a resumable crash site
         jp = self._journal_path()

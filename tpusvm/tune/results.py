@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import dataclasses
 import json
+import os
 from typing import Any, Dict, List
 
 # v2: the kernel-family search axis — results gain a `kernels` list, rows
@@ -62,9 +63,14 @@ class TuneResult:
 
 
 def save_tune_result(path: str, result: TuneResult) -> None:
-    with open(path, "w") as fh:
+    """Atomic write (temp + os.replace): a deploy/warm-start reader
+    racing a re-tune sees the old complete result or the new one,
+    never a torn half-written JSON."""
+    tmp = path + ".tmp"
+    with open(tmp, "w") as fh:
         json.dump(result.to_dict(), fh, indent=2)
         fh.write("\n")
+    os.replace(tmp, path)
 
 
 def load_tune_result(path: str) -> TuneResult:

@@ -1,4 +1,5 @@
+from tpusvm.utils.durable import fsync_replace
 from tpusvm.utils.logging import RunLogger
 from tpusvm.utils.timing import PhaseTimer, trace
 
-__all__ = ["PhaseTimer", "RunLogger", "trace"]
+__all__ = ["PhaseTimer", "RunLogger", "fsync_replace", "trace"]

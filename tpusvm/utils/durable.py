@@ -1,0 +1,42 @@
+"""The one sanctioned spelling of a flushed atomic commit.
+
+``os.replace`` alone makes a write atomic with respect to READERS — they
+see old bytes or new bytes, never a torn file — but not with respect to
+POWER LOSS: most filesystems may commit the rename to the journal before
+the staged file's data blocks reach disk, so a crash can leave the final
+name pointing at a hollow or truncated file. The journal/commit hot
+paths (ingest journals, append commits, solver checkpoints, autopilot
+state) promise kill-safety, which needs the full sequence:
+
+    flush stream -> fsync(staged fd) -> os.replace(staged, final)
+
+`fsync_replace` is that sequence; the JXD306 lint rule names it as the
+fix, and the dura static model recognises the call as an
+already-fsynced rename-commit.
+
+Directory-entry durability (fsync of the parent dir) is deliberately
+NOT included: the recovery journals tolerate a vanished rename (it
+replays), what they cannot tolerate is a *committed name with torn
+bytes* — exactly what the data fsync closes.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def fsync_replace(tmp_path: str, final_path: str) -> None:
+    """Atomically commit `tmp_path` over `final_path`, durably.
+
+    The staged file's bytes are fsync'd before the rename so the commit
+    can never outrun its data. Callers write + flush + close the staged
+    file first; this reopens it read-only to fsync, which keeps the
+    helper droppable into every existing `os.replace(tmp, path)` site
+    without restructuring the write above it."""
+    fd = os.open(tmp_path, os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+    # tpusvm: durable-by=callers stage the temp beside its target; the helper's opaque params carry no directory to compare
+    os.replace(tmp_path, final_path)
