@@ -1,0 +1,690 @@
+"""Routing tier: HRW placement, membership, health admission, rollout
+skew, the forwarding proxy, and the serve-side replica identity.
+
+The contracts under test (ISSUE 17 acceptance):
+  * HRW placement properties — LEAVE re-maps only the leaver's keys,
+    JOIN steals roughly 1/N of keys and nothing else, and the placement
+    table for one (keys, replicas, k, seed) is byte-identical across
+    processes and input container types;
+  * ReplicaSet membership — torn-proof immutable views, version ticks
+    only on real changes, listener sees every view in flip order;
+  * health state machine — ok/degraded (breaker OR burning SLO budget)/
+    draining/down with the down_after grace window, burn-aware
+    admission ordering (placed tier first, ok before degraded,
+    draining/down excluded);
+  * rollout skew — the window predicate (held iff skew > window),
+    unknown generations reported not guessed, staggered_rollout holds
+    instead of fanning out a split, and the per-replica swap POST is
+    never retried;
+  * the proxy — failover on connection failure/replica 503 under the
+    shared Retry machinery, 429 backpressure passed through WITHOUT
+    failover, NO_REPLICA/ALL_DOWN statuses, counters;
+  * serve replicas — stable persisted replica_id + uptime_s in
+    /healthz, the actual bound address recorded in serve_state.json
+    (the --port 0 contract), identity surviving a kill/revive.
+"""
+
+import json
+import threading
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpusvm import faults
+from tpusvm.config import SVMConfig
+from tpusvm.data import rings
+from tpusvm.models import BinarySVC
+from tpusvm.obs.registry import MetricsRegistry
+from tpusvm.router import (
+    HealthPoller,
+    ReplicaSet,
+    Router,
+    RouterConfig,
+    SkewReport,
+    check_skew,
+    generation_vector,
+    hrw_score,
+    place,
+    placement_table,
+    skew_of,
+    staggered_rollout,
+    table_bytes,
+)
+from tpusvm.serve import ServeConfig, Server
+from tpusvm.status import RouterStatus
+
+URLS = tuple(f"http://10.0.0.{i}:8400" for i in range(1, 7))
+KEYS = [f"model-{i}" for i in range(200)]
+
+
+# ------------------------------------------------------------- placement
+def test_hrw_score_is_seeded_and_stable():
+    assert hrw_score("m", "a") == hrw_score("m", "a")
+    assert hrw_score("m", "a") != hrw_score("m", "a", seed=1)
+    # length mixing: ("ab","c") and ("a","bc") must not collide
+    assert hrw_score("c", "ab") != hrw_score("bc", "a")
+
+
+def test_place_is_deterministic_top_k():
+    got = place("m", URLS, k=3, seed=7)
+    assert len(got) == 3 and len(set(got)) == 3
+    assert got == place("m", list(URLS), k=3, seed=7)
+    ranked = sorted(URLS, key=lambda r: (-hrw_score("m", r, 7), r))
+    assert got == tuple(ranked[:3])
+
+
+def test_place_k_below_one_raises():
+    with pytest.raises(ValueError, match="replication factor"):
+        place("m", URLS, k=0)
+    with pytest.raises(ValueError, match="replication factor"):
+        ReplicaSet(URLS, k=0)
+    with pytest.raises(ValueError, match="replication"):
+        RouterConfig(replicas=URLS, replication=0)
+
+
+def test_place_fewer_replicas_than_k_hosts_everywhere():
+    assert set(place("m", URLS[:2], k=5)) == set(URLS[:2])
+
+
+def test_table_bytes_reproducible_per_seed():
+    a = table_bytes(placement_table(KEYS, URLS, k=2, seed=3))
+    b = table_bytes(placement_table(tuple(KEYS), list(URLS), k=2, seed=3))
+    assert a == b
+    assert a != table_bytes(placement_table(KEYS, URLS, k=2, seed=4))
+
+
+def test_leave_moves_only_the_leavers_keys():
+    before = placement_table(KEYS, URLS, k=2, seed=5)
+    leaver = URLS[2]
+    after = placement_table(KEYS, [u for u in URLS if u != leaver],
+                            k=2, seed=5)
+    for key in KEYS:
+        if leaver in before[key]:
+            continue  # this key's placement may change (its slot refills)
+        assert after[key] == before[key], key
+
+
+def test_join_moves_at_most_its_fair_share():
+    n = len(URLS)
+    before = placement_table(KEYS, URLS, k=1, seed=5)
+    joined = URLS + ("http://10.0.0.99:8400",)
+    after = placement_table(KEYS, joined, k=1, seed=5)
+    moved = [k for k in KEYS if after[k] != before[k]]
+    # every moved key moved TO the joiner (nothing reshuffles elsewhere)
+    assert all(after[k] == (joined[-1],) for k in moved)
+    # expectation is len/ (n+1); allow a generous statistical margin
+    assert len(moved) <= 2.5 * len(KEYS) / (n + 1)
+
+
+# ------------------------------------------------------------ membership
+def test_replica_set_views_and_versions():
+    rs = ReplicaSet(("b", "a", "a"), k=1, seed=0)
+    assert rs.replicas() == ("a", "b")       # sorted, deduped
+    assert rs.version == 1
+    assert rs.join("c") and rs.version == 2
+    assert not rs.join("c") and rs.version == 2   # dedup: no tick
+    assert rs.leave("a") and rs.version == 3
+    assert not rs.leave("zz") and rs.version == 3
+    assert rs.replicas() == ("b", "c")
+    assert rs.placement("m") in (("b",), ("c",))
+    assert ReplicaSet((), k=1).placement("m") == ()
+
+
+def test_replica_set_listener_sees_every_view_in_order():
+    log = []
+    rs = ReplicaSet(("a",), k=1,
+                    listener=lambda v: log.append((v.version, v.replicas)))
+    rs.join("b")
+    rs.join("b")   # no-op: not logged
+    rs.leave("a")
+    assert log == [(1, ("a",)), (2, ("a", "b")), (3, ("b",))]
+    assert (rs.version, rs.replicas()) == log[-1]
+
+
+# ---------------------------------------------------------------- health
+def _payload(status="ok", gen=1, burning=(), breakers=None,
+             replica_id="r-x", uptime=12.5):
+    return {
+        "status": status,
+        "replica_id": replica_id,
+        "uptime_s": uptime,
+        "models": breakers or {"m": "closed"},
+        "swap": {"m": {"generation": gen}},
+        "slo": {name: {"burning": True} for name in burning},
+    }
+
+
+def _poller(fetches, **kw):
+    """Poller over stub replicas; `fetches[url]` is a callable or dict."""
+
+    def fetch(url, timeout_s=0.0):
+        f = fetches[url]
+        out = f() if callable(f) else f
+        if isinstance(out, Exception):
+            raise out
+        return out
+
+    kw.setdefault("down_after", 2)
+    return HealthPoller(tuple(fetches), interval_s=0.05, fetch=fetch,
+                        registry=MetricsRegistry(), **kw)
+
+
+def test_health_states_ok_degraded_draining():
+    p = _poller({
+        "a": _payload(),
+        "b": _payload(status="degraded"),
+        "c": _payload(burning=("m",)),        # burn-aware: ok + burning
+        "d": _payload(status="draining"),
+    })
+    assert p.poll_once() == {"a": "ok", "b": "degraded",
+                             "c": "degraded", "d": "draining"}
+    rec = p.snapshot()["a"]
+    assert rec.replica_id == "r-x" and rec.uptime_s == 12.5
+    assert rec.generations == {"m": 1} and rec.failures == 0
+    assert p.snapshot()["c"].burning == ("m",)
+
+
+def test_health_down_after_grace_window():
+    state = {"fail": False}
+
+    def flaky():
+        if state["fail"]:
+            return ConnectionRefusedError("refused")
+        return _payload()
+
+    p = _poller({"a": flaky}, down_after=2)
+    assert p.poll_once() == {"a": "ok"}
+    state["fail"] = True
+    # one missed poll keeps the previous state (transient blip)
+    assert p.poll_once() == {"a": "ok"}
+    assert p.snapshot()["a"].failures == 1
+    assert p.poll_once() == {"a": "down"}      # streak hits down_after
+    state["fail"] = False
+    assert p.poll_once() == {"a": "ok"}        # recovery resets
+    assert p.snapshot()["a"].failures == 0
+
+
+def test_health_never_polled_is_down_immediately():
+    p = _poller({"a": ConnectionRefusedError("refused")}, down_after=3)
+    assert p.poll_once() == {"a": "down"}      # polls == 0: no grace
+
+
+def test_health_poller_validates_knobs():
+    with pytest.raises(ValueError, match="interval_s"):
+        HealthPoller(("a",), interval_s=0.0, registry=MetricsRegistry())
+    with pytest.raises(ValueError, match="down_after"):
+        HealthPoller(("a",), down_after=0, registry=MetricsRegistry())
+
+
+def test_admissible_tiers_and_exclusions():
+    p = _poller({
+        "a": _payload(),                       # ok
+        "b": _payload(burning=("m",)),         # degraded
+        "c": _payload(status="draining"),      # excluded
+        "d": ConnectionRefusedError("x"),      # down: excluded
+        "e": _payload(),                       # ok (fallback tier)
+    })
+    p.poll_once()
+    # placed tier first, ok before degraded inside each tier
+    assert p.admissible(["b", "a", "c", "d"],
+                        fallback=["e", "b"]) == ["a", "b", "e"]
+    # a replica the poller has never seen is excluded outright
+    assert p.admissible(["zz"], fallback=[]) == []
+
+
+# ------------------------------------------------------------------ skew
+class _Rec:
+    def __init__(self, state="ok", polls=1, gens=None):
+        self.state = state
+        self.polls = polls
+        self.generations = gens or {}
+
+
+def test_generation_vector_and_skew():
+    snap = {
+        "a": _Rec(gens={"m": 3}),
+        "b": _Rec(gens={"m": 5}),
+        "c": _Rec(state="down", gens={"m": 9}),   # down: unknown
+        "d": _Rec(polls=0),                       # never polled: unknown
+        "e": _Rec(gens={}),                       # no such model: unknown
+    }
+    vec = generation_vector(snap, "m")
+    assert vec == {"a": 3, "b": 5, "c": None, "d": None, "e": None}
+    assert skew_of(vec) == 2
+    assert skew_of({"a": None, "b": 4}) == 0    # < 2 known gens
+    rep = check_skew(snap, "m", window=1)
+    assert isinstance(rep, SkewReport)
+    assert rep.held and rep.skew == 2 and rep.unknown == ("c", "d", "e")
+    assert rep.laggards == ("a",)
+    assert check_skew(snap, "m", window=2).held is False  # boundary
+    j = rep.to_json()
+    assert j["held"] and j["laggards"] == ["a"] and j["skew"] == 2
+
+
+def test_check_skew_window_validation():
+    with pytest.raises(ValueError, match="window"):
+        check_skew({}, "m", window=-1)
+
+
+def test_staggered_rollout_swaps_each_replica_once():
+    gens = {"a": 1, "b": 1, "c": 1}
+    p = _poller({u: (lambda u=u: _payload(gen=gens[u])) for u in gens})
+    posts = []
+
+    def post(url, obj, timeout_s=0.0):
+        posts.append((url, obj["name"]))
+        base = url[:-len("/admin/swap")]
+        gens[base] += 1
+        return 200, {"swapped": True, "generation": gens[base]}
+
+    out = staggered_rollout(p, "m", "/tmp/v2.npz", window=1, post=post)
+    assert out["status"] == RouterStatus.OK.name
+    assert out["swapped"] == ["a", "b", "c"] and not out["failed"]
+    assert out["report"]["skew"] == 0 and not out["report"]["unknown"]
+    # non-idempotent: exactly one POST per replica, in sorted order
+    assert posts == [(u + "/admin/swap", "m") for u in ("a", "b", "c")]
+
+
+def test_staggered_rollout_holds_on_skew_and_posts_nothing():
+    p = _poller({"a": _payload(gen=1), "b": _payload(gen=4)})
+    posts = []
+
+    def post(url, obj, timeout_s=0.0):
+        posts.append(url)
+        return 200, {"swapped": True, "generation": 5}
+
+    out = staggered_rollout(p, "m", "/x.npz", window=1, post=post)
+    assert out["status"] == RouterStatus.SKEW_HOLD.name
+    assert posts == [] and out["swapped"] == []
+    assert out["report"]["laggards"] == ["a"]
+
+
+def test_staggered_rollout_skips_down_and_records_409():
+    gens = {"a": 1, "b": 1}
+    fetches = {
+        "a": lambda: _payload(gen=gens["a"]),
+        "b": lambda: _payload(gen=gens["b"]),
+        "c": ConnectionRefusedError("dead"),
+    }
+    p = _poller(fetches, down_after=1)
+
+    def post(url, obj, timeout_s=0.0):
+        base = url[:-len("/admin/swap")]
+        if base == "b":
+            return 409, {"error": "stage failed, rolled back"}
+        gens[base] += 1
+        return 200, {"swapped": True, "generation": gens[base]}
+
+    out = staggered_rollout(p, "m", "/x.npz", window=1, post=post)
+    assert out["swapped"] == ["a"] and out["skipped"] == ["c"]
+    assert "b" in out["failed"] and "409" in out["failed"]["b"]
+    # a+1 vs b at gen 1 is skew 1: inside the window, rollout completes
+    assert out["status"] == RouterStatus.OK.name
+
+
+# ----------------------------------------------------------------- proxy
+def _router(fetches, transport, **cfg_kw):
+    cfg_kw.setdefault("replicas", tuple(sorted(fetches)))
+    cfg_kw.setdefault("replication", 2)
+    cfg_kw.setdefault("poll_interval_s", 10.0)
+
+    def fetch(url, timeout_s=0.0):
+        f = fetches[url]
+        out = f() if callable(f) else f
+        if isinstance(out, Exception):
+            raise out
+        return out
+
+    r = Router(RouterConfig(**cfg_kw), transport=transport, fetch=fetch,
+               registry=MetricsRegistry(), log_fn=None)
+    r.poller.poll_once()
+    return r
+
+
+def _metric(router, name):
+    return sum(m["value"] for m in router._registry.snapshot()["metrics"]
+               if m["name"] == name)
+
+
+def test_forward_success_passes_through():
+    calls = []
+
+    def transport(url, body, timeout_s):
+        calls.append(url)
+        return 200, b'{"scores": [1.5]}', None
+
+    r = _router({"http://a": _payload(), "http://b": _payload()},
+                transport)
+    code, data, ra = r.forward("m", b'{"instances": [[0, 0]]}')
+    assert (code, ra) == (200, None)
+    assert json.loads(data)["scores"] == [1.5]
+    assert calls == [r.replica_set.placement("m")[0]
+                     + "/v1/models/m:predict"]
+    assert _metric(r, "router.requests") == 1
+    assert _metric(r, "router.failovers") == 0
+    r.poller.stop()
+
+
+def test_forward_fails_over_on_connection_failure_and_503():
+    for failure in (faults.TransientIOError("refused"),
+                    (503, b'{"error": "half-dead"}', None)):
+        first = []
+
+        def transport(url, body, timeout_s, failure=failure, first=first):
+            if not first:
+                first.append(url)
+                if isinstance(failure, Exception):
+                    raise failure
+                return failure
+            return 200, b'{"scores": [2.0]}', None
+
+        r = _router({"http://a": _payload(), "http://b": _payload()},
+                    transport)
+        code, data, _ = r.forward("m", b"{}")
+        assert code == 200 and json.loads(data)["scores"] == [2.0]
+        assert _metric(r, "router.failovers") == 1
+        assert _metric(r, "router.retries") == 1
+        r.poller.stop()
+
+
+def test_forward_429_backpressure_never_fails_over():
+    calls = []
+
+    def transport(url, body, timeout_s):
+        calls.append(url)
+        return 429, b'{"error": "OVERLOADED"}', None
+
+    r = _router({"http://a": _payload(), "http://b": _payload()},
+                transport)
+    code, _data, ra = r.forward("m", b"{}")
+    assert code == 429
+    assert ra == "1"            # honest backpressure default hint
+    assert len(calls) == 1      # no failover: load is not bounced
+    assert _metric(r, "router.failovers") == 0
+    r.poller.stop()
+
+
+def test_forward_all_down_and_no_replica():
+    def transport(url, body, timeout_s):
+        raise faults.TransientIOError("refused")
+
+    r = _router({"http://a": _payload(), "http://b": _payload()},
+                transport)
+    code, data, _ = r.forward("m", b"{}")
+    assert code == 503
+    assert json.loads(data)["router"] == RouterStatus.ALL_DOWN.name
+    assert _metric(r, "router.failovers") == 1
+    r.poller.stop()
+
+    r2 = _router({"http://a": ConnectionRefusedError("dead")}, transport,
+                 down_after=1)
+    code, data, _ = r2.forward("m", b"{}")
+    assert code == 503
+    assert json.loads(data)["router"] == RouterStatus.NO_REPLICA.name
+    assert _metric(r2, "router.no_replica") == 1
+    r2.poller.stop()
+
+
+def test_forward_attempts_pass_the_fault_point():
+    def transport(url, body, timeout_s):
+        return 200, b"{}", None
+
+    r = _router({"http://a": _payload(), "http://b": _payload()},
+                transport)
+    plan = faults.FaultPlan([faults.FaultRule(
+        point="router.forward", kind="transient", p=1.0, max_hits=1)])
+    with faults.active(plan):
+        code, _, _ = r.forward("m", b"{}")
+    assert code == 200            # injected transient absorbed by failover
+    assert plan.hits("router.forward") == 2
+    assert _metric(r, "router.retries") == 1
+    assert _metric(r, "router.failovers") == 1
+    r.poller.stop()
+
+
+def test_router_status_and_health_rollup():
+    assert [s.name for s in RouterStatus] == [
+        "OK", "NO_REPLICA", "ALL_DOWN", "SKEW_HOLD"]
+
+    def transport(url, body, timeout_s):
+        return 200, b"{}", None
+
+    r = _router({}, transport, replicas=())
+    assert r.status_code() == RouterStatus.NO_REPLICA
+    assert r.health()["status"] == "down"
+    r.poller.stop()
+
+    r = _router({"http://a": ConnectionRefusedError("dead")}, transport,
+                down_after=1)
+    assert r.status_code() == RouterStatus.ALL_DOWN
+    r.poller.stop()
+
+    r = _router({"http://a": _payload()}, transport)
+    assert r.status_code() == RouterStatus.OK
+    with r._lock:
+        r._holds["m"] = {"skew": 2}
+    assert r.status_code() == RouterStatus.SKEW_HOLD
+    h = r.health()
+    assert h["status"] == "degraded" and h["holds"]["m"]["skew"] == 2
+    assert h["placement"]["replicas"] == ["http://a"]
+    r.poller.stop()
+
+
+def test_router_rollout_sets_and_clears_hold(monkeypatch):
+    # the hold state machine, with the rollout driver itself stubbed:
+    # SKEW_HOLD installs the report on /healthz, a later OK clears it
+    import tpusvm.router.proxy as proxy_mod
+
+    outcomes = [
+        {"status": RouterStatus.SKEW_HOLD.name, "swapped": [],
+         "skipped": [], "failed": {}, "report": {"skew": 2}},
+        {"status": RouterStatus.OK.name, "swapped": ["http://a"],
+         "skipped": [], "failed": {}, "report": {"skew": 0}},
+    ]
+    seen = []
+
+    def stub(poller, model, path, window=1, **kw):
+        seen.append((model, path, window))
+        return outcomes[len(seen) - 1]
+
+    monkeypatch.setattr(proxy_mod, "staggered_rollout", stub)
+    r = _router({"http://a": _payload()}, lambda *a: (200, b"{}", None),
+                skew_window=2)
+    out = r.rollout("m", "/x.npz")
+    assert out["status"] == RouterStatus.SKEW_HOLD.name
+    assert r.holds() == {"m": {"skew": 2}}
+    assert r.status_code() == RouterStatus.SKEW_HOLD
+    out = r.rollout("m", "/x.npz", window=1)
+    assert out["status"] == RouterStatus.OK.name
+    assert not r.holds() and r.status_code() == RouterStatus.OK
+    # config skew_window is the default; an explicit window overrides
+    assert seen == [("m", "/x.npz", 2), ("m", "/x.npz", 1)]
+    r.poller.stop()
+
+
+# -------------------------------------------- HTTP front door (end to end)
+@pytest.fixture(scope="module")
+def served_fleet():
+    """Two real in-process serve replicas + a router front door."""
+    from tpusvm.serve.http import make_http_server, start_http_thread
+
+    X, Y = rings(n=240, seed=2)
+    model = BinarySVC(SVMConfig(C=10.0, gamma=10.0),
+                      dtype=jnp.float32).fit(X, Y)
+    servers, urls = [], []
+    for _ in range(2):
+        srv = Server(ServeConfig(max_batch=8), dtype=jnp.float32)
+        srv.add_model("m", model)
+        srv.warmup()
+        httpd = make_http_server(srv, port=0)
+        srv.attach_http(httpd, start_http_thread(httpd))
+        host, port = httpd.server_address[:2]
+        servers.append(srv)
+        urls.append(f"http://{host}:{port}")
+    router = Router(RouterConfig(replicas=tuple(urls), replication=2,
+                                 seed=3, poll_interval_s=10.0),
+                    registry=MetricsRegistry(), log_fn=None)
+    router.poller.poll_once()
+    from tpusvm.router import make_router_http
+    httpd = make_router_http(router, port=0)
+    router.attach_http(httpd,
+                       threading.Thread(target=httpd.serve_forever,
+                                        daemon=True))
+    router._http_thread.start()
+    host, port = httpd.server_address[:2]
+    yield servers, router, f"http://{host}:{port}", model
+    router.close()
+    for srv in servers:
+        srv.close()
+
+
+def _get(url):
+    import urllib.request
+    with urllib.request.urlopen(url, timeout=10) as resp:
+        return resp.status, json.loads(resp.read())
+
+
+def _post(url, obj):
+    import urllib.error
+    import urllib.request
+    req = urllib.request.Request(
+        url, data=json.dumps(obj).encode(),
+        headers={"Content-Type": "application/json"}, method="POST")
+    try:
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read() or b"{}")
+
+
+def test_router_http_predict_and_introspection(served_fleet):
+    servers, router, base, model = served_fleet
+    Xq, _ = rings(n=4, seed=3)
+    ref = np.asarray(model.decision_function(Xq)).ravel()
+    code, out = _post(base + "/v1/models/m:predict",
+                      {"instances": np.asarray(Xq, float).tolist()})
+    assert code == 200
+    got = np.asarray(out["scores"], float).ravel()
+    assert np.array_equal(got.astype(np.float32),
+                          ref.astype(np.float32))
+
+    code, h = _get(base + "/healthz")
+    assert code == 200 and h["router"] == RouterStatus.OK.name
+    assert set(h["replicas"].values()) == {"ok"}
+
+    code, detail = _get(base + "/v1/replicas")
+    assert code == 200 and len(detail) == 2
+    for rec in detail.values():
+        assert rec["state"] == "ok" and rec["replica_id"]
+        assert rec["uptime_s"] >= 0 and rec["generations"] == {"m": 1}
+
+    import urllib.request
+    with urllib.request.urlopen(base + "/metrics", timeout=10) as resp:
+        text = resp.read().decode()
+    assert "tpusvm_router_requests_total" in text
+    assert "tpusvm_router_forwards_total" in text
+
+    code, err = _post(base + "/admin/rollout", {"name": "m"})
+    assert code == 400 and "path" in err["error"]
+
+
+def test_router_http_join_leave(served_fleet):
+    _servers, router, base, _model = served_fleet
+    v0 = router.replica_set.version
+    code, out = _post(base + "/admin/join", {"url": "http://10.9.9.9:1"})
+    assert code == 200 and out["changed"] and out["version"] == v0 + 1
+    code, out = _post(base + "/admin/leave", {"url": "http://10.9.9.9:1"})
+    assert code == 200 and out["changed"] and out["version"] == v0 + 2
+    assert "http://10.9.9.9:1" not in router.replica_set.replicas()
+
+
+# ------------------------------------------------- serve replica identity
+def test_serve_health_reports_replica_id_and_uptime(served_fleet):
+    servers, _router, _base, _model = served_fleet
+    h = servers[0].health()
+    assert h["replica_id"].startswith("r-") and len(h["replica_id"]) == 10
+    assert h["uptime_s"] >= 0
+    # ids are per-replica stable and distinct across the fleet
+    assert servers[0].health()["replica_id"] == h["replica_id"]
+    assert servers[1].health()["replica_id"] != h["replica_id"]
+
+
+def test_serve_state_records_bound_address_and_identity(tmp_path):
+    X, Y = rings(n=240, seed=2)
+    model = BinarySVC(SVMConfig(C=10.0, gamma=10.0),
+                      dtype=jnp.float32).fit(X, Y)
+    mp = str(tmp_path / "m.npz")
+    model.save(mp)
+    state = str(tmp_path / "serve_state.json")
+    with Server(ServeConfig(max_batch=8), dtype=jnp.float32) as srv:
+        srv.enable_state(state)
+        srv.load_model("m", mp)
+        srv.set_bound_address("127.0.0.1", 45678)
+        assert srv.bound_address == "127.0.0.1:45678"
+        first_id = srv.replica_id
+    persisted = json.loads(open(state).read())
+    assert persisted["address"] == "127.0.0.1:45678"
+    assert persisted["replica_id"] == first_id
+    # the revive: a fresh process adopts the persisted identity
+    with Server(ServeConfig(max_batch=8), dtype=jnp.float32) as srv2:
+        restored = srv2.restore_state(state)
+        assert isinstance(restored, dict)
+        assert srv2.replica_id == first_id
+
+
+def test_serve_port_zero_binds_ephemeral(tmp_path):
+    from tpusvm.serve.http import make_http_server, start_http_thread
+    X, Y = rings(n=240, seed=2)
+    model = BinarySVC(SVMConfig(C=10.0, gamma=10.0),
+                      dtype=jnp.float32).fit(X, Y)
+    state = str(tmp_path / "serve_state.json")
+    with Server(ServeConfig(max_batch=8), dtype=jnp.float32) as srv:
+        srv.enable_state(state)
+        srv.add_model("m", model)
+        httpd = make_http_server(srv, port=0)
+        srv.attach_http(httpd, start_http_thread(httpd))
+        host, port = httpd.server_address[:2]
+        assert port != 0
+        srv.set_bound_address(host, port)
+    persisted = json.loads(open(state).read())
+    assert persisted["address"] == f"{host}:{port}"
+
+
+# ----------------------------------------------------------- wiring pins
+def test_fault_point_and_chaos_plan_cover_the_router():
+    assert "router.forward" in faults.POINTS
+    import os
+    plan = json.loads(open(os.path.join(
+        os.path.dirname(__file__), "fixtures",
+        "chaos_plan.json")).read())
+    kinds = sorted(r["kind"] for r in plan["rules"]
+                   if r["point"] == "router.forward")
+    assert kinds == ["latency", "transient"]
+
+
+def test_ci_runs_the_router_gates():
+    import os
+    ci = open(os.path.join(os.path.dirname(__file__), "..", ".github",
+                           "workflows", "ci.yml")).read()
+    assert "router chaos smoke" in ci
+    assert "router-chaos-smoke" in ci
+    assert "router_fanout" in ci
+
+
+def test_conc_stress_registers_the_router_suite():
+    from tpusvm.analysis.conc import stress
+    assert "router" in stress.SUITES
+    assert "router" in stress.REAL_SUITES
+    assert "router.flip" in stress.SUITE_SITES["router"]
+    # a short real run: torn-view or version-skip violations raise
+    stress.stress_router(seed=1, iters=40, threads=3)
+
+
+def test_benchdiff_schema_covers_router_fanout():
+    from tpusvm.obs.benchdiff import KEY_FIELDS, SCHEMA_RULES
+    assert "replicas" in KEY_FIELDS
+    rules = {r.metric: r for r in SCHEMA_RULES["router_fanout"]}
+    assert rules["lost_responses"].direction == "=="
+    assert rules["failover_ok"].direction == "=="
+    assert rules["qps"].timing and rules["p99_ms"].timing

@@ -47,6 +47,14 @@ Suites (run all: `python -m tpusvm.analysis conc-stress`):
             own generation stamp disagreeing with the generation the
             registry reports), generations must be monotone per reader,
             and the final count must equal 1 + successful swaps;
+  router    router ReplicaSet membership: mutator threads join/leave
+            replicas with the membership lock perturbed across the view
+            flip while a reader spins on view()/placement — a reader
+            must never observe a torn view (a published version whose
+            member tuple disagrees with the serialized flip log), view
+            versions must be monotone per reader, placement must be a
+            pure function of the view, and the final version must equal
+            1 + applied membership changes;
   racy      a DELIBERATELY broken fixture (read-modify-write with no
             lock) the harness must catch — the self-test proving the
             perturber actually amplifies races (`--self-test`).
@@ -76,6 +84,8 @@ SUITE_SITES = {
     "breaker": ("breaker.step",),
     "swap": ("swap.lock.acquire", "swap.lock.release", "swap.read",
              "swap.flip"),
+    "router": ("router.lock.acquire", "router.lock.release",
+               "router.read", "router.mutate", "router.flip"),
     "racy": ("racy.rmw",),
 }
 
@@ -671,6 +681,98 @@ def stress_swap(seed: int = DEFAULT_SEED, iters: int = 120,
     return _report("swap", p, violations, t0)
 
 
+def stress_router(seed: int = DEFAULT_SEED, iters: int = 150,
+                  threads: int = 4) -> StressReport:
+    """router ReplicaSet membership: the view flip perturbed.
+
+    The REAL membership object (router/placement.py) with its lock
+    wrapped by PerturbLock: `threads` mutator threads join/leave unique
+    replicas while one reader spins on view() + placement. The listener
+    — called under the lock BEFORE publication, ReplicaSet's documented
+    contract — appends each flipped view to a log, so the log IS the
+    serialized flip order. Invariants — the lock-free-read contract the
+    proxy's forwarding hot path builds on:
+
+      * no torn view: every observed (version, replicas) pair equals
+        the logged pair for that version (a view assembled outside the
+        lock parks exactly where the perturber sleeps);
+      * monotone: view versions observed by the reader never decrease;
+      * pure placement: placing a key against a captured view is
+        repeatable and stays inside that view's members;
+      * exact count: the final version is 1 + applied membership
+        changes (no flip lost, none double-counted)."""
+    from tpusvm.router.placement import ReplicaSet, place
+
+    p = SchedulePerturber(seed)
+    t0 = time.perf_counter()
+    log: Dict[int, tuple] = {}
+    llock = threading.Lock()
+
+    def listener(view):
+        with llock:
+            log[view.version] = view.replicas
+        p.perturb("router.flip")
+
+    rs = ReplicaSet([f"http://seed{i}" for i in range(4)], k=2, seed=7,
+                    listener=listener)
+    rs._lock = PerturbLock(p, "router.lock", inner=rs._lock)
+    violations: List[str] = []
+    vlock = threading.Lock()
+    stop = threading.Event()
+    applied = [0] * threads
+
+    def mutator(t):
+        def run():
+            for i in range(iters):
+                url = f"http://m{t}-{i}"
+                if rs.join(url):
+                    applied[t] += 1
+                p.perturb("router.mutate")
+                if rs.leave(url):
+                    applied[t] += 1
+        return run
+
+    def reader():
+        last = 0
+        while not stop.is_set():
+            v = rs.view()
+            p.perturb("router.read")
+            with llock:
+                logged = log.get(v.version)
+            if logged != v.replicas:
+                with vlock:
+                    violations.append(
+                        f"torn view: version {v.version} published "
+                        f"{v.replicas} but the flip log recorded "
+                        f"{logged}")
+            if v.version < last:
+                with vlock:
+                    violations.append(
+                        f"view version went backwards: {v.version} "
+                        f"after {last}")
+            last = v.version
+            placed = place("m", v.replicas, k=rs.k, seed=rs.seed)
+            if placed != place("m", v.replicas, k=rs.k, seed=rs.seed) \
+                    or not set(placed) <= set(v.replicas):
+                with vlock:
+                    violations.append(
+                        f"placement of a captured view is not pure: "
+                        f"{placed} over {v.replicas}")
+
+    rthread = threading.Thread(target=reader, daemon=True)
+    rthread.start()
+    violations += _run_threads([mutator(t) for t in range(threads)])
+    stop.set()
+    rthread.join(timeout=30.0)
+    final = rs.version
+    want = 1 + sum(applied)
+    if final != want:
+        violations.append(
+            f"final view version {final} != 1 + {sum(applied)} applied "
+            "membership changes")
+    return _report("router", p, violations, t0)
+
+
 # ----------------------------------------------------------- self-test
 class RacyTally:
     """DELIBERATELY racy: classic read-modify-write with no lock. The
@@ -714,12 +816,14 @@ SUITES: Dict[str, Callable[..., StressReport]] = {
     "reader": stress_reader,
     "breaker": stress_breaker,
     "swap": stress_swap,
+    "router": stress_router,
     "racy": stress_racy,
 }
 
 # the real-object suites --smoke runs (racy is the self-test, expected
 # to FAIL — it proves the harness catches what it exists to catch)
-REAL_SUITES = ("registry", "batcher", "reader", "breaker", "swap")
+REAL_SUITES = ("registry", "batcher", "reader", "breaker", "swap",
+               "router")
 
 
 def self_test(seeds: Sequence[int] = range(8)) -> Optional[StressReport]:

@@ -653,6 +653,49 @@ def _build_parser() -> argparse.ArgumentParser:
                     metavar="N")
     ap.add_argument("-q", "--quiet", action="store_true")
 
+    ro = sub.add_parser(
+        "router", parents=[common],
+        help="multi-replica routing tier (tpusvm.router): an HTTP front "
+        "door over N `tpusvm serve` replicas — HRW placement, burn-aware "
+        "admission, failover on connection failure/503, staggered "
+        "rollouts with skew holds")
+    ro.add_argument("--replica", action="append", default=[],
+                    metavar="URL", dest="replicas",
+                    help="replica base URL (http://host:port), "
+                    "repeatable; the initial membership — /admin/join "
+                    "and /admin/leave mutate it live")
+    ro.add_argument("--host", default="127.0.0.1")
+    ro.add_argument("--port", type=int, default=8470,
+                    help="router HTTP port (0 = ephemeral; default 8470)")
+    ro.add_argument("--replication", type=int, default=2, metavar="K",
+                    help="HRW replication factor: a model's requests "
+                    "prefer its K placed replicas (default 2)")
+    ro.add_argument("--seed", type=int, default=0,
+                    help="placement seed (tables are byte-reproducible "
+                    "per seed)")
+    ro.add_argument("--poll-interval-s", type=float, default=1.0,
+                    help="replica /healthz poll period (default 1.0)")
+    ro.add_argument("--down-after", type=int, default=2,
+                    help="consecutive failed polls that mark a replica "
+                    "down (default 2; one blip keeps its state)")
+    ro.add_argument("--health-timeout-s", type=float, default=2.0,
+                    help="per-poll fetch timeout (default 2.0)")
+    ro.add_argument("--forward-timeout-s", type=float, default=10.0,
+                    help="per-attempt forward timeout (default 10.0)")
+    ro.add_argument("--skew-window", type=int, default=1,
+                    help="rollout generation-skew hold threshold "
+                    "(default 1: the steady staggered state)")
+    ro.add_argument("--smoke", action="store_true",
+                    help="CI gate: an in-process two-replica fleet "
+                    "behind the router — concurrent clients, a replica "
+                    "outage mid-run (failover must absorb it), a "
+                    "staggered rollout; asserts zero lost responses, "
+                    "every score bitwise one of the two generations, "
+                    "and a skew-free final vector")
+    ro.add_argument("--smoke-threads", type=int, default=4)
+    ro.add_argument("--smoke-requests", type=int, default=40,
+                    help="requests per smoke thread")
+
     tu = sub.add_parser(
         "tune", parents=[common],
         help="cross-validated (C, gamma) search with warm-started fits "
@@ -1872,9 +1915,13 @@ def _cmd_serve(args) -> int:
     # bound port is released) + thread join — no leaked listener
     server.attach_http(httpd)
     host, port = httpd.server_address[:2]
+    # with --port 0 the kernel chose the port just now: record the real
+    # address into serve_state.json (when --state is on) and flush the
+    # line, so a supervisor/chaos harness can discover where we bound
+    server.set_bound_address(host, port)
     print(f"serving on http://{host}:{port} "
           f"(POST /v1/models/<name>:predict, POST /admin/swap, "
-          f"GET /metrics)")
+          f"GET /metrics)", flush=True)
     try:
         with _profile_trace(args.profile):
             httpd.serve_forever()
@@ -1929,6 +1976,244 @@ def _serve_smoke(server, n_threads: int, n_requests: int) -> int:
             print(f"SMOKE FAILED {name}: statuses={bad} errors={errors} "
                   f"recompiles={recompiles}")
         return 1
+    return 0
+
+
+def _cmd_router(args) -> int:
+    import json
+
+    from tpusvm.router import Router, RouterConfig, make_router_http
+
+    if args.smoke:
+        return _router_smoke(args)
+    if not args.replicas:
+        raise SystemExit("router: no fleet — pass --replica URL "
+                         "(repeatable) or --smoke")
+    cfg = RouterConfig(
+        replicas=tuple(args.replicas),
+        replication=args.replication,
+        seed=args.seed,
+        poll_interval_s=args.poll_interval_s,
+        down_after=args.down_after,
+        health_timeout_s=args.health_timeout_s,
+        forward_timeout_s=args.forward_timeout_s,
+        skew_window=args.skew_window,
+    )
+    router = Router(cfg).start()
+    httpd = make_router_http(router, host=args.host, port=args.port)
+    router.attach_http(httpd)
+    host, port = httpd.server_address[:2]
+    print(f"routing on http://{host}:{port} over "
+          f"{len(cfg.replicas)} replicas (k={cfg.replication}, "
+          f"seed={cfg.seed}) — POST /v1/models/<name>:predict, "
+          f"POST /admin/rollout|join|leave, GET /healthz /metrics "
+          f"/v1/replicas", flush=True)
+    try:
+        httpd.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        print(router.metrics_text(), end="")
+        print(json.dumps(router.health()))
+        router.close()
+    return 0
+
+
+def _router_smoke(args) -> int:
+    """CI gate: an in-process two-replica fleet behind the router.
+
+    Concurrent clients stream through Router.forward while one replica
+    goes dark mid-run (its HTTP listener stops — failover must absorb
+    it invisibly) and comes back for a staggered rollout. Asserts zero
+    lost responses, every score bitwise one of the two generations, a
+    skew-free final vector, and byte-reproducible placement tables."""
+    import json
+    import os
+    import tempfile
+    import threading
+    import time
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tpusvm.config import SVMConfig
+    from tpusvm.data import rings
+    from tpusvm.models import BinarySVC
+    from tpusvm.router import (
+        Router,
+        RouterConfig,
+        placement_table,
+        table_bytes,
+    )
+    from tpusvm.serve import ServeConfig, Server
+    from tpusvm.serve.http import (
+        make_http_server,
+        start_http_thread,
+        stop_http_server,
+    )
+    from tpusvm.status import RouterStatus
+
+    failures = []
+    Xa, Ya = rings(n=240, seed=2)
+    Xb, Yb = rings(n=240, seed=9)
+    A = BinarySVC(SVMConfig(C=10.0, gamma=10.0),
+                  dtype=jnp.float32).fit(Xa, Ya)
+    B = BinarySVC(SVMConfig(C=10.0, gamma=5.0),
+                  dtype=jnp.float32).fit(Xb, Yb)
+    Xq, _ = rings(n=16, seed=3)
+
+    with tempfile.TemporaryDirectory() as td:
+        pa = os.path.join(td, "v1.npz")
+        pb = os.path.join(td, "v2.npz")
+        A.save(pa)
+        B.save(pb)
+        replicas, frontends = [], []
+        try:
+            for i in range(2):
+                srv = Server(ServeConfig(max_batch=8), dtype=jnp.float32)
+                srv.load_model("m", pa)
+                srv.warmup()
+                httpd = make_http_server(srv, port=0)
+                srv.attach_http(httpd, start_http_thread(httpd))
+                host, port = httpd.server_address[:2]
+                replicas.append(srv)
+                frontends.append((httpd, host, port))
+            urls = [f"http://{h}:{p}" for _, h, p in frontends]
+            refA, _ = replicas[0].predict_direct("m", Xq)
+            refA = [float(v) for v in np.asarray(refA).ravel()]
+            with Server(ServeConfig(max_batch=8),
+                        dtype=jnp.float32) as orc:
+                orc.load_model("m", pb)
+                rb, _ = orc.predict_direct("m", Xq)
+            refB = [float(v) for v in np.asarray(rb).ravel()]
+            if refA == refB:
+                print("ROUTER SMOKE FAILED: generations are not "
+                      "distinguishable — the bitwise gate is vacuous")
+                return 1
+
+            keys = ["m", "m-shadow", "m-canary"]
+            if table_bytes(placement_table(keys, urls, k=2, seed=3)) \
+                    != table_bytes(placement_table(list(keys),
+                                                   tuple(urls),
+                                                   k=2, seed=3)):
+                failures.append("placement tables for one seed are "
+                                "not byte-identical")
+
+            # the poller is deliberately SLOW to mark replicas down
+            # (0.9s grace): the outage below must be discovered by
+            # forward failures, i.e. the failover path, not admission
+            router = Router(RouterConfig(
+                replicas=tuple(urls), replication=2, seed=3,
+                poll_interval_s=0.3, down_after=3,
+                forward_timeout_s=15.0), log_fn=lambda m: None)
+            router.start()
+            bad = []
+            bad_lock = threading.Lock()
+            phase2 = threading.Event()  # set once the rollout finished
+
+            def client(t):
+                for i in range(args.smoke_requests):
+                    idx = (t + i) % len(Xq)
+                    body = json.dumps(
+                        {"instances":
+                         [np.asarray(Xq[idx], float).tolist()]}).encode()
+                    code, data, _ra = router.forward("m", body)
+                    if code == 429:
+                        time.sleep(0.05)
+                        continue
+                    if code != 200:
+                        with bad_lock:
+                            bad.append(("code", code, data[:120]))
+                        continue
+                    s = json.loads(data)["scores"][0]
+                    if isinstance(s, list):
+                        s = s[0]
+                    allowed = ([refB[idx]] if phase2.is_set()
+                               else [refA[idx], refB[idx]])
+                    if s not in allowed:
+                        with bad_lock:
+                            bad.append(("torn", idx, s))
+
+            # phase 1: concurrent load while the replica every "m"
+            # request PREFERS (first in placement order) goes DARK —
+            # so the outage is guaranteed to be met by forwards and
+            # must be absorbed by failover to the second placement
+            dark = urls.index(router.replica_set.placement("m")[0])
+
+            def metric(name):
+                return sum(m["value"] for m
+                           in router._registry.snapshot()["metrics"]
+                           if m["name"] == name)
+
+            threads = [threading.Thread(target=client, args=(t,))
+                       for t in range(args.smoke_threads)]
+            for t in threads:
+                t.start()
+            # cut the cord only once a quarter of the load is through —
+            # wall-clock sleeps race 2ms in-process forwards
+            target = (args.smoke_threads * args.smoke_requests) // 4
+            deadline = time.monotonic() + 30.0
+            while metric("router.requests") < target \
+                    and time.monotonic() < deadline:
+                time.sleep(0.005)
+            httpd0, host0, port0 = frontends[dark]
+            stop_http_server(httpd0)  # the outage: connection refused
+            for t in threads:
+                t.join(60.0)
+            if bad:
+                failures.append(f"lost/torn responses during the "
+                                f"outage: {bad[:5]} ({len(bad)} total)")
+            failovers = metric("router.failovers")
+            if not failovers:
+                failures.append("the outage never exercised failover "
+                                "(router.failovers == 0)")
+
+            # phase 2: the dark replica returns on ITS port; rollout
+            httpd0b = make_http_server(replicas[dark], host=host0,
+                                       port=port0)
+            replicas[dark].attach_http(httpd0b,
+                                       start_http_thread(httpd0b))
+            frontends[dark] = (httpd0b, host0, port0)
+            deadline = time.monotonic() + 10.0
+            while time.monotonic() < deadline:
+                router.poller.poll_once()
+                if all(s == "ok"
+                       for s in router.poller.states().values()):
+                    break
+                time.sleep(0.1)
+            out = router.rollout("m", pb)
+            rep = out["report"]
+            gens = set(rep["vector"].values())
+            if out["status"] != RouterStatus.OK.name or out["failed"] \
+                    or len(out["swapped"]) != 2 or rep["skew"] != 0 \
+                    or rep["unknown"] or len(gens) != 1:
+                failures.append(f"rollout not clean/skew-free: {out}")
+            phase2.set()
+            threads = [threading.Thread(target=client, args=(t,))
+                       for t in range(args.smoke_threads)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(60.0)
+            if bad:
+                failures.append(f"post-rollout responses off the new "
+                                f"generation: {bad[:5]}")
+            h = router.health()
+            if h["router"] != RouterStatus.OK.name:
+                failures.append(f"router health not OK at the end: {h}")
+            router.close()
+        finally:
+            for srv in replicas:
+                srv.close()
+
+    if failures:
+        for f in failures:
+            print(f"ROUTER SMOKE FAILED: {f}")
+        return 1
+    total = args.smoke_threads * args.smoke_requests * 2
+    print(f"router smoke ok: {total} requests over 2 replicas, 0 "
+          f"lost/torn (failovers {int(failovers)} absorbed the outage), "
+          f"rollout skew-free, placement bytes reproducible")
     return 0
 
 
@@ -2765,6 +3050,7 @@ def main(argv=None) -> int:
     return {"train": _cmd_train, "ingest": _cmd_ingest,
             "predict": _cmd_predict, "serve": _cmd_serve,
             "refresh": _cmd_refresh, "autopilot": _cmd_autopilot,
+            "router": _cmd_router,
             "tune": _cmd_tune, "info": _cmd_info,
             "report": _cmd_report,
             "benchdiff": _cmd_benchdiff}[args.command](args)

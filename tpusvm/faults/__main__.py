@@ -23,6 +23,22 @@ Commands:
                       the injected causes, every failed stage rolled
                       back to a serving old generation with /healthz
                       degraded, and a subsequent clean swap recovers.
+  router-chaos-smoke  The routing-tier CI gate: N REAL `tpusvm serve`
+                      replica PROCESSES (spawned on ephemeral ports,
+                      discovered through serve_state.json) behind an
+                      in-process Router front door, under multi-threaded
+                      client load — while replicas are SIGKILLed and
+                      revived on their recorded ports (keeping their
+                      persisted replica identity) and router.forward
+                      faults inject transients/latency into the fabric
+                      itself. Asserts: zero lost responses (every client
+                      request ends 200 with a score bitwise-equal to one
+                      of the two live generations; 429 backpressure is
+                      retried, nothing else tolerated), a staggered
+                      rollout completes skew-free to a uniform
+                      generation vector, placement tables are
+                      byte-identical per seed, revived replicas keep
+                      their replica_id, and the injected faults fired.
   autopilot-chaos-smoke
                       The closed-loop online-learning CI gate (kill at
                       EVERY stage): while client threads stream
@@ -544,6 +560,284 @@ def _autopilot_chaos_smoke() -> int:
     return 0
 
 
+def _router_chaos_smoke() -> int:
+    import json
+    import os
+    import subprocess
+    import threading
+    import time
+    import urllib.error
+    import urllib.request
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tpusvm import faults
+    from tpusvm.config import SVMConfig
+    from tpusvm.data import rings
+    from tpusvm.models import BinarySVC
+    from tpusvm.router import (
+        Router,
+        RouterConfig,
+        make_router_http,
+        placement_table,
+        table_bytes,
+    )
+    from tpusvm.serve import ServeConfig, Server
+    from tpusvm.serve.http import start_http_thread
+    from tpusvm.status import RouterStatus
+
+    N_REPLICAS = 3
+    N_CLIENTS = 4
+    failures = []
+
+    Xa, Ya = rings(n=240, seed=2)
+    Xb, Yb = rings(n=240, seed=9)
+    A = BinarySVC(SVMConfig(C=10.0, gamma=10.0),
+                  dtype=jnp.float32).fit(Xa, Ya)
+    Bm = BinarySVC(SVMConfig(C=10.0, gamma=5.0),
+                   dtype=jnp.float32).fit(Xb, Yb)
+    Xq, _ = rings(n=16, seed=3)
+    rows = [np.asarray(Xq[i], float).tolist() for i in range(len(Xq))]
+
+    with tempfile.TemporaryDirectory() as td:
+        pa = os.path.join(td, "v1.npz")
+        pb = os.path.join(td, "v2.npz")
+        A.save(pa)
+        Bm.save(pb)
+        # the bitwise oracles: the SAME scoring arithmetic the replica
+        # processes run, via the sequential in-process path
+        with Server(ServeConfig(max_batch=8), dtype=jnp.float32) as orc:
+            orc.load_model("m", pa)
+            ra, _ = orc.predict_direct("m", Xq)
+            orc.swap("m", pb)
+            rb, _ = orc.predict_direct("m", Xq)
+        refA = [float(v) for v in np.asarray(ra).ravel()]
+        refB = [float(v) for v in np.asarray(rb).ravel()]
+        if refA == refB:
+            print("ROUTER CHAOS SMOKE FAILED: the two generations are "
+                  "not distinguishable — the bitwise oracle is vacuous")
+            return 1
+
+        def state_path(i):
+            return os.path.join(td, f"rep{i}", "serve_state.json")
+
+        logs = []
+
+        def spawn(i, port=0):
+            """One REAL replica process. port=0 first boot (ephemeral,
+            satellite: the bound port is discovered from the state
+            file); a revive passes the recorded port back in and
+            restores the model set + replica identity from --state."""
+            os.makedirs(os.path.dirname(state_path(i)), exist_ok=True)
+            log = open(os.path.join(td, f"rep{i}.log"), "ab")
+            logs.append(log)
+            cmd = [sys.executable, "-m", "tpusvm", "serve",
+                   "--platform", "cpu", "--host", "127.0.0.1",
+                   "--port", str(port), "--state", state_path(i),
+                   "--max-batch", "8", "--no-warmup"]
+            if port == 0:
+                cmd += ["--model", f"m={pa}"]
+            return subprocess.Popen(cmd, stdout=log,
+                                    stderr=subprocess.STDOUT)
+
+        def wait_ready(i, deadline_s=120.0):
+            """Discover the replica's bound address from its state file,
+            then wait for /healthz ok; (url, replica_id)."""
+            t0 = time.monotonic()
+            while time.monotonic() - t0 < deadline_s:
+                try:
+                    with open(state_path(i)) as f:
+                        st = json.load(f)
+                    addr = st.get("address")
+                    if addr and st.get("models"):
+                        url = f"http://{addr}"
+                        with urllib.request.urlopen(
+                                url + "/healthz", timeout=2.0) as r:
+                            payload = json.loads(r.read())
+                        if payload.get("status") == "ok":
+                            return url, st.get("replica_id")
+                except (OSError, ValueError):
+                    pass
+                time.sleep(0.2)
+            raise RuntimeError(f"replica {i} not serving within "
+                               f"{deadline_s:g}s (see rep{i}.log)")
+
+        procs = [spawn(i) for i in range(N_REPLICAS)]
+        router = None
+        stop = threading.Event()
+        try:
+            ready = [wait_ready(i) for i in range(N_REPLICAS)]
+            urls = [u for u, _ in ready]
+            ids0 = dict(ready)
+
+            # placement byte-reproducibility: two independent
+            # constructions of the same (keys, replicas, k, seed)
+            keys = ["m", "m-shadow", "m-canary"]
+            if table_bytes(placement_table(keys, urls, k=2, seed=7)) \
+                    != table_bytes(placement_table(list(keys),
+                                                   tuple(urls),
+                                                   k=2, seed=7)):
+                failures.append("placement tables for one seed are not "
+                                "byte-identical")
+
+            router = Router(RouterConfig(
+                replicas=tuple(urls), replication=2, seed=7,
+                poll_interval_s=0.2, down_after=2,
+                forward_timeout_s=30.0), log_fn=lambda m: None)
+            router.start()
+            httpd = make_router_http(router, port=0)
+            router.attach_http(httpd, start_http_thread(httpd))
+            rhost, rport = httpd.server_address[:2]
+            router_url = f"http://{rhost}:{rport}"
+
+            # chaos INSIDE the fabric: two deterministic transient
+            # forwards (each consumes one failover) + forward latency
+            plan = faults.FaultPlan([
+                faults.FaultRule(point="router.forward",
+                                 kind="transient", at_hit=5),
+                faults.FaultRule(point="router.forward",
+                                 kind="transient", at_hit=23),
+                faults.FaultRule(point="router.forward", kind="latency",
+                                 p=0.2, delay_ms=1.0, max_hits=16),
+            ], seed=20260806)
+
+            bad = []
+            bad_lock = threading.Lock()
+            counts = [0] * N_CLIENTS
+
+            def client(t):
+                i = t
+                while not stop.is_set():
+                    idx = i % len(rows)
+                    body = json.dumps({"instances": [rows[idx]]}).encode()
+                    req = urllib.request.Request(
+                        router_url + "/v1/models/m:predict", data=body,
+                        headers={"Content-Type": "application/json"},
+                        method="POST")
+                    try:
+                        with urllib.request.urlopen(req,
+                                                    timeout=30.0) as r:
+                            code, raw = r.status, r.read()
+                    except urllib.error.HTTPError as e:
+                        code, raw = e.code, e.read()
+                    except Exception as e:  # noqa: BLE001 — transport
+                        # failure to the ROUTER itself = a lost response
+                        with bad_lock:
+                            bad.append(("transport",
+                                        f"{type(e).__name__}: {e}"))
+                        i += 1
+                        continue
+                    if code == 429:
+                        time.sleep(0.1)  # backpressure: same row again
+                        continue
+                    if code != 200:
+                        with bad_lock:
+                            bad.append(("code", code, raw[:160]))
+                    else:
+                        s = json.loads(raw)["scores"][0]
+                        if isinstance(s, list):
+                            s = s[0]
+                        if s != refA[idx] and s != refB[idx]:
+                            with bad_lock:
+                                bad.append(("torn", idx, s))
+                        counts[t] += 1
+                    i += 1
+
+            threads = [threading.Thread(target=client, args=(t,))
+                       for t in range(N_CLIENTS)]
+            kills = revives = 0
+            with faults.active(plan):
+                for t in threads:
+                    t.start()
+                time.sleep(1.0)
+                # kill + revive two replicas, one at a time, under load
+                for i in (0, 1):
+                    procs[i].kill()  # real SIGKILL, nothing flushed
+                    procs[i].wait()
+                    kills += 1
+                    time.sleep(1.0)  # clients keep scoring via failover
+                    with open(state_path(i)) as f:
+                        st = json.load(f)
+                    port = int(st["address"].rsplit(":", 1)[1])
+                    procs[i] = spawn(i, port=port)
+                    url, rid = wait_ready(i)
+                    revives += 1
+                    if url != urls[i]:
+                        failures.append(
+                            f"replica {i} revived on {url}, not its "
+                            f"recorded address {urls[i]}")
+                    if rid != ids0[urls[i]]:
+                        failures.append(
+                            f"replica {i} lost its identity across the "
+                            f"revive ({ids0[urls[i]]} -> {rid})")
+                time.sleep(0.8)  # poller re-admits the revived replicas
+                # staggered rollout v1 -> v2 across the fleet, under load
+                out = router.rollout("m", pb)
+                if out["status"] != RouterStatus.OK.name:
+                    failures.append(f"rollout did not complete: {out}")
+                if out["failed"]:
+                    failures.append(f"rollout swaps failed: "
+                                    f"{out['failed']}")
+                if len(out["swapped"]) != N_REPLICAS:
+                    failures.append(
+                        f"rollout reached {len(out['swapped'])}/"
+                        f"{N_REPLICAS} replicas "
+                        f"(skipped {out['skipped']})")
+                rep = out["report"]
+                gens = set(rep["vector"].values())
+                if rep["skew"] != 0 or rep["unknown"] or len(gens) != 1 \
+                        or None in gens:
+                    failures.append("final generation vector is not "
+                                    f"uniform/skew-free: {rep}")
+                time.sleep(0.5)  # post-rollout traffic on the new gen
+                stop.set()
+                for t in threads:
+                    t.join(30.0)
+            faults.deactivate()
+
+            if bad:
+                failures.append(f"lost/torn responses under chaos: "
+                                f"{bad[:5]} ({len(bad)} total)")
+            if min(counts) == 0:
+                failures.append(f"a client thread scored nothing: "
+                                f"{counts}")
+            if plan.hits("router.forward") == 0:
+                failures.append("no router.forward fault ever fired")
+            h = router.health()
+            if h["router"] != RouterStatus.OK.name:
+                failures.append(f"router health did not recover: {h}")
+        finally:
+            stop.set()
+            if router is not None:
+                router.close()
+            for p in procs:
+                p.kill()
+            for p in procs:
+                try:
+                    p.wait(timeout=10.0)
+                except Exception:  # noqa: BLE001 — teardown best-effort
+                    pass
+            for log in logs:
+                log.close()
+
+    if failures:
+        for f in failures:
+            print(f"ROUTER CHAOS SMOKE FAILED: {f}")
+        return 1
+    print(f"router chaos smoke ok: {N_REPLICAS} replica processes, "
+          f"{N_CLIENTS} client threads ({sum(counts)} responses, 0 "
+          f"lost/torn), {kills} SIGKILLs absorbed with identity-"
+          f"preserving revives, staggered rollout skew-free to a "
+          f"uniform generation vector, placement bytes reproducible, "
+          f"{plan.hits('router.forward')} router.forward fault-point "
+          f"hits")
+    return 0
+
+
 def main(argv=None) -> int:
     argv = sys.argv[1:] if argv is None else argv
     if not argv or argv[0] in ("-h", "--help"):
@@ -554,6 +848,8 @@ def main(argv=None) -> int:
         return _kill_resume_smoke()
     if cmd == "swap-chaos-smoke":
         return _swap_chaos_smoke()
+    if cmd == "router-chaos-smoke":
+        return _router_chaos_smoke()
     if cmd == "autopilot-chaos-smoke":
         return _autopilot_chaos_smoke()
     if cmd == "validate":

@@ -60,6 +60,10 @@ rejected at load time):
                               commit (autopilot/state.py)
   ``cascade.checkpoint``      the cascade inter-round checkpoint write
                               (parallel/cascade.py)
+  ``router.forward``          the routing tier's per-replica forward
+                              attempt — transient/latency rules here
+                              exercise failover to the next placement
+                              under client load (router/proxy.py)
 
 Kill semantics: :class:`SimulatedKill` subclasses ``BaseException`` (like
 ``KeyboardInterrupt``), so no ``except Exception`` recovery path — not
@@ -100,6 +104,7 @@ POINTS = frozenset({
     "serve.state_write",
     "autopilot.state",
     "cascade.checkpoint",
+    "router.forward",
 })
 
 KINDS = ("transient", "latency", "corrupt", "kill")

@@ -44,7 +44,7 @@ KEY_FIELDS = (
     "bench", "metric", "summary", "mode", "engine", "kernel", "task",
     "config", "threads", "topology", "P", "n", "n_train", "d", "q",
     "seed", "case", "rows_per_shard", "telemetry", "smoke", "rung",
-    "bucket", "B", "arm", "D",
+    "bucket", "B", "arm", "D", "replicas",
 )
 
 
@@ -164,6 +164,25 @@ SCHEMA_RULES: Dict[str, Tuple[Rule, ...]] = {
         Rule("misses", "<="),
         Rule("first_prediction_s", "<=", rel_tol=0.5, timing=True),
         Rule("warm_speedup", ">=", rel_tol=0.4, timing=True),
+    ),
+    # routing-tier round, the fan-out gate: rows pair on (bench, arm,
+    # replicas, threads, n, smoke). lost_responses is the zero-loss
+    # claim and is gated EXACT (the committed baseline's 0 then enforces
+    # staying 0 — one lost response is a regression, not noise), as is
+    # failover_ok (the failover arm's own verdict that the outage was
+    # absorbed). no_replica may only fall. failovers/retries are
+    # direction-gated with a wide band (their exact counts depend on
+    # where in the stream the outage lands), and the throughput/latency
+    # columns are timing rules, skipped at smoke level
+    "router_fanout": (
+        Rule("lost_responses", "=="),
+        Rule("failover_ok", "=="),
+        Rule("no_replica", "<="),
+        Rule("failovers", "<=", rel_tol=1.0),
+        Rule("retries", "<=", rel_tol=1.0),
+        Rule("qps", ">=", rel_tol=0.3, timing=True),
+        Rule("p50_ms", "<=", rel_tol=0.5, timing=True),
+        Rule("p99_ms", "<=", rel_tol=0.5, timing=True),
     ),
     # round 9, the solver speed ladder: per-rung rows pair on (bench,
     # rung, n, d, q). Correctness metrics are exact — every rung must

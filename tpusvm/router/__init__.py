@@ -1,0 +1,77 @@
+"""tpusvm.router — the multi-replica serving fabric (routing tier).
+
+PR 14/15 made ONE serving replica unkillable (atomic hot-swap, persisted
+compile cache, crash-safe state); this package is the horizontal axis the
+ROADMAP's "heavy traffic" item needs: a stdlib-HTTP front door over N
+`tpusvm serve` replicas — the Cascade-SVM merge-coordinator role of the
+reference's MPI star topology (rank-0, PAPER.md) reborn as a
+serving-plane coordinator.
+
+  placement.py  deterministic rendezvous (HRW) hashing: model name ->
+                replica set with a configurable replication factor;
+                stable under join/leave (only the moved keys re-map) and
+                byte-reproducible per seed, plus the torn-free
+                ReplicaSet membership view the proxy reads lock-free
+  health.py     background poller over every replica's /healthz feeding
+                a per-replica state machine (ok / degraded / draining /
+                down) with burn-aware admission: a replica whose SLO
+                budget burns is deprioritized BEFORE its breaker trips
+  rollout.py    generation-skew detection for staggered hot-swap
+                rollouts: the per-model generation vector across
+                replicas (healthz's swap block); skew beyond the window
+                holds the rollout and reports instead of fanning a bad
+                artifact fleet-wide
+  proxy.py      the threaded HTTP front door (`tpusvm router`): forwards
+                predict requests to the placed replica, fails over to
+                the next placement on connection failure or 503 under
+                the shared Retry/DEFAULT_IO_POLICY machinery, maps
+                backpressure honestly (replica 429 -> client 429 +
+                Retry-After), and serves its own /healthz + /metrics
+
+Chaos gate: `python -m tpusvm.faults router-chaos-smoke` — real replica
+processes killed and revived under multi-threaded client load; zero lost
+responses, every response bitwise one of the live generations, and a
+staggered rollout completing skew-free.
+"""
+
+from tpusvm.router.health import (
+    REPLICA_STATES,
+    STATE_CODES,
+    HealthPoller,
+    ReplicaHealth,
+)
+from tpusvm.router.placement import (
+    ReplicaSet,
+    hrw_score,
+    place,
+    placement_table,
+    table_bytes,
+)
+from tpusvm.router.proxy import Router, RouterConfig, make_router_http
+from tpusvm.router.rollout import (
+    SkewReport,
+    check_skew,
+    generation_vector,
+    skew_of,
+    staggered_rollout,
+)
+
+__all__ = [
+    "HealthPoller",
+    "REPLICA_STATES",
+    "ReplicaHealth",
+    "ReplicaSet",
+    "Router",
+    "RouterConfig",
+    "STATE_CODES",
+    "SkewReport",
+    "check_skew",
+    "generation_vector",
+    "hrw_score",
+    "make_router_http",
+    "place",
+    "placement_table",
+    "skew_of",
+    "staggered_rollout",
+    "table_bytes",
+]

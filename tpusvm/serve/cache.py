@@ -205,13 +205,19 @@ def record_signatures(cache_dir: str, signatures) -> dict:
 
 # ----------------------------------------------------------- serve state
 def save_serve_state(path: str, models: Dict[str, dict],
-                     cache_dir: Optional[str] = None) -> None:
+                     cache_dir: Optional[str] = None,
+                     address: Optional[str] = None,
+                     replica_id: Optional[str] = None) -> None:
     """Atomically persist the registry manifest.
 
     `models` maps name -> {"path": source .npz, "generation": int}; only
     path-backed entries can be restored (in-process add_model entries
     have no durable source and are recorded with path=None so the
-    restore names what it cannot bring back)."""
+    restore names what it cannot bring back). `address` records the
+    ACTUAL bound HTTP host:port (`serve --port 0` picks it at bind
+    time) and `replica_id` the replica's fleet identity — both optional
+    keys readers tolerate being absent, so version 1 states from before
+    the routing tier still load."""
     from tpusvm import faults
 
     state = {
@@ -219,6 +225,10 @@ def save_serve_state(path: str, models: Dict[str, dict],
         "cache_dir": cache_dir,
         "models": models,
     }
+    if address is not None:
+        state["address"] = address
+    if replica_id is not None:
+        state["replica_id"] = replica_id
     faults.point("serve.state_write", path=path)
     tmp = path + ".tmp"
     with open(tmp, "w") as f:

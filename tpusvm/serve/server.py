@@ -34,6 +34,7 @@ from __future__ import annotations
 import dataclasses
 import threading
 import time
+import uuid
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import jax.numpy as jnp
@@ -384,9 +385,15 @@ class Server:
     """In-process serving frontend over named SVM models."""
 
     def __init__(self, config: ServeConfig = ServeConfig(),
-                 dtype=jnp.float32):
+                 dtype=jnp.float32, replica_id: Optional[str] = None):
         self.config = config
         self.dtype = dtype
+        # the replica's fleet identity: minted once per fresh replica,
+        # persisted in serve_state.json and re-adopted by restore_state,
+        # so a revived replica keeps its identity across kill/restart
+        # (the routing tier keys its health records on it)
+        self.replica_id = replica_id or f"r-{uuid.uuid4().hex[:8]}"
+        self._start_t = time.monotonic()
         self.registry = ModelRegistry()
         self._workers: Dict[str, _ModelWorker] = {}
         self._lock = threading.Lock()
@@ -401,6 +408,7 @@ class Server:
         self._http_thread = None
         self._state_path: Optional[str] = None
         self._cache_dir: Optional[str] = None
+        self._bound_address: Optional[str] = None
 
     # ----------------------------------------------------------- hosting
     def _install(self, entry: ModelEntry) -> ModelEntry:
@@ -497,6 +505,18 @@ class Server:
         self._state_path = path
         self._persist_state()
 
+    def set_bound_address(self, host: str, port: int) -> None:
+        """Record the ACTUAL bound HTTP address (host, port) into the
+        persisted state. With `serve --port 0` the kernel picks the
+        port, so serve_state.json is where a supervisor (or the chaos
+        harness reviving this replica) reads the real address back."""
+        self._bound_address = f"{host}:{int(port)}"
+        self._persist_state()
+
+    @property
+    def bound_address(self) -> Optional[str]:
+        return self._bound_address
+
     def _persist_state(self) -> None:
         if self._state_path is None:
             return
@@ -507,7 +527,9 @@ class Server:
             e, gen = self.registry.get_versioned(n)
             models[n] = {"path": e.source_path, "generation": gen}
         save_serve_state(self._state_path, models,
-                         cache_dir=self._cache_dir)
+                         cache_dir=self._cache_dir,
+                         address=self._bound_address,
+                         replica_id=self.replica_id)
 
     def restore_state(self, path: str) -> dict:
         """Reload the model set recorded in a serve_state.json: every
@@ -518,6 +540,11 @@ class Server:
         from tpusvm.serve.cache import load_serve_state
 
         state = load_serve_state(path)
+        if state.get("replica_id"):
+            # a revived replica IS the replica that wrote the state:
+            # keep its fleet identity (the router's health records and
+            # the chaos harness both key on it across kill/restart)
+            self.replica_id = state["replica_id"]
         restored, skipped = [], []
         for name, info in sorted(state["models"].items()):
             if name in self.registry:
@@ -676,7 +703,9 @@ class Server:
             status = "degraded"
         else:
             status = "ok"
-        out = {"status": status, "models": breakers, "swap": swap}
+        out = {"status": status, "models": breakers, "swap": swap,
+               "replica_id": self.replica_id,
+               "uptime_s": round(time.monotonic() - self._start_t, 3)}
         if slo:
             out["slo"] = {
                 n: {"latency_burn": st["latency_burn"],

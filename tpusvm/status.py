@@ -149,6 +149,34 @@ class AutopilotStatus(enum.IntEnum):
     REFRESH_TIMEOUT = 6
 
 
+class RouterStatus(enum.IntEnum):
+    """Fleet-level outcome codes for the routing tier (tpusvm.router).
+
+    The single-replica conditions already have ServeStatus codes; these
+    are the conditions that only EXIST once there is a fleet, reported
+    on the router's /healthz and by `tpusvm router`'s rollout driver:
+
+      OK          replicas are admissible and rollouts are skew-free
+      NO_REPLICA  placement produced no candidate at all — the replica
+                  set is empty, or every member is unknown to the
+                  health poller (never successfully polled); nothing
+                  was forwarded
+      ALL_DOWN    candidates existed but every one was down, draining
+                  or failed the forward — the whole placement (and the
+                  fallback tier) was exhausted
+      SKEW_HOLD   a staggered rollout's generation vector spread beyond
+                  the skew window (a replica's swap failed and rolled
+                  back while the rollout advanced elsewhere); the
+                  rollout is held — no further swap is issued — until
+                  the laggard is resolved (tpusvm.router.rollout)
+    """
+
+    OK = 0
+    NO_REPLICA = 1
+    ALL_DOWN = 2
+    SKEW_HOLD = 3
+
+
 class TuneStatus(enum.IntEnum):
     """Per-grid-point outcome codes for hyperparameter search (tpusvm.tune).
 
