@@ -1,0 +1,280 @@
+#!/usr/bin/env python
+"""Coalesced fleet refresh vs N solo daemons: the tenant-platform economics.
+
+The tenants tier's acceptance harness: B drifted tenants sharing one
+append-grown corpus are refreshed three ways on identical data —
+
+  solo_warm       B separate ``refresh_fit`` runs, each inside its own
+                  profiling scope WITH the jit caches cleared between
+                  tenants — per-PROCESS accounting, because this arm
+                  models the PR 15 deployment it replaces: one autopilot
+                  daemon per tenant, so nothing is shared, not even a
+                  compile cache
+  coalesced_warm  ONE ``refresh_drifted`` call: the whole tenant set in
+                  one power-of-two fleet launch, X loaded and scaled
+                  once, every tenant's deployed_seed riding the alpha0
+                  lane (tpusvm.tenants.coalesce)
+  coalesced_cold  the same launch with warm=False — the control the warm
+                  path's update savings are measured against
+
+with HARD parity gates (each coalesced tenant keeps its solo control's
+exact SV-ID set, status and held-out accuracy) and the two economics
+gates the tenants tier exists for:
+
+  * compiles: the coalesced refresh must compile FEWER XLA executables
+    than the N-solo-daemon arm total (B lanes, one program);
+  * updates: coalesced_warm must spend strictly fewer total SMO updates
+    than coalesced_cold (the warm seeds do real work), and stay within
+    10% of solo_warm's total (coalescing must not degrade the
+    per-tenant warm quality it inherits).
+
+Wall-clock columns are direction-gated at full level only (--smoke rows
+carry them for provenance; benchdiff timing rules skip at smoke level,
+where the CI runner is not the baseline machine).
+
+Usage: python benchmarks/tenant_refresh.py [--smoke] [--tenants 16]
+           [--n 768] [--grow 256] [--d 8] [--jsonl PATH]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from benchmarks.common import emit, log, pin_platform  # noqa: E402
+
+pin_platform()
+
+import jax  # noqa: E402
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--smoke", action="store_true",
+                    help="tiny shape (schema/CI run): parity + compile "
+                    "+ update gates only, no timing claims")
+    ap.add_argument("--tenants", type=int, default=16)
+    ap.add_argument("--n", type=int, default=768,
+                    help="corpus rows at donor provisioning")
+    ap.add_argument("--grow", type=int, default=256,
+                    help="appended rows the refresh absorbs")
+    ap.add_argument("--n-test", type=int, default=128)
+    ap.add_argument("--d", type=int, default=8)
+    ap.add_argument("--classes", type=int, default=8)
+    ap.add_argument("--seed", type=int, default=20260806)
+    ap.add_argument("--jsonl", default=None,
+                    help="also append the records to this file")
+    args = ap.parse_args(argv)
+    if args.smoke:
+        args.tenants, args.n, args.grow = 8, 320, 128
+        args.n_test, args.d = 64, 6
+
+    import tempfile
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tpusvm.models import BinarySVC
+    from tpusvm.obs import prof
+    from tpusvm.obs.registry import MetricsRegistry
+    from tpusvm.serve.refresh import refresh_fit
+    from tpusvm.tenants import (
+        TenantRecord,
+        provision_tenants,
+        refresh_drifted,
+        tenant_labels,
+    )
+
+    B = args.tenants
+    K = args.classes
+    rng = np.random.default_rng(args.seed)
+    n_all = args.n + args.grow + args.n_test
+    labels = rng.integers(0, K, size=n_all).astype(np.int32)
+    means = rng.normal(0.0, 2.0, size=(K, args.d))
+    X = means[labels] + rng.normal(0.0, 1.0, size=(n_all, args.d))
+    X[args.n:] += 0.5  # the appended batch is distribution-shifted
+    n_train = args.n + args.grow
+    Xtr, ytr = X[:n_train], labels[:n_train]
+    Xte, yte = X[n_train:], labels[n_train:]
+    C_PAL, G_PAL = (1.0, 3.0, 10.0), (0.5, 1.5, 5.0)
+
+    def mk_records():
+        return [TenantRecord(
+            tenant_id=f"t{i:02d}", positive_label=i % K,
+            C=C_PAL[i % 3], gamma=G_PAL[(i // 3) % 3])
+            for i in range(B)]
+
+    def accuracy(path, rec):
+        m = BinarySVC.load(path, dtype=jnp.float32)
+        Yt, _ = tenant_labels(yte, rec)
+        pred = np.where(
+            np.asarray(m.decision_function(Xte)) >= 0, 1, -1)
+        return float((pred == Yt).mean())
+
+    records, violations = [], []
+    with tempfile.TemporaryDirectory() as td:
+        donors = os.path.join(td, "donors")
+        os.makedirs(donors)
+        recs = mk_records()
+        log(f"provisioning {B} donors (one cold fleet launch, "
+            f"n={args.n})...")
+        provision_tenants(X[:args.n], labels[:args.n], recs,
+                          artifacts_dir=donors)
+
+        arms = {}
+
+        # ---- solo_warm: B daemons, per-process accounting
+        sdir = os.path.join(td, "solo")
+        os.makedirs(sdir)
+        log(f"solo_warm: {B} separate refresh_fit daemons...")
+        jax.clear_caches()
+        compiles = updates = 0
+        t0 = time.perf_counter()
+        for rec in recs:
+            jax.clear_caches()  # each daemon is its own process
+            with prof.profiling(registry=MetricsRegistry()) as obs:
+                m = refresh_fit(
+                    rec.model_path, Xtr, np.asarray(
+                        tenant_labels(ytr, rec)[0]),
+                    out_path=os.path.join(sdir, rec.tenant_id + ".npz"))
+            compiles += len(obs.records)
+            updates += int(m.n_iter_)
+        arms["solo_warm"] = dict(
+            refresh_s=time.perf_counter() - t0,
+            compiles=compiles, updates=updates, outdir=sdir)
+
+        # ---- coalesced arms: one refresh_drifted launch each
+        for arm, warm in (("coalesced_warm", True),
+                          ("coalesced_cold", False)):
+            adir = os.path.join(td, arm)
+            os.makedirs(adir)
+            log(f"{arm}: one fleet launch over {B} tenants...")
+            jax.clear_caches()
+            arecs = mk_records()
+            for r, src in zip(arecs, recs):
+                r.model_path = src.model_path
+            t0 = time.perf_counter()
+            with prof.profiling(registry=MetricsRegistry()) as obs:
+                outcomes = refresh_drifted(
+                    Xtr, ytr, arecs, artifacts_dir=adir, warm=warm)
+            arms[arm] = dict(
+                refresh_s=time.perf_counter() - t0,
+                compiles=len(obs.records),
+                updates=sum(int(o["n_iter"])
+                            for o in outcomes.values()),
+                outdir=adir)
+            bad = [t for t, o in outcomes.items() if "error" in o]
+            if bad:
+                violations.append(f"{arm}: failed tenants {bad}")
+
+        # ---- parity: each coalesced tenant vs its solo control
+        solo_art = {r.tenant_id: BinarySVC.load(
+            os.path.join(sdir, r.tenant_id + ".npz")) for r in recs}
+        solo_acc = {r.tenant_id: accuracy(
+            os.path.join(sdir, r.tenant_id + ".npz"), r) for r in recs}
+        for arm in arms:
+            a = arms[arm]
+            sv_parity = status_parity = accuracy_parity = True
+            statuses_converged = True
+            for rec in recs:
+                path = os.path.join(a["outdir"], rec.tenant_id + ".npz")
+                m = BinarySVC.load(path)
+                ctl = solo_art[rec.tenant_id]
+                if m.status_.name != "CONVERGED":
+                    statuses_converged = False
+                if arm == "solo_warm":
+                    continue
+                if not np.array_equal(m.sv_ids_, ctl.sv_ids_):
+                    sv_parity = False
+                if m.status_ != ctl.status_:
+                    status_parity = False
+                if accuracy(path, rec) != solo_acc[rec.tenant_id]:
+                    accuracy_parity = False
+            a.update(sv_parity=sv_parity, status_parity=status_parity,
+                     accuracy_parity=accuracy_parity,
+                     statuses_converged=statuses_converged)
+            if not statuses_converged:
+                violations.append(f"{arm}: a tenant did not converge")
+            if arm != "solo_warm" and not (
+                    sv_parity and status_parity and accuracy_parity):
+                violations.append(
+                    f"{arm}: parity vs the solo controls broken "
+                    f"(sv {sv_parity}, status {status_parity}, "
+                    f"accuracy {accuracy_parity})")
+
+        # ---- the economics gates
+        if arms["coalesced_warm"]["compiles"] >= \
+                arms["solo_warm"]["compiles"]:
+            violations.append(
+                "coalesced refresh compiled "
+                f"{arms['coalesced_warm']['compiles']} executables, "
+                f"not fewer than the {B}-daemon arm's "
+                f"{arms['solo_warm']['compiles']}")
+        if arms["coalesced_warm"]["updates"] >= \
+                arms["coalesced_cold"]["updates"]:
+            violations.append(
+                "warm coalesced refresh spent "
+                f"{arms['coalesced_warm']['updates']} updates, not "
+                "strictly fewer than the cold control's "
+                f"{arms['coalesced_cold']['updates']}")
+        if arms["coalesced_warm"]["updates"] > \
+                1.10 * max(1, arms["solo_warm"]["updates"]):
+            violations.append(
+                "warm coalesced refresh spent "
+                f"{arms['coalesced_warm']['updates']} updates, beyond "
+                "1.10x the solo-daemon arm's "
+                f"{arms['solo_warm']['updates']}")
+
+        for arm, a in arms.items():
+            records.append({
+                "bench": "tenant_refresh", "arm": arm,
+                "B": B, "bucket": 1 << (B - 1).bit_length(),
+                "n": n_train, "d": args.d,
+                "grow": args.grow, "seed": args.seed,
+                "warm": arm != "coalesced_cold",
+                "compiles": a["compiles"],
+                "updates": a["updates"],
+                "sv_parity": a["sv_parity"],
+                "status_parity": a["status_parity"],
+                "accuracy_parity": a["accuracy_parity"],
+                "statuses_converged": a["statuses_converged"],
+                "refresh_s": round(a["refresh_s"], 6),
+                "tenants_per_s": round(B / a["refresh_s"], 4),
+                "smoke": bool(args.smoke),
+            })
+        records.append({
+            "bench": "tenant_refresh", "summary": True,
+            "B": B, "n": n_train, "d": args.d,
+            "compile_saving": arms["solo_warm"]["compiles"]
+            - arms["coalesced_warm"]["compiles"],
+            "warm_update_saving": arms["coalesced_cold"]["updates"]
+            - arms["coalesced_warm"]["updates"],
+            "smoke": bool(args.smoke),
+            "violations": violations,
+        })
+
+    for rec in records:
+        emit(rec)
+    if args.jsonl:
+        with open(args.jsonl, "a") as f:
+            for rec in records:
+                f.write(json.dumps(rec) + "\n")
+    if violations:
+        for v in violations:
+            log(f"GATE FAILED: {v}")
+        return 1
+    log(f"tenant_refresh: coalesced refresh of {B} tenants compiled "
+        f"{arms['coalesced_warm']['compiles']} executables vs the "
+        f"{B}-daemon arm's {arms['solo_warm']['compiles']}, warm "
+        f"updates {arms['coalesced_warm']['updates']} vs cold "
+        f"{arms['coalesced_cold']['updates']}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -310,7 +310,7 @@ def test_derived_points_are_claimed_by_scenarios():
     assert set(derived) == {
         "ingest.write_shard", "stream.journal", "stream.append",
         "solver.outer_checkpoint", "models.save", "serve.state_write",
-        "autopilot.state", "cascade.checkpoint",
+        "autopilot.state", "cascade.checkpoint", "tenants.store",
     }, "write-guarding point universe drifted — update the scenarios"
     claimed = set()
     for sc in SCENARIOS.values():

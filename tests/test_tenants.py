@@ -1,0 +1,535 @@
+"""Multi-tenant platform tier (tpusvm/tenants/): per-tenant views over
+one shared corpus, the crash-safe tenant store, coalesced fleet
+refreshes with solo-parity gates, the supervisor's stage machine, and
+the platform-scale serving satellites (2k-entry registry, scandir
+watcher)."""
+
+import dataclasses
+import json
+import os
+import time
+import zlib
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpusvm import faults
+from tpusvm.autopilot import DriftThresholds
+from tpusvm.models import BinarySVC
+from tpusvm.serve.refresh import refresh_fit
+from tpusvm.status import TenantsStatus
+from tpusvm.stream import ShardWriter, ingest_arrays
+from tpusvm.tenants import (
+    TenantRecord,
+    TenantsConfig,
+    TenantsState,
+    TenantsSupervisor,
+    is_tenant_store,
+    load_fleet_checkpoint,
+    load_store,
+    provision_tenants,
+    refresh_drifted,
+    save_fleet_checkpoint,
+    save_store,
+    tenant_labels,
+    view_fingerprint,
+)
+
+# one shared multiclass corpus: K labelled blobs, f64 host rows (the
+# serve tier's bitwise served-vs-offline contract is stated for f64
+# queries — tests below compare artifacts, but the data idiom matches)
+K, D = 4, 4
+N0, GROW = 160, 96
+_rng = np.random.default_rng(1807)
+LABELS = _rng.integers(0, K, size=N0 + GROW).astype(np.int32)
+_MEANS = _rng.normal(0.0, 2.5, size=(K, D))
+XALL = _MEANS[LABELS] + _rng.normal(0.0, 1.0, size=(N0 + GROW, D))
+XALL[N0:] += 0.6  # appended rows are shifted: refreshed != donor
+
+C_PAL, G_PAL = (1.0, 3.0, 10.0), (0.5, 1.5, 5.0)
+SOLVER_OPTS = {"q": 16, "max_inner": 8}
+
+
+def _mk_records(n=5):
+    """4 full-view tenants + 1 row-subset tenant — one coalescing
+    bucket (the subset view is a per-problem valid mask, not a
+    static-key split)."""
+    recs = []
+    for i in range(n):
+        recs.append(TenantRecord(
+            tenant_id=f"t{i}", positive_label=i % K,
+            C=C_PAL[i % 3], gamma=G_PAL[i % 3],
+            row_mod=2 if i == 4 else None,
+            row_ofs=1 if i == 4 else 0))
+    return recs
+
+
+def _mk_odd():
+    """The different-static-template tenant (provisioned with its own
+    SVMConfig) that can never join the shared bucket."""
+    return TenantRecord(tenant_id="t5", positive_label=1, C=3.0,
+                        gamma=1.5)
+
+
+# ---------------------------------------------------------------- views
+
+def test_tenant_labels_column_view():
+    rec = TenantRecord(tenant_id="a", positive_label=2, C=1.0, gamma=1.0)
+    Y, valid = tenant_labels(LABELS, rec)
+    assert valid is None
+    np.testing.assert_array_equal(
+        Y, np.where(LABELS == 2, 1, -1).astype(np.int32))
+
+
+def test_tenant_labels_row_subset_mask():
+    rec = TenantRecord(tenant_id="a", positive_label=1, C=1.0, gamma=1.0,
+                       row_mod=3, row_ofs=2)
+    Y, valid = tenant_labels(LABELS, rec)
+    np.testing.assert_array_equal(
+        valid, (np.arange(LABELS.shape[0]) % 3) == 2)
+    # live rows keep the +/-1 column view; masked rows are never y=0
+    assert set(np.unique(Y)) == {-1, 1}
+
+
+def test_tenant_labels_degenerate_view_raises():
+    labels = np.zeros(16, np.int32)  # all one class
+    rec = TenantRecord(tenant_id="a", positive_label=0, C=1.0, gamma=1.0)
+    with pytest.raises(ValueError, match="degenerate"):
+        tenant_labels(labels, rec)
+    # a subset view can be degenerate even when the full view is not
+    labels = np.array([0, 1] * 8, np.int32)
+    rec = TenantRecord(tenant_id="b", positive_label=0, C=1.0, gamma=1.0,
+                       row_mod=2, row_ofs=0)
+    with pytest.raises(ValueError, match="degenerate"):
+        tenant_labels(labels, rec)
+
+
+def test_view_fingerprint_tracks_view_not_rows():
+    rec = TenantRecord(tenant_id="a", positive_label=1, C=1.0, gamma=1.0,
+                       row_mod=2, row_ofs=0)
+    fp1 = view_fingerprint(*tenant_labels(LABELS, rec))
+    fp2 = view_fingerprint(*tenant_labels(LABELS, rec))
+    assert fp1 == fp2
+    grown = np.concatenate([LABELS, [1]]).astype(np.int32)
+    assert view_fingerprint(*tenant_labels(grown, rec)) != fp1
+
+
+def test_record_validation_rejects_bad_hyperparams():
+    with pytest.raises(ValueError, match="C must be"):
+        TenantRecord(tenant_id="a", positive_label=0, C=0.0,
+                     gamma=1.0).validate()
+    with pytest.raises(ValueError, match="gamma must be"):
+        TenantRecord(tenant_id="a", positive_label=0, C=1.0,
+                     gamma=float("nan")).validate()
+    with pytest.raises(ValueError, match="row_ofs"):
+        TenantRecord(tenant_id="a", positive_label=0, C=1.0, gamma=1.0,
+                     row_mod=2, row_ofs=2).validate()
+
+
+# ---------------------------------------------------------------- store
+
+def _state():
+    st = TenantsState(seed=7, tick=3, stage="fitting",
+                      inflight={"tenant_ids": ["a"], "stage_rows": 12},
+                      generation=2, refreshes=5)
+    st.tenants["a"] = TenantRecord(
+        tenant_id="a", positive_label=0, C=1.0, gamma=2.0,
+        model_path="/x/a.npz", generation=2, rows_at_refresh=12)
+    st.tenants["b"] = TenantRecord(
+        tenant_id="b", positive_label=1, C=3.0, gamma=0.5,
+        row_mod=2, row_ofs=1)
+    return st
+
+
+def test_store_roundtrip(tmp_path):
+    p = str(tmp_path / "store.json")
+    st = _state()
+    save_store(p, st)
+    assert is_tenant_store(p)
+    assert load_store(p).to_json() == st.to_json()
+
+
+def test_store_rejects_corruption(tmp_path):
+    p = str(tmp_path / "store.json")
+    save_store(p, _state())
+    raw = bytearray(open(p, "rb").read())
+    raw[len(raw) // 2] ^= 0x20
+    open(p, "wb").write(bytes(raw))
+    with pytest.raises(ValueError):
+        load_store(p)
+
+
+def test_store_rejects_future_version_and_unknown_fields(tmp_path):
+    from tpusvm.tenants.store import _canonical
+
+    p = str(tmp_path / "store.json")
+    save_store(p, _state())
+    doc = json.load(open(p))
+    doc["store_version"] = 99
+    json.dump(doc, open(p, "w"))
+    with pytest.raises(ValueError, match="version"):
+        load_store(p)
+    # an unknown field must be refused even when the CRC is VALID (a
+    # newer tpusvm wrote it) — re-sign the tampered payload like a
+    # newer writer would
+    save_store(p, _state())
+    doc = json.load(open(p))
+    doc.pop("crc32")
+    doc["from_the_future"] = 1
+    doc["crc32"] = zlib.crc32(_canonical(doc)) & 0xFFFFFFFF
+    json.dump(doc, open(p, "w"))
+    with pytest.raises(ValueError, match="unknown fields"):
+        load_store(p)
+
+
+def test_fleet_checkpoint_fingerprint_refusal(tmp_path):
+    from tpusvm.solver.blocked import _OuterState
+
+    p = str(tmp_path / "fleet.ck.npz")
+    rng = np.random.default_rng(0)
+    st = _OuterState(*(np.asarray(rng.normal(size=(2, 8)), np.float32)
+                       for _ in _OuterState._fields))
+    save_fleet_checkpoint(p, st, {"launch": "aaa", "rows": 64})
+    back = load_fleet_checkpoint(p, {"launch": "aaa", "rows": 64})
+    for a, b in zip(st, back):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    with pytest.raises(ValueError, match="rows"):
+        load_fleet_checkpoint(p, {"launch": "aaa", "rows": 128})
+
+
+# ------------------------------------------- coalesced refresh parity
+
+@pytest.fixture(scope="module")
+def platform(tmp_path_factory):
+    """Donors provisioned on the N0-row prefix, then the three refresh
+    arms over the grown corpus: per-tenant solo controls, one warm
+    coalesced launch, one cold coalesced launch, and a warm launch with
+    the records in reversed order (lane-placement invariance)."""
+    from tpusvm.config import SVMConfig
+
+    td = tmp_path_factory.mktemp("tenants_platform")
+    donors = str(td / "donors")
+    os.makedirs(donors)
+    recs = _mk_records()
+    provision_tenants(XALL[:N0], LABELS[:N0], recs, artifacts_dir=donors,
+                      solver_opts=SOLVER_OPTS)
+    # one tenant whose donor carries a DIFFERENT static template: its
+    # launch key can never join the shared bucket, so refresh_drifted
+    # must route it through the solo refresh_fit fallback
+    odd = _mk_odd()
+    provision_tenants(XALL[:N0], LABELS[:N0], [odd],
+                      artifacts_dir=donors,
+                      config=SVMConfig(eps=1e-11),
+                      solver_opts=SOLVER_OPTS)
+    recs = recs + [odd]
+
+    solo = str(td / "solo")
+    os.makedirs(solo)
+    solo_models = {}
+    for rec in recs:
+        # the platform convention: a row-subset tenant solves over the
+        # FULL corpus with a valid mask (coalesce.py's solo fallback
+        # does the same), so SV ids live in shared-corpus row space
+        Y, valid = tenant_labels(LABELS, rec)
+        opts = dict(SOLVER_OPTS)
+        if valid is not None:
+            opts["valid"] = valid
+        solo_models[rec.tenant_id] = refresh_fit(
+            rec.model_path, XALL, Y,
+            out_path=os.path.join(solo, rec.tenant_id + ".npz"),
+            solver_opts=opts)
+
+    arms = {}
+    for arm, warm, order in (("warm", True, 1), ("cold", False, 1),
+                             ("warm_rev", True, -1)):
+        adir = str(td / arm)
+        os.makedirs(adir)
+        arecs = (_mk_records() + [_mk_odd()])[::order]
+        for r in arecs:
+            r.model_path = os.path.join(donors, r.tenant_id + ".npz")
+        arms[arm] = (refresh_drifted(
+            XALL, LABELS, arecs, artifacts_dir=adir, warm=warm,
+            solver_opts=SOLVER_OPTS), adir)
+    return recs, solo, solo_models, arms
+
+
+def test_coalesced_matches_solo_exactly(platform):
+    """The tier's load-bearing parity: each coalesced tenant keeps its
+    solo control's exact SV-ID set, status and held-out accuracy; b and
+    alpha land within the cross-engine band (batched vs single-head XLA
+    programs round differently — same physics as tests/test_fleet.py
+    and the ovr band in tests/test_models.py; bitwise is a same-program
+    property, exercised by the lane-invariance test below)."""
+    recs, solo, solo_models, arms = platform
+    outcomes, adir = arms["warm"]
+    modes = {r.tenant_id: outcomes[r.tenant_id]["mode"] for r in recs}
+    # the row-subset view is a per-problem axis (valid mask), NOT a
+    # static-key split — t4 coalesces with the full-view bucket; only
+    # the different-template tenant t5 falls back solo
+    assert [modes[f"t{i}"] for i in range(6)] == \
+        ["fleet", "fleet", "fleet", "fleet", "fleet", "solo"]
+    for rec in recs:
+        m = BinarySVC.load(os.path.join(adir, rec.tenant_id + ".npz"))
+        ctl = solo_models[rec.tenant_id]
+        assert m.status_ == ctl.status_, rec.tenant_id
+        np.testing.assert_array_equal(m.sv_ids_, ctl.sv_ids_)
+        np.testing.assert_allclose(m.b_, ctl.b_, atol=1e-4)
+        np.testing.assert_allclose(m.sv_alpha_, ctl.sv_alpha_,
+                                   atol=1e-3)
+        Y, _ = tenant_labels(LABELS, rec)
+        pred_m = np.asarray(m.decision_function(XALL)) >= 0
+        pred_c = np.asarray(ctl.decision_function(XALL)) >= 0
+        assert (pred_m == (Y == 1)).mean() == \
+            (pred_c == (Y == 1)).mean(), rec.tenant_id
+
+
+def test_record_order_is_bitwise_invariant(platform):
+    """Reversing the record order handed to refresh_drifted must not
+    change a single artifact byte: coalesce_drifted sorts tenant ids
+    inside each launch group, so lane assignment — and therefore every
+    lane-sliced solve — is deterministic in the SET of drifted tenants,
+    not the order the caller enumerated them in."""
+    recs, _, _, arms = platform
+    _, fwd = arms["warm"]
+    _, rev = arms["warm_rev"]
+    for rec in recs:
+        a = np.load(os.path.join(fwd, rec.tenant_id + ".npz"))
+        b = np.load(os.path.join(rev, rec.tenant_id + ".npz"))
+        assert set(a.files) == set(b.files)
+        for k in a.files:
+            assert a[k].tobytes() == b[k].tobytes(), \
+                f"{rec.tenant_id}:{k}"
+
+
+def test_warm_fleet_beats_cold(platform):
+    """The deployed_seed alpha0 lanes must do real work: the warm
+    coalesced launch spends strictly fewer total SMO updates than the
+    cold control."""
+    _, _, _, arms = platform
+    warm_updates = sum(int(o["n_iter"])
+                       for o in arms["warm"][0].values())
+    cold_updates = sum(int(o["n_iter"])
+                       for o in arms["cold"][0].values())
+    assert warm_updates < cold_updates
+
+
+def test_checkpointed_refresh_kill_resume_bit_identity(tmp_path,
+                                                       platform):
+    """SIGKILL mid-fleet-solve at a segment-checkpoint write, then
+    resume: the recovered launch must continue from the durable carry
+    to artifacts BIT-identical to an uninterrupted control — the
+    supervisor's crash-window contract at unit scale (the 64-tenant
+    version lives in `python -m tpusvm.faults tenant-chaos-smoke`)."""
+    recs, _, _, _ = platform
+    donors = {r.tenant_id: r.model_path for r in recs}
+
+    def run(outdir, plan):
+        arecs = _mk_records() + [_mk_odd()]
+        for r in arecs:
+            r.model_path = donors[r.tenant_id]
+        ckdir = str(tmp_path / (os.path.basename(outdir) + "_ck"))
+        os.makedirs(ckdir, exist_ok=True)
+        kwargs = dict(artifacts_dir=outdir, checkpoint_dir=ckdir,
+                      checkpoint_every=2, resume=True,
+                      solver_opts=SOLVER_OPTS)
+        if plan is None:
+            return refresh_drifted(XALL, LABELS, arecs, **kwargs)
+        with faults.active(plan):
+            with pytest.raises(faults.SimulatedKill):
+                refresh_drifted(XALL, LABELS, arecs, **kwargs)
+        faults.deactivate()
+        assert any(f.endswith(".ck.npz") for f in os.listdir(ckdir)), \
+            "no durable checkpoint at the kill point"
+        return refresh_drifted(XALL, LABELS, arecs, **kwargs)
+
+    ctl_dir = str(tmp_path / "ctl")
+    os.makedirs(ctl_dir)
+    run(ctl_dir, None)
+    kill_dir = str(tmp_path / "kill")
+    os.makedirs(kill_dir)
+    plan = faults.FaultPlan([faults.FaultRule(
+        point="tenants.store", kind="kill", at_hit=2)], seed=5)
+    run(kill_dir, plan)
+    for rec in recs:
+        a = np.load(os.path.join(ctl_dir, rec.tenant_id + ".npz"))
+        b = np.load(os.path.join(kill_dir, rec.tenant_id + ".npz"))
+        for k in a.files:
+            assert a[k].tobytes() == b[k].tobytes(), \
+                f"{rec.tenant_id}:{k}"
+
+
+# ------------------------------------------------------- supervisor
+
+def _mk_platform_dir(tmp_path, n_tenants=3):
+    data = str(tmp_path / "data")
+    ingest_arrays(data, XALL[:N0], LABELS[:N0], rows_per_shard=64)
+    donors = str(tmp_path / "donors")
+    os.makedirs(donors)
+    recs = _mk_records(n_tenants)
+    provision_tenants(XALL[:N0], LABELS[:N0], recs, artifacts_dir=donors,
+                      solver_opts=SOLVER_OPTS)
+    return data, recs
+
+
+def _cfg(tmp_path, data, **kw):
+    base = dict(
+        data_dir=data,
+        store_path=str(tmp_path / "store.json"),
+        artifacts_dir=str(tmp_path / "artifacts"),
+        thresholds=DriftThresholds(growth=0.25, feature=None,
+                                   score=None, jitter_frac=0.0),
+        hysteresis=1, cooldown_s=0.0, checkpoint_every=4, min_fleet=2,
+        seed=11, solver_opts=SOLVER_OPTS,
+    )
+    base.update(kw)
+    return TenantsConfig(**base)
+
+
+def _grow(data):
+    w = ShardWriter.open_append(data)
+    w.append(XALL[N0:], LABELS[N0:])
+    w.close()
+
+
+def test_supervisor_watch_refresh_cycle(tmp_path):
+    data, recs = _mk_platform_dir(tmp_path)
+    sup = TenantsSupervisor(_cfg(tmp_path, data), log_fn=None)
+    for rec in recs:
+        sup.register(rec)
+    out = sup.tick()
+    assert out["status"] == TenantsStatus.WATCHING
+    _grow(data)
+    out = sup.tick()
+    assert out["status"] == TenantsStatus.REFRESHED
+    assert sorted(out["drifted"]) == [r.tenant_id for r in recs]
+    for rec in recs:
+        st = sup.state.tenants[rec.tenant_id]
+        assert st.generation == 1
+        assert st.rows_at_refresh == N0 + GROW
+        assert os.path.exists(st.model_path)
+    # refreshed == watching again until more rows arrive
+    assert sup.tick()["status"] == TenantsStatus.WATCHING
+    # ...and the whole decision memory is durable: a resumed supervisor
+    # sees the identical registry + counters
+    sup2 = TenantsSupervisor(_cfg(tmp_path, data), resume=True,
+                             log_fn=None)
+    assert sup2.state.to_json() == sup.state.to_json()
+
+
+def test_supervisor_hysteresis_arms_before_firing(tmp_path):
+    data, recs = _mk_platform_dir(tmp_path, n_tenants=2)
+    sup = TenantsSupervisor(_cfg(tmp_path, data, hysteresis=2),
+                            log_fn=None)
+    for rec in recs:
+        sup.register(rec)
+    _grow(data)
+    assert sup.tick()["status"] == TenantsStatus.TRIGGERED_HYSTERESIS
+    assert sup.tick()["status"] == TenantsStatus.REFRESHED
+
+
+def test_supervisor_breaker_suppresses_after_failures(tmp_path,
+                                                      monkeypatch):
+    data, recs = _mk_platform_dir(tmp_path, n_tenants=2)
+    cfg = _cfg(tmp_path, data, breaker_threshold=1,
+               breaker_cooldown_s=3600.0)
+    sup = TenantsSupervisor(cfg, log_fn=None)
+    for rec in recs:
+        sup.register(rec)
+
+    # a refresh stage that dies (infra outage, not a per-tenant error)
+    # must come back as a COUNTED status — previous generations keep
+    # serving — and feed the breaker, which then suppresses the retry
+    def boom(*a, **kw):
+        raise RuntimeError("refresh infra down")
+
+    monkeypatch.setattr("tpusvm.tenants.loop.refresh_drifted", boom)
+    _grow(data)
+    assert sup.tick()["status"] == TenantsStatus.REFRESH_FAILED
+    assert sup.state.failures >= 1
+    assert sup.tick()["status"] == TenantsStatus.SUPPRESSED_BREAKER
+
+
+def test_supervisor_resume_refuses_seed_mismatch(tmp_path):
+    data, recs = _mk_platform_dir(tmp_path, n_tenants=2)
+    sup = TenantsSupervisor(_cfg(tmp_path, data, seed=11), log_fn=None)
+    for rec in recs:
+        sup.register(rec)
+    with pytest.raises(ValueError, match="seed"):
+        TenantsSupervisor(_cfg(tmp_path, data, seed=12), resume=True,
+                          log_fn=None)
+
+
+def test_register_rejects_duplicates(tmp_path):
+    data, recs = _mk_platform_dir(tmp_path, n_tenants=2)
+    sup = TenantsSupervisor(_cfg(tmp_path, data), log_fn=None)
+    sup.register(recs[0])
+    with pytest.raises(ValueError, match="already registered"):
+        sup.register(dataclasses.replace(recs[0]))
+
+
+# ------------------------------------------- platform-scale serving
+
+def _tiny_artifact(tmp_path):
+    from tpusvm.config import SVMConfig
+    from tpusvm.data import rings
+
+    Xr, Yr = rings(n=80, seed=3)
+    p = str(tmp_path / "tiny.npz")
+    BinarySVC(SVMConfig(C=10.0, gamma=10.0),
+              dtype=jnp.float32).fit(Xr, Yr).save(p)
+    return p
+
+
+def test_registry_ops_stay_flat_at_2k_entries(tmp_path):
+    """The tenant platform hangs thousands of entries off ONE registry;
+    swap and get_versioned are dict-op + lock, so per-op latency must
+    not scale with the registry size (a linear scan sneaking in would
+    turn every request into an O(tenants) stall)."""
+    from tpusvm.serve.registry import ModelEntry, ModelRegistry
+
+    entry = ModelEntry.from_path("m0", _tiny_artifact(tmp_path))
+
+    def bench(n_entries, ops=3000):
+        reg = ModelRegistry()
+        for i in range(n_entries):
+            reg.add(dataclasses.replace(entry, name=f"m{i}",
+                                        generation=1))
+        probe = f"m{n_entries - 1}"
+        t0 = time.perf_counter()
+        for _ in range(ops):
+            reg.get_versioned(probe)
+        t_get = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        for _ in range(ops):
+            reg.swap(dataclasses.replace(entry, name=probe))
+        t_swap = time.perf_counter() - t0
+        return t_get / ops, t_swap / ops
+
+    small_get, small_swap = bench(16)
+    big_get, big_swap = bench(2048)
+    # 128x the entries must not cost anywhere near 128x per op; the
+    # bound is deliberately loose (CI noise) — it catches O(n), not jitter
+    assert big_get < small_get * 25 + 1e-4, (small_get, big_get)
+    assert big_swap < small_swap * 25 + 1e-4, (small_swap, big_swap)
+
+
+def test_watcher_scan_is_one_sweep(tmp_path):
+    """_scan: name-filtered scandir index — .npz entries only, junk and
+    subdirectories skipped, missing directory = empty (not a crash),
+    deterministic sorted order."""
+    from tpusvm.serve.watch import ModelWatcher
+
+    d = str(tmp_path / "watch")
+    os.makedirs(d)
+    for name in ("b.npz", "a.npz", "notes.txt", "c.npz.tmp"):
+        open(os.path.join(d, name), "wb").write(b"x")
+    os.makedirs(os.path.join(d, "sub.npz"))  # a DIRECTORY named *.npz
+    w = ModelWatcher(server=None, watch_dir=d, log_fn=None)
+    got = w._scan()
+    assert [os.path.basename(p) for p, _ in got] == ["a.npz", "b.npz"]
+    assert all(isinstance(m, float) for _, m in got)
+    w_missing = ModelWatcher(server=None,
+                             watch_dir=str(tmp_path / "nope"),
+                             log_fn=None)
+    assert w_missing._scan() == []

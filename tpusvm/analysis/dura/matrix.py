@@ -267,6 +267,50 @@ def _autopilot_state_exec(workdir: str, seed: int, resume: bool) -> str:
     return _digest(got)
 
 
+def _tenant_store_exec(workdir: str, seed: int, resume: bool) -> str:
+    import numpy as np
+
+    from tpusvm.solver.blocked import _OuterState
+    from tpusvm.tenants.store import (
+        TenantRecord,
+        TenantsState,
+        load_fleet_checkpoint,
+        load_store,
+        save_fleet_checkpoint,
+        save_store,
+    )
+
+    path = os.path.join(workdir, "tenants_store.json")
+    ck = os.path.join(workdir, "fleet.ck.npz")
+    fp = {"launch": seed}
+    if resume:
+        # CRC + version gates must pass on any survivor of the kill —
+        # both durable artifacts share the tenants.store point
+        if os.path.exists(path):
+            load_store(path)
+        if os.path.exists(ck):
+            load_fleet_checkpoint(ck, fp)
+    rng = np.random.default_rng(7000 + seed)
+    for rev in (1, 2):
+        st = TenantsState(seed=seed, tick=rev, tenants={
+            "a": TenantRecord(tenant_id="a", positive_label=1,
+                              C=1.0, gamma=0.5, generation=rev),
+            "b": TenantRecord(tenant_id="b", positive_label=2,
+                              C=10.0, gamma=1.5, row_mod=2,
+                              row_ofs=rev % 2),
+        })
+        save_store(path, st)
+        carry = _OuterState(*(
+            np.asarray(rng.normal(size=(2, 8)), np.float32)
+            for _ in _OuterState._fields))
+        save_fleet_checkpoint(ck, carry, fp)
+    got = load_store(path).to_json()
+    back = load_fleet_checkpoint(ck, fp)
+    return _digest({"store": got,
+                    "carry": [_arr(getattr(back, f))
+                              for f in _OuterState._fields]})
+
+
 def _cascade_ckpt_exec(workdir: str, seed: int, resume: bool) -> str:
     import jax.numpy as jnp
     import numpy as np
@@ -344,6 +388,15 @@ SCENARIOS: Dict[str, Scenario] = {
             doc="autopilot supervisor state killed mid-commit; the CRC "
                 "fingerprint + version gate must pass on any survivor",
             execute=_autopilot_state_exec,
+        ),
+        Scenario(
+            name="tenant_store",
+            points=frozenset({"tenants.store"}),
+            doc="tenant registry + fleet segment checkpoint killed "
+                "mid-commit; the CRC/fingerprint + version gates must "
+                "pass on any survivor and the recovered pair matches "
+                "the control digests",
+            execute=_tenant_store_exec,
         ),
         Scenario(
             name="cascade_ckpt",

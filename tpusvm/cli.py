@@ -653,6 +653,108 @@ def _build_parser() -> argparse.ArgumentParser:
                     metavar="N")
     ap.add_argument("-q", "--quiet", action="store_true")
 
+    tn = sub.add_parser(
+        "tenants", parents=[common],
+        help="multi-tenant platform tier (tpusvm.tenants): thousands of "
+        "per-tenant closed loops over ONE shared append-grown corpus — "
+        "per-tenant drift detection, drifted tenants coalesced into "
+        "power-of-two fleet refresh launches with warm seeds, staggered "
+        "hot-swap roll-out")
+    tn.add_argument("--data", metavar="DIR",
+                    help="the shared sharded dataset every tenant views "
+                    "(grown by stream appends; required unless --smoke)")
+    tn.add_argument("--store", metavar="JSON", default=None,
+                    help="crash-safe tenant registry + supervisor state "
+                    "(atomic, versioned, CRC-fingerprinted; default: "
+                    "DATA/tenants_store.json); --resume replays it")
+    tn.add_argument("--artifacts", metavar="DIR", default=None,
+                    help="refreshed per-tenant artifacts land here as "
+                    "<tenant_id>.npz (atomic replace; default: "
+                    "DATA/tenant_models) — point a serve --watch dir "
+                    "here for zero-coordination deploys")
+    tn.add_argument("--resume", action="store_true",
+                    help="resume a killed supervisor from --store: "
+                    "per-tenant decisions replay identically and an "
+                    "in-flight coalesced launch continues from its "
+                    "fleet checkpoint bit-identically")
+    tn.add_argument("--swap", metavar="URL", dest="swap_url",
+                    help="POST /admin/swap per tenant on this running "
+                    "serve frontend after each refresh (omit for "
+                    "artifact-drop mode)")
+    tn.add_argument("--interval-s", type=float, default=30.0,
+                    help="tick period (default 30)")
+    tn.add_argument("--max-ticks", type=int, default=None,
+                    help="stop after N ticks (default: run forever)")
+    tdet = tn.add_argument_group("drift detectors (per tenant; "
+                                 "None/off when unset)")
+    tdet.add_argument("--growth-threshold", type=float, default=0.25,
+                      help="refresh a tenant when appended rows exceed "
+                      "this fraction of its rows at last refresh "
+                      "(default 0.25; -1 disables)")
+    tdet.add_argument("--feature-threshold", type=float, default=0.10,
+                      help="refresh when appended shards' min/max "
+                      "escapes the tenant artifact's fitted range by "
+                      "this relative fraction (default 0.10; -1 "
+                      "disables)")
+    tdet.add_argument("--staleness-s", type=float, default=None,
+                      help="refresh a tenant after this many seconds "
+                      "regardless of drift (default: off)")
+    tdet.add_argument("--min-new-rows", type=int, default=1,
+                      help="suppress non-staleness refreshes until this "
+                      "many rows appended (default 1)")
+    tdet.add_argument("--jitter-frac", type=float, default=0.0,
+                      help="seeded +/- threshold jitter fraction; each "
+                      "tenant jitters with its own derived seed, so a "
+                      "nonzero value de-synchronises the fleet "
+                      "(default 0 = exact)")
+    tdet.add_argument("--seed", type=int, default=0,
+                      help="base decision seed (per-tenant seeds derive "
+                      "from it; decisions replay per seed)")
+    tgate = tn.add_argument_group("refresh gating + coalescing")
+    tgate.add_argument("--hysteresis", type=int, default=1,
+                       help="consecutive triggered ticks required per "
+                       "tenant (default 1)")
+    tgate.add_argument("--cooldown-s", type=float, default=0.0,
+                       help="per-tenant post-refresh quiet period "
+                       "(default 0)")
+    tgate.add_argument("--min-fleet", type=int, default=2,
+                       help="smallest drifted group coalesced into a "
+                       "fleet launch; smaller groups refresh solo "
+                       "(default 2)")
+    tgate.add_argument("--stagger-s", type=float, default=0.0,
+                       help="delay between per-tenant swaps of one "
+                       "generation roll-out (default 0)")
+    tgate.add_argument("--breaker-threshold", type=int, default=3,
+                       help="consecutive all-failed refresh rounds that "
+                       "trip the fleet refresh breaker (default 3)")
+    tgate.add_argument("--breaker-cooldown-s", type=float, default=60.0,
+                       help="open-breaker cooldown before a half-open "
+                       "refresh probe (default 60)")
+    tfit = tn.add_argument_group("refresh fit")
+    tfit.add_argument("--cold", action="store_true",
+                      help="cold refits (skip the deployed warm seeds)")
+    tfit.add_argument("--checkpoint-every", type=int, default=64,
+                      metavar="K",
+                      help="fleet-checkpoint segment length in outer "
+                      "rounds (default 64)")
+    tn.add_argument("--smoke", action="store_true",
+                    help="CI gate: provision a small tenant fleet over "
+                    "one ingested corpus, grow it, run the supervisor "
+                    "in-process against a live server under any active "
+                    "fault plan; asserts a coalesced refresh lands, "
+                    "every tenant's swap serves its refreshed bytes, "
+                    "and the store resumes consistently")
+    tn.add_argument("--smoke-tenants", type=int, default=8,
+                    help="smoke fleet size (default 8)")
+    tn.add_argument("--smoke-ticks", type=int, default=6,
+                    help="smoke tick budget (default 6)")
+    tn.add_argument("--trace", metavar="PATH",
+                    help="write drift + refresh lifecycle events + "
+                    "metric snapshots to a JSONL trace")
+    tn.add_argument("--trace-max-bytes", type=int, default=None,
+                    metavar="N")
+    tn.add_argument("-q", "--quiet", action="store_true")
+
     ro = sub.add_parser(
         "router", parents=[common],
         help="multi-replica routing tier (tpusvm.router): an HTTP front "
@@ -2560,6 +2662,213 @@ def _autopilot_smoke(args) -> int:
     return 0
 
 
+def _cmd_tenants(args) -> int:
+    """The multi-tenant coalescing supervisor (tpusvm.tenants)."""
+    from tpusvm.autopilot import DriftThresholds
+    from tpusvm.tenants import TenantsConfig, TenantsSupervisor
+
+    tracer = _make_tracer(args, "tenants")
+
+    def _finish(rc: int) -> int:
+        if tracer is not None:
+            from tpusvm.obs import default_registry
+
+            tracer.metrics_snapshot(default_registry().snapshot())
+        _close_tracer(tracer)
+        return rc
+
+    if args.smoke:
+        return _finish(_tenants_smoke(args))
+    if not args.data:
+        raise SystemExit("tenants: --data DIR is required (or --smoke)")
+    say = (lambda msg: None) if args.quiet else print
+
+    def thr(v):
+        return None if v is not None and v < 0 else v
+
+    cfg = TenantsConfig(
+        data_dir=args.data,
+        store_path=args.store,
+        artifacts_dir=args.artifacts,
+        interval_s=args.interval_s,
+        thresholds=DriftThresholds(
+            feature=thr(args.feature_threshold),
+            growth=thr(args.growth_threshold),
+            score=None,
+            staleness_s=args.staleness_s,
+            min_new_rows=args.min_new_rows,
+            jitter_frac=args.jitter_frac,
+        ),
+        hysteresis=args.hysteresis,
+        cooldown_s=args.cooldown_s,
+        warm=not args.cold,
+        checkpoint_every=args.checkpoint_every,
+        min_fleet=args.min_fleet,
+        stagger_s=args.stagger_s,
+        breaker_threshold=args.breaker_threshold,
+        breaker_cooldown_s=args.breaker_cooldown_s,
+        seed=args.seed,
+    )
+    try:
+        sup = TenantsSupervisor(cfg, swap_url=args.swap_url,
+                                resume=args.resume, log_fn=say)
+    except (OSError, ValueError) as e:
+        raise SystemExit(f"tenants: {e}")
+    if not sup.state.tenants and not args.resume:
+        raise SystemExit(
+            "tenants: the store has no registered tenants — register "
+            "them programmatically (TenantsSupervisor.register) or "
+            "--resume an existing store"
+        )
+    say(f"tenants: supervising {len(sup.state.tenants)} tenants over "
+        f"{args.data} every {cfg.interval_s:g}s (store "
+        f"{sup.cfg.store_path}, artifacts {sup.cfg.artifacts_dir})")
+    try:
+        out = sup.run(max_ticks=args.max_ticks)
+    except KeyboardInterrupt:
+        out = {"ticks": sup.state.tick,
+               "generation": sup.state.generation,
+               "refreshes": sup.state.refreshes,
+               "failures": sup.state.failures}
+    say(f"tenants: {out['ticks']} ticks, {out['refreshes']} per-tenant "
+        f"refreshes ({out['failures']} failures), generation "
+        f"{out['generation']}")
+    return _finish(0)
+
+
+def _tenants_smoke(args) -> int:
+    """CI gate: the whole multi-tenant loop in-process — ingest one
+    shared corpus, provision a tenant fleet, serve every tenant, grow
+    the corpus, supervise — tolerant of an active fault plan (the chaos
+    CI step runs it under tests/fixtures/chaos_plan.json, whose tenants
+    rules inject tick latency and a transient store-write failure the
+    retry/breaker machinery must absorb)."""
+    import tempfile
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tpusvm.autopilot import DriftThresholds
+    from tpusvm.config import SVMConfig
+    from tpusvm.models import BinarySVC
+    from tpusvm.serve import ServeConfig, Server
+    from tpusvm.status import TenantsStatus
+    from tpusvm.stream import ShardWriter, ingest_arrays
+    from tpusvm.tenants import (
+        TenantRecord,
+        TenantsConfig,
+        TenantsSupervisor,
+        load_store,
+        tenant_labels,
+    )
+
+    say = (lambda m: None) if args.quiet else print
+    failures = []
+    n_tenants = max(2, args.smoke_tenants)
+    K = 4
+    rng = np.random.default_rng(20260806)
+    n0, n1, d = 240, 120, 6
+    X = rng.normal(size=(n0 + n1, d))
+    labels = rng.integers(0, K, size=n0 + n1).astype(np.int32)
+    for k in range(K):
+        X[labels == k] += 0.8 * k
+    with tempfile.TemporaryDirectory() as td:
+        import os as _os
+
+        data = _os.path.join(td, "data")
+        ingest_arrays(data, X[:n0], labels[:n0], rows_per_shard=64)
+        recs = []
+        for i in range(n_tenants):
+            recs.append(TenantRecord(
+                tenant_id=f"tenant{i:03d}", positive_label=i % K,
+                C=1.0 + 0.5 * (i % 3), gamma=0.3 + 0.1 * (i % 2),
+                row_mod=(2 if i % 5 == 4 else None),
+            ))
+        with Server(ServeConfig(max_batch=8), dtype=jnp.float32) as srv:
+            for rec in recs:
+                Y, valid = tenant_labels(labels[:n0], rec)
+                opts = {} if valid is None else {"valid": valid}
+                path = _os.path.join(td, rec.tenant_id + ".donor.npz")
+                BinarySVC(SVMConfig(C=rec.C, gamma=rec.gamma),
+                          dtype=jnp.float32,
+                          solver_opts=opts).fit(X[:n0], Y).save(path)
+                rec.model_path = path
+                rec.rows_at_refresh = n0
+                srv.load_model(rec.tenant_id, path)
+            srv.warmup()
+            cfg = TenantsConfig(
+                data_dir=data,
+                thresholds=DriftThresholds(growth=0.25, feature=0.10,
+                                           score=None, jitter_frac=0.0),
+                hysteresis=1, checkpoint_every=8,
+                breaker_threshold=3, breaker_cooldown_s=0.1,
+                seed=20260806,
+            )
+            sup = TenantsSupervisor(cfg, server=srv, log_fn=say)
+            for rec in recs:
+                sup.register(rec)
+            first = sup.tick()
+            if first["status"] != TenantsStatus.WATCHING:
+                failures.append(
+                    f"tick on unchanged data: {first['status'].name}")
+            w = ShardWriter.open_append(data)
+            w.append(X[n0:], labels[n0:])
+            w.close()
+            statuses = []
+            for _ in range(args.smoke_ticks):
+                statuses.append(sup.tick()["status"])
+                if statuses[-1] in (TenantsStatus.REFRESHED,
+                                    TenantsStatus.PARTIAL):
+                    break
+            if TenantsStatus.REFRESHED not in statuses:
+                failures.append(
+                    f"no coalesced refresh landed in {args.smoke_ticks} "
+                    f"ticks: {[s.name for s in statuses]}")
+            else:
+                for rec in recs:
+                    st_rec = sup.state.tenants[rec.tenant_id]
+                    if st_rec.generation != 1:
+                        failures.append(
+                            f"{rec.tenant_id}: generation "
+                            f"{st_rec.generation} != 1")
+                        continue
+                    scores, _ = srv.predict_direct(rec.tenant_id, X[:16])
+                    offline = BinarySVC.load(st_rec.model_path,
+                                             dtype=jnp.float32)
+                    want = np.asarray(offline.decision_function(X[:16]))
+                    if not np.array_equal(scores, want):
+                        failures.append(
+                            f"{rec.tenant_id}: served scores after the "
+                            "swap are not bit-identical to its "
+                            "refreshed artifact")
+                    if srv.registry.generation(rec.tenant_id) < 2:
+                        failures.append(
+                            f"{rec.tenant_id}: registry generation did "
+                            "not advance")
+            # the store must resume to the same fleet state
+            sup2 = TenantsSupervisor(cfg, server=srv, resume=True,
+                                     log_fn=lambda m: None)
+            if sup2.state.generation != sup.state.generation or \
+                    len(sup2.state.tenants) != len(sup.state.tenants):
+                failures.append("resumed store diverged: "
+                                f"gen {sup2.state.generation} vs "
+                                f"{sup.state.generation}")
+            persisted = load_store(sup.cfg.store_path)
+            if persisted.stage != "idle":
+                failures.append(
+                    f"store left stage {persisted.stage!r} after a "
+                    "completed round")
+    if failures:
+        for f in failures:
+            print(f"TENANTS SMOKE FAILED: {f}")
+        return 1
+    print(f"tenants smoke ok: {n_tenants} tenants refreshed in one "
+          f"coalesced generation ({sup.state.failures} absorbed "
+          "failures), every tenant served its refreshed bytes, store "
+          "resumes consistently")
+    return 0
+
+
 def _cmd_tune(args) -> int:
     import dataclasses
 
@@ -2771,6 +3080,10 @@ def _info_artifact(path: str) -> int:
     if is_tune_result(path):
         print(format_table(load_tune_result(path)))
         return 0
+    from tpusvm.tenants import is_tenant_store
+
+    if is_tenant_store(path):
+        return _info_tenant_store(path)
     from tpusvm.models.serialization import load_model, model_task
 
     try:
@@ -2844,6 +3157,35 @@ def _info_artifact(path: str) -> int:
                   f"B={float(state['platt_b']):.6f})")
         else:
             print("calibrated: no")
+    return 0
+
+
+def _info_tenant_store(path: str) -> int:
+    """Describe a multi-tenant registry/store file."""
+    from tpusvm.tenants import load_store
+
+    try:
+        st = load_store(path)
+    except ValueError as e:
+        raise SystemExit(f"info: {e}")
+    print(f"tenant store: {len(st.tenants)} tenants, generation "
+          f"{st.generation} (tick {st.tick})")
+    print(f"stage: {st.stage}"
+          + (f" — in-flight launch over "
+             f"{len(st.inflight.get('tenant_ids', []))} tenants at "
+             f"{st.inflight.get('stage_rows')} rows"
+             if st.inflight else ""))
+    print(f"refreshes landed: {st.refreshes} ({st.failures} failures)")
+    if st.tenants:
+        gens = [r.generation for r in st.tenants.values()]
+        subset = sum(1 for r in st.tenants.values()
+                     if r.row_mod is not None)
+        armed = sum(1 for r in st.tenants.values()
+                    if r.consecutive_triggered > 0)
+        print(f"tenant generations: min {min(gens)} max {max(gens)}")
+        print(f"views: {subset} row-subset, "
+              f"{len(st.tenants) - subset} full-corpus; {armed} "
+              "drift-armed")
     return 0
 
 
@@ -3050,7 +3392,7 @@ def main(argv=None) -> int:
     return {"train": _cmd_train, "ingest": _cmd_ingest,
             "predict": _cmd_predict, "serve": _cmd_serve,
             "refresh": _cmd_refresh, "autopilot": _cmd_autopilot,
-            "router": _cmd_router,
+            "tenants": _cmd_tenants, "router": _cmd_router,
             "tune": _cmd_tune, "info": _cmd_info,
             "report": _cmd_report,
             "benchdiff": _cmd_benchdiff}[args.command](args)

@@ -59,6 +59,25 @@ Commands:
                       degraded, and the post-recovery refreshed model
                       is BIT-IDENTICAL (alpha bytes / SV ids / b) to
                       the uninterrupted control run's.
+  tenant-chaos-smoke  The multi-tenant platform CI gate: 64 tenants
+                      (one shared corpus, per-tenant label/row-subset
+                      views) provisioned in ONE cold fleet launch and
+                      served; the coalescing supervisor is SIGKILLed
+                      mid-fleet-refresh at a segment-checkpoint write
+                      and rebuilt with resume=True, while client
+                      threads stream per-tenant requests. Asserts:
+                      a durable fleet checkpoint existed at the kill
+                      and the recovered refit is BIT-IDENTICAL (alpha
+                      bytes / SV ids / b / n_iter) per tenant to an
+                      uninterrupted control arm, every served response
+                      bitwise-matches one of that tenant's two
+                      complete generations, every tenant's dataset
+                      view fingerprint equals the control's (no rows
+                      lost), fleet checkpoints are reaped after the
+                      swapping-stage commit, and a corrupted swap
+                      artifact pins exactly one tenant on its previous
+                      generation (serving bitwise) before a solo
+                      recovery refresh lands.
 """
 
 from __future__ import annotations
@@ -838,6 +857,356 @@ def _router_chaos_smoke() -> int:
     return 0
 
 
+def _tenant_chaos_smoke() -> int:
+    import glob
+    import os
+    import threading
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tpusvm import faults
+    from tpusvm.autopilot import DriftThresholds
+    from tpusvm.models import BinarySVC
+    from tpusvm.serve import ServeConfig, Server
+    from tpusvm.status import TenantsStatus
+    from tpusvm.stream import ShardWriter, ingest_arrays, open_dataset
+    from tpusvm.tenants import (
+        TenantRecord,
+        TenantsConfig,
+        TenantsSupervisor,
+        provision_tenants,
+        tenant_labels,
+        view_fingerprint,
+    )
+
+    failures = []
+    N_T = 64
+    D, K = 6, 8
+    N0, N1, N2 = 320, 160, 160
+    rng = np.random.default_rng(20260806)
+    labels_all = rng.integers(0, K, size=N0 + N1 + N2).astype(np.int32)
+    means = rng.normal(0.0, 2.0, size=(K, D))
+    # f64 host rows: the serve tier validates/scales queries in f64
+    # (registry.validate_rows), so the bitwise served-vs-offline oracle
+    # contract is stated for f64 inputs — exactly what clients POST
+    X_all = means[labels_all] + rng.normal(0.0, 1.0,
+                                           size=(N0 + N1 + N2, D))
+    # the appended batches are distribution-shifted so every tenant's
+    # refreshed solution genuinely differs from its donor (the
+    # torn-generation oracle needs two DISTINGUISHABLE generations)
+    X_all[N0:] += 0.75
+    Xq = X_all[:8]
+    C_PAL, G_PAL = (1.0, 3.0, 10.0), (0.5, 1.5, 5.0)
+
+    def mk_records():
+        recs = []
+        for i in range(N_T):
+            recs.append(TenantRecord(
+                tenant_id=f"t{i:02d}", positive_label=i % K,
+                C=C_PAL[i % 3], gamma=G_PAL[(i // 3) % 3],
+                row_mod=2 if i % 8 == 7 else None,
+                row_ofs=(i // 8) % 2 if i % 8 == 7 else 0))
+        return recs
+
+    def setup(td):
+        """One complete platform: shared dataset, 64 provisioned donors
+        (ONE cold fleet launch), supervisor config. Identical for
+        control and chaos arms."""
+        data = os.path.join(td, "data")
+        donors = os.path.join(td, "donors")
+        arts = os.path.join(td, "artifacts")
+        os.makedirs(donors)
+        ingest_arrays(data, X_all[:N0], labels_all[:N0],
+                      rows_per_shard=64)
+        recs = mk_records()
+        provision_tenants(X_all[:N0], labels_all[:N0], recs,
+                          artifacts_dir=donors)
+        cfg = TenantsConfig(
+            data_dir=data,
+            store_path=os.path.join(td, "tenants_store.json"),
+            artifacts_dir=arts,
+            thresholds=DriftThresholds(growth=0.25, feature=None,
+                                       score=None, jitter_frac=0.0),
+            hysteresis=1, cooldown_s=0.0,
+            checkpoint_every=2, min_fleet=2,
+            breaker_threshold=5, breaker_cooldown_s=0.05,
+            seed=20260806,
+            solver_opts={"q": 32, "max_inner": 8},
+        )
+        return data, recs, cfg
+
+    def append(data, a, b):
+        w = ShardWriter.open_append(data)
+        w.append(X_all[a:b], labels_all[a:b])
+        w.close()
+
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as td:
+        # ---------------- control arm: uninterrupted platform
+        cdir = os.path.join(td, "control")
+        os.makedirs(cdir)
+        data_c, recs_c, cfg_c = setup(cdir)
+        sup = TenantsSupervisor(cfg_c, log_fn=lambda m: None)
+        for rec in recs_c:
+            sup.register(rec)
+        out = sup.tick()
+        if out["status"] != TenantsStatus.WATCHING:
+            print("TENANT CHAOS SMOKE FAILED: control arm drifted "
+                  "before any append")
+            return 1
+        append(data_c, N0, N0 + N1)
+        out = sup.tick()
+        if out["status"] != TenantsStatus.REFRESHED:
+            print(f"TENANT CHAOS SMOKE FAILED: control arm did not "
+                  f"refresh ({out['status'].name})")
+            return 1
+        append(data_c, N0 + N1, N0 + N1 + N2)
+        tids = sorted(st.tenant_id for st in recs_c)
+        # both complete generations of every tenant, as OFFLINE oracles
+        # (serving is bitwise-equal to offline f32 decision_function —
+        # the serve-tier contract): the chaos arm's torn-read reference
+        refOld, refNew, control_art = {}, {}, {}
+        for tid in tids:
+            refOld[tid] = np.asarray(BinarySVC.load(
+                os.path.join(cdir, "donors", tid + ".npz"),
+                dtype=jnp.float32).decision_function(Xq))
+            m = BinarySVC.load(os.path.join(cdir, "artifacts",
+                                            tid + ".npz"),
+                               dtype=jnp.float32)
+            refNew[tid] = np.asarray(m.decision_function(Xq))
+            control_art[tid] = m
+        distinct = sum(not np.array_equal(refOld[t], refNew[t])
+                       for t in tids)
+        if distinct < N_T // 2:
+            failures.append(
+                f"only {distinct}/{N_T} tenants changed across the "
+                "refresh — the torn-generation check is vacuous")
+        ds_c = open_dataset(data_c)
+        control_manifest = ds_c.manifest.to_json()
+        Xc, Yc = ds_c.load_arrays()
+
+        # ---------------- chaos arm: same platform, killed mid-fleet
+        hdir = os.path.join(td, "chaos")
+        os.makedirs(hdir)
+        data_h, recs_h, cfg_h = setup(hdir)
+        srv = Server(ServeConfig(max_batch=8), dtype=jnp.float32)
+        for rec in recs_h:
+            srv.load_model(rec.tenant_id, rec.model_path)
+        with srv:
+            sup_h = TenantsSupervisor(cfg_h, server=srv,
+                                      log_fn=lambda m: None)
+            for rec in recs_h:
+                sup_h.register(rec)
+            out = sup_h.tick()
+            if out["status"] != TenantsStatus.WATCHING:
+                failures.append("chaos arm drifted before any append")
+            for tid in tids:
+                s, _ = srv.predict_direct(tid, Xq)
+                if not np.array_equal(np.asarray(s), refOld[tid]):
+                    failures.append(
+                        f"chaos donor generation of {tid} does not "
+                        "serve the control's scores — arms are not "
+                        "comparable")
+                    break
+            append(data_h, N0, N0 + N1)
+
+            # the kill plan counts tenants.store hits WITHIN the
+            # refresh tick (activated only now, so registration writes
+            # don't shift the count): hit 1 is the stage="fitting"
+            # store commit, hits 2.. are the fleet segment checkpoints
+            # — at_hit=3 dies at the SECOND checkpoint write, i.e. with
+            # a durable first-segment checkpoint on disk
+            plan = faults.FaultPlan([
+                faults.FaultRule(point="tenants.store", kind="kill",
+                                 at_hit=3),
+                faults.FaultRule(point="tenants.tick", kind="latency",
+                                 p=0.5, delay_ms=1.0, max_hits=8),
+                faults.FaultRule(point="serve.score", kind="latency",
+                                 p=0.3, delay_ms=2.0, max_hits=16),
+            ], seed=20260806)
+            stop = threading.Event()
+            bad = []
+            bad_lock = threading.Lock()
+
+            def client(t):
+                i = t
+                while not stop.is_set():
+                    tid = tids[(7 * t + i) % N_T]
+                    r = srv.submit(tid, Xq[i % 8], timeout_s=10.0)
+                    if r.ok:
+                        s = np.asarray(r.scores)
+                        if s != refOld[tid][i % 8] \
+                                and s != refNew[tid][i % 8]:
+                            with bad_lock:
+                                bad.append(("torn", tid, i % 8,
+                                            float(s)))
+                    elif r.status.name not in ("TIMEOUT",):
+                        with bad_lock:
+                            bad.append(("status", r.status.name))
+                    i += 1
+
+            threads = [threading.Thread(target=client, args=(t,))
+                       for t in range(3)]
+            kills = 0
+            ck_at_kill = False
+            with faults.active(plan):
+                for t in threads:
+                    t.start()
+                statuses = []
+                for attempt in range(16):
+                    try:
+                        out = sup_h.tick()
+                    except faults.SimulatedKill:
+                        kills += 1
+                        # the evidence that recovery RESUMES rather
+                        # than restarts: a durable fleet checkpoint
+                        # exists at the moment of death
+                        if glob.glob(os.path.join(
+                                hdir, "artifacts", "fleet_*.ck.npz")):
+                            ck_at_kill = True
+                        sup_h = TenantsSupervisor(
+                            cfg_h, server=srv, resume=True,
+                            log_fn=lambda m: None)
+                        continue
+                    statuses.append(out["status"])
+                    if out["status"] == TenantsStatus.REFRESHED:
+                        break
+                else:
+                    failures.append(
+                        "no coalesced refresh landed within the tick "
+                        f"budget: {[s.name for s in statuses]}")
+                stop.set()
+                for t in threads:
+                    t.join(10.0)
+            faults.deactivate()
+
+            # ---------------- phase-1 gates
+            if kills == 0:
+                failures.append("the kill rule never fired — the chaos "
+                                "arm degenerated to the control arm")
+            if kills and not ck_at_kill:
+                failures.append(
+                    "killed mid-fleet-refresh with NO durable segment "
+                    "checkpoint on disk — recovery would re-fit from "
+                    "scratch")
+            if bad:
+                failures.append(f"client anomalies under chaos: "
+                                f"{bad[:5]} ({len(bad)} total)")
+            if glob.glob(os.path.join(hdir, "artifacts",
+                                      "fleet_*.ck.npz")):
+                failures.append("fleet checkpoints survived the "
+                                "swapping-stage commit")
+            for tid in tids:
+                rec = sup_h.state.tenants[tid]
+                if rec.generation != 1:
+                    failures.append(
+                        f"{tid} generation {rec.generation} != 1 after "
+                        "the recovered refresh")
+                    continue
+                s, _ = srv.predict_direct(tid, Xq)
+                s = np.asarray(s)
+                if not np.array_equal(s, refNew[tid]):
+                    still_old = np.array_equal(s, refOld[tid])
+                    failures.append(
+                        f"post-recovery served scores of {tid} do not "
+                        "bitwise-match the control generation "
+                        f"(max |delta| "
+                        f"{float(np.max(np.abs(s - refNew[tid])))!r}, "
+                        f"still the donor generation: {still_old})")
+                chaos = BinarySVC.load(os.path.join(
+                    hdir, "artifacts", tid + ".npz"))
+                ctrl = control_art[tid]
+                if chaos.sv_alpha_.tobytes() != ctrl.sv_alpha_.tobytes() \
+                        or not np.array_equal(chaos.sv_ids_,
+                                              ctrl.sv_ids_) \
+                        or chaos.b_ != ctrl.b_ \
+                        or chaos.n_iter_ != ctrl.n_iter_:
+                    failures.append(
+                        f"recovered refit of {tid} is NOT bit-identical "
+                        f"to the uninterrupted control "
+                        f"({len(chaos.sv_ids_)} vs {len(ctrl.sv_ids_)} "
+                        f"SVs, b {chaos.b_!r} vs {ctrl.b_!r}, n_iter "
+                        f"{chaos.n_iter_} vs {ctrl.n_iter_})")
+
+            # ---------------- phase 2: corrupt one swap's bytes
+            append(data_h, N0 + N1, N0 + N1 + N2)
+            plan2 = faults.FaultPlan([
+                faults.FaultRule(point="registry.load", kind="corrupt",
+                                 at_hit=1),
+            ], seed=20260806)
+            with faults.active(plan2):
+                out = sup_h.tick()
+            faults.deactivate()
+            if out["status"] != TenantsStatus.PARTIAL:
+                failures.append(
+                    "the corrupted swap did not surface as a PARTIAL "
+                    f"generation (got {out['status'].name})")
+            stuck = [tid for tid in tids
+                     if sup_h.state.tenants[tid].generation == 1]
+            if len(stuck) != 1:
+                failures.append(
+                    f"expected exactly one tenant pinned on its "
+                    f"previous generation, got {stuck}")
+            else:
+                s, _ = srv.predict_direct(stuck[0], Xq)
+                if not np.array_equal(np.asarray(s), refNew[stuck[0]]):
+                    failures.append(
+                        f"{stuck[0]}'s failed swap did not keep its "
+                        "previous generation serving bitwise")
+                out = sup_h.tick()
+                if out["status"] != TenantsStatus.REFRESHED \
+                        or out["drifted"] != stuck:
+                    failures.append(
+                        "the corrupted tenant did not stay armed and "
+                        f"recover solo (status {out['status'].name}, "
+                        f"drifted {out['drifted']})")
+                else:
+                    want = np.asarray(BinarySVC.load(
+                        os.path.join(hdir, "artifacts",
+                                     stuck[0] + ".npz"),
+                        dtype=jnp.float32).decision_function(Xq))
+                    s, _ = srv.predict_direct(stuck[0], Xq)
+                    if not np.array_equal(np.asarray(s), want):
+                        failures.append(
+                            f"{stuck[0]}'s recovery swap does not "
+                            "serve its refreshed artifact bitwise")
+
+        # ---------------- gate (a): no tenant lost rows
+        ds_h = open_dataset(data_h)
+        if ds_h.manifest.to_json() != control_manifest:
+            failures.append("chaos dataset manifest differs from the "
+                            "uninterrupted control (rows lost, "
+                            "duplicated, or mis-sharded)")
+        Xh, Yh = ds_h.load_arrays()
+        if not (np.array_equal(Xc, Xh) and np.array_equal(Yc, Yh)):
+            failures.append("chaos dataset rows differ from control")
+        else:
+            for rc, rh in zip(recs_c, recs_h):
+                if view_fingerprint(*tenant_labels(Yc, rc)) != \
+                        view_fingerprint(*tenant_labels(Yh, rh)):
+                    failures.append(
+                        f"tenant {rc.tenant_id} view fingerprint "
+                        "differs between arms")
+                    break
+
+    if failures:
+        for f in failures:
+            print(f"TENANT CHAOS SMOKE FAILED: {f}")
+        return 1
+    print(f"tenant chaos smoke ok: {N_T} tenants, supervisor killed "
+          f"mid-fleet-refresh ({kills} kills) resumed from a durable "
+          "segment checkpoint to artifacts bit-identical to the "
+          "uninterrupted control, 0 torn responses, every view "
+          "fingerprint equal, corrupted swap pinned one tenant on its "
+          "previous generation then recovered solo")
+    return 0
+
+
 def main(argv=None) -> int:
     argv = sys.argv[1:] if argv is None else argv
     if not argv or argv[0] in ("-h", "--help"):
@@ -852,6 +1221,8 @@ def main(argv=None) -> int:
         return _router_chaos_smoke()
     if cmd == "autopilot-chaos-smoke":
         return _autopilot_chaos_smoke()
+    if cmd == "tenant-chaos-smoke":
+        return _tenant_chaos_smoke()
     if cmd == "validate":
         if len(rest) != 1:
             print("usage: python -m tpusvm.faults validate PLAN.json")

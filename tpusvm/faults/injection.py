@@ -64,6 +64,14 @@ rejected at load time):
                               attempt — transient/latency rules here
                               exercise failover to the next placement
                               under client load (router/proxy.py)
+  ``tenants.tick``            the multi-tenant supervisor's per-tick
+                              entry (tenants/loop.py)
+  ``tenants.store``           the tenant registry's CRC-fingerprinted
+                              state commit AND the coalesced fleet
+                              refresh's segment-checkpoint write — kill
+                              rules here die mid-fleet-refresh with the
+                              previous durable state intact
+                              (tenants/store.py)
 
 Kill semantics: :class:`SimulatedKill` subclasses ``BaseException`` (like
 ``KeyboardInterrupt``), so no ``except Exception`` recovery path — not
@@ -105,6 +113,8 @@ POINTS = frozenset({
     "autopilot.state",
     "cascade.checkpoint",
     "router.forward",
+    "tenants.tick",
+    "tenants.store",
 })
 
 KINDS = ("transient", "latency", "corrupt", "kill")

@@ -199,6 +199,24 @@ SCHEMA_RULES: Dict[str, Tuple[Rule, ...]] = {
         Rule("cache_hit_rate", ">=", abs_tol=0.05),
         Rule("best_speedup", ">=", rel_tol=0.25, timing=True),
     ),
+    # multi-tenant round, the coalescing economics: rows pair on
+    # (bench, arm, B, bucket, n, d, smoke). Parity metrics are the
+    # harness's own verdicts that every coalesced tenant kept its solo
+    # control's SV sets / statuses / accuracy — exact. compiles is the
+    # launch-economics claim (a coalesced fleet refresh compiles ONCE
+    # where N solo daemons compile N times — per-process accounting)
+    # and may only fall; updates may only fall within the warm band;
+    # wall clock is direction-gated at full level only
+    "tenant_refresh": (
+        Rule("sv_parity", "=="),
+        Rule("status_parity", "=="),
+        Rule("accuracy_parity", "=="),
+        Rule("statuses_converged", "=="),
+        Rule("compiles", "<="),
+        Rule("updates", "<=", rel_tol=0.1),
+        Rule("refresh_s", "<=", rel_tol=0.4, timing=True),
+        Rule("tenants_per_s", ">=", rel_tol=0.25, timing=True),
+    ),
 }
 
 

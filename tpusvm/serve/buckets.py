@@ -8,9 +8,12 @@ the shape universe at len(buckets) ~ log2(max_batch)+1 shapes per model,
 all compiled AHEAD OF TIME by warmup(); steady state then never compiles.
 
 Padding is safe because per-row scores are independent of the surrounding
-batch (each padded row contributes only garbage rows that get sliced off —
-tests/test_predict.py proves bit-identity across every block/padding
-geometry). Executables are built with .lower().compile() rather than
+batch (each padded row contributes only garbage rows that get sliced off),
+and every bucket executable runs the SAME internal block geometry as the
+offline scorer — the contraction shape, not just the row set, is pinned,
+because XLA's CPU dot kernels drift ~1 ulp across shapes at degenerate
+sizes (see the block comments below). Executables are built with
+.lower().compile() rather than
 relying on jax's internal jit cache, so COMPILES ARE OBSERVABLE: the cache
 counts them, and compiles after warm-up surface as the `recompiles` metric
 (steady-state target: 0).
@@ -142,16 +145,28 @@ class CompileCache:
                 Xz, e.map_params, e.X_sv, e.coef, e.b,
                 family=cfg.kernel)
         if e.kind in ("binary", "svr"):
-            # block capped at the bucket: decision_function pads m up to a
-            # block multiple internally, so block=2048 would make a 1-row
-            # bucket compute 2048 rows of kernel (measured 7x throughput
-            # loss); any block yields bit-identical per-row scores
-            # (tests/test_predict.py), so the cap is free. The kernel
-            # family/params come from the model's config — one executable
-            # per (model, bucket) regardless of family
+            # block deliberately NOT capped at the bucket (this path used
+            # block=min(block, bucket) until the tenants tier's chaos
+            # harness falsified the "any block is bit-identical" claim it
+            # rested on): decision_function pads m up to a block multiple
+            # INSIDE the jit, so with block=2048 every bucket runs the
+            # identical (2048, n_sv) matvec the offline scorer runs —
+            # bit-identity by construction, for every n_sv. Capping
+            # instead runs a (bucket, n_sv) matvec whose CPU dot kernel
+            # drifts ~1 ulp against the 2048-row program at degenerate SV
+            # counts (measured at n_sv=49/m=8; n_sv=47,48 agree — the
+            # same shape-dependent contraction physics as _MIN_BUCKET and
+            # the fused-map branch above). The cap bought throughput on
+            # sparse traffic (a 1-row request now computes a full block
+            # of kernel rows), but a served score that differs from the
+            # offline artifact breaks the torn-generation oracle every
+            # rollout gate is built on — correctness wins, as it already
+            # did for the approximate families above. The kernel family/
+            # params come from the model's config — one executable per
+            # (model, bucket) regardless of family
             lowered = decision_function.lower(
                 Xz, e.X_sv, e.coef, e.b, gamma=cfg.gamma,
-                block=min(self.block, bucket), kernel=cfg.kernel,
+                block=self.block, kernel=cfg.kernel,
                 degree=cfg.degree, coef0=cfg.coef0)
         else:
             gamma = jnp.asarray(cfg.gamma, e.dtype)

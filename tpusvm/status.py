@@ -177,6 +177,44 @@ class RouterStatus(enum.IntEnum):
     SKEW_HOLD = 3
 
 
+class TenantsStatus(enum.IntEnum):
+    """Per-tick outcome codes for the multi-tenant coalescing supervisor
+    (tpusvm.tenants). One supervisor owns THOUSANDS of per-tenant closed
+    loops, so the tick outcome is fleet-level — "what did this tick do
+    with the currently-drifted tenant set":
+
+      WATCHING            no tenant's detectors triggered past its
+                          hysteresis; nothing refreshed
+      TRIGGERED_HYSTERESIS at least one tenant triggered but none has
+                          accumulated `hysteresis` consecutive ticks —
+                          noisy per-tenant detectors cannot thrash the
+                          fleet into refresh storms
+      SUPPRESSED_BREAKER  drifted tenants exist but the fleet refresh
+                          circuit breaker is OPEN (repeated coalesced-
+                          refresh failures); degraded-watch mode
+      REFRESHED           the drifted set was coalesced into fleet
+                          launches (+ solo fallbacks), every artifact
+                          saved and its swap rolled out — the tenants'
+                          new generations are live
+      PARTIAL             the coalesced launches finished but at least
+                          one tenant's save/swap failed (its previous
+                          generation keeps serving; its drift state
+                          stays armed so a later tick retries it)
+      REFRESH_FAILED      the coalesced refresh stage raised before any
+                          tenant completed (fit error, injected fault);
+                          breaker-counted, retried on a later tick —
+                          an in-flight fleet checkpoint resumes
+                          bit-identically
+    """
+
+    WATCHING = 0
+    TRIGGERED_HYSTERESIS = 1
+    SUPPRESSED_BREAKER = 2
+    REFRESHED = 3
+    PARTIAL = 4
+    REFRESH_FAILED = 5
+
+
 class TuneStatus(enum.IntEnum):
     """Per-grid-point outcome codes for hyperparameter search (tpusvm.tune).
 

@@ -1,0 +1,72 @@
+"""Multi-tenant platform tier: thousands of closed loops, one corpus.
+
+A production platform serves thousands of SMALL tenant models, not one
+big one. Every piece of that scenario already exists in isolation —
+fleet trains B SVMs sharing X as one XLA program (tpusvm.fleet),
+autopilot closes the loop for one model (tpusvm.autopilot), serve
+hot-swaps atomically (tpusvm.serve) — and this package is their fusion:
+
+  store.py     the tenant registry: per-tenant label/row-subset view
+               spec, (C, gamma), deployed artifact, drift state — one
+               crash-safe, format-versioned, CRC-fingerprinted file
+               (the autopilot/state.py discipline at fleet scale), plus
+               the coalesced refresh's durable fleet-segment checkpoint
+  views.py     per-tenant (Y, valid) views over ONE shared append-grown
+               sharded dataset — X is loaded and scaled exactly once
+               per tick, never per tenant
+  coalesce.py  the refresh coalescer: the currently-drifted tenant set
+               becomes power-of-two fleet_smo_solve launches (per-tenant
+               warm seeds via tune.warm.deployed_seed in the alpha0
+               lane), checkpointed at segment boundaries so a killed
+               supervisor resumes the SAME fleet solve bit-identically;
+               singleton / odd-corpus tenants fall back to solo
+               refresh_fit
+  loop.py      the supervisor: per-tenant drift detection off the
+               autopilot detectors, hysteresis + refresh breaker,
+               staggered swap roll-out through the serve registry
+
+CLI: `tpusvm tenants [--smoke]`. Chaos gate:
+`python -m tpusvm.faults tenant-chaos-smoke` (kill mid-fleet-refresh +
+corrupt one tenant artifact under client load — no tenant loses rows,
+re-fits from scratch, or serves a torn generation).
+"""
+
+from tpusvm.tenants.coalesce import (
+    CoalescePlan,
+    checkpointed_fleet_refresh,
+    coalesce_drifted,
+    provision_tenants,
+    refresh_drifted,
+)
+from tpusvm.tenants.loop import TenantsConfig, TenantsSupervisor
+from tpusvm.tenants.store import (
+    STORE_VERSION,
+    TenantRecord,
+    TenantsState,
+    is_tenant_store,
+    load_fleet_checkpoint,
+    load_store,
+    save_fleet_checkpoint,
+    save_store,
+)
+from tpusvm.tenants.views import tenant_labels, view_fingerprint
+
+__all__ = [
+    "STORE_VERSION",
+    "TenantRecord",
+    "TenantsState",
+    "TenantsConfig",
+    "TenantsSupervisor",
+    "CoalescePlan",
+    "checkpointed_fleet_refresh",
+    "coalesce_drifted",
+    "provision_tenants",
+    "refresh_drifted",
+    "is_tenant_store",
+    "load_fleet_checkpoint",
+    "load_store",
+    "save_fleet_checkpoint",
+    "save_store",
+    "tenant_labels",
+    "view_fingerprint",
+]

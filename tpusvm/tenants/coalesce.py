@@ -1,0 +1,483 @@
+"""The refresh coalescer: drifted tenants -> power-of-two fleet launches.
+
+The platform's core economics. N drifted tenants refreshed the PR 15
+way cost N solo supervisor loops — N dataset loads, N cold jit caches,
+N sequential solves. Coalescing instead packs the currently-drifted set
+into power-of-two ``fleet_smo_solve`` launches: X is loaded, scaled and
+device-resident ONCE for the whole bucket, per-tenant (C, gamma) enter
+as arrays (one compiled program regardless of hyperparameter spread),
+and each tenant's warm seed — ``tune.warm.deployed_seed`` of its deployed
+artifact — rides the fleet's alpha0 lane, so a mixed warm/cold bucket
+is exact (fleet/batch.py).
+
+Coalescing rules (``coalesce_drifted``):
+
+  * tenants group by their launch STATIC key — kernel family/shape,
+    eps/tau/max_iter, sv_tol, scale policy. Everything jit-static is
+    necessarily shared by one program (fleet/batch.py per-problem
+    statics validation); per-problem axes are exactly
+    (y, valid, alpha0, C, gamma);
+  * a group of >= ``min_fleet`` tenants becomes one fleet launch,
+    bucket-padded to the next power of two with inert zero-label lanes;
+  * singletons and odd-corpus tenants (a static key nobody shares, or
+    an approximate-family artifact whose refresh is rejected by the
+    dual-seed contract) fall back to solo ``refresh_fit`` — the PR 15
+    path, checkpointed per tenant.
+
+Crash safety (``checkpointed_fleet_refresh``): the launch runs in
+``checkpoint_every``-outer-round segments (the fleet's pause_at /
+resume_states surface), and after each segment the BATCHED carry is
+written durably (tenants/store.py:save_fleet_checkpoint, fingerprinted
+against this exact launch). A supervisor SIGKILLed mid-refresh re-enters
+the same batched solve from the last segment boundary — per-lane
+BIT-IDENTICAL to an uninterrupted run, the checkpointed_blocked_solve
+argument applied fleet-wide (each lane's carry is independent state;
+segmenting is exact).
+
+Parity discipline (tests/test_tenants.py): a tenant refreshed in a
+fleet bucket matches its solo refresh_fit control on exact SV-ID set,
+status and accuracy, with b/alpha inside the cross-engine band; bitwise
+equality is reserved for same-program lane invariance (the PR 12
+cross-program fma note).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import zlib
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from tpusvm.status import Status
+from tpusvm.tenants.store import (
+    TenantRecord,
+    load_fleet_checkpoint,
+    save_fleet_checkpoint,
+)
+from tpusvm.tenants.views import tenant_labels
+
+__all__ = [
+    "CoalescePlan",
+    "coalesce_drifted",
+    "checkpointed_fleet_refresh",
+    "provision_tenants",
+    "refresh_drifted",
+]
+
+
+@dataclasses.dataclass
+class CoalescePlan:
+    """The coalescer's decision, JSON-able so the supervisor can persist
+    it in the store's inflight record and a resumed run finishes the
+    SAME launches (not a re-planned set that later appends could have
+    changed)."""
+
+    launches: List[List[str]]   # each: tenant ids of one fleet launch
+    solos: List[str]            # tenant ids refreshed solo
+
+    def to_json(self) -> dict:
+        return {"launches": [list(ids) for ids in self.launches],
+                "solos": list(self.solos)}
+
+    @classmethod
+    def from_json(cls, obj: dict) -> "CoalescePlan":
+        return cls(launches=[list(ids) for ids in obj["launches"]],
+                   solos=list(obj["solos"]))
+
+
+def _static_key(rec: TenantRecord, base) -> tuple:
+    """The launch-compatibility key: everything one fleet program must
+    share. Per-problem axes (y, valid, alpha0, C, gamma) are excluded
+    by construction."""
+    cfg = base.config
+    return (cfg.kernel, cfg.degree, cfg.coef0, cfg.eps, cfg.tau,
+            cfg.max_iter, cfg.sv_tol, bool(base.scale))
+
+
+def coalesce_drifted(records: Sequence[TenantRecord], donors: Dict,
+                     min_fleet: int = 2) -> CoalescePlan:
+    """Group the drifted set by launch static key; groups of
+    >= min_fleet become fleet launches (sorted tenant order inside each
+    — deterministic lane assignment), the rest go solo. `donors` maps
+    tenant_id -> its loaded donor estimator (the supervisor's cache)."""
+    from tpusvm import kernels
+
+    groups: Dict[tuple, List[str]] = {}
+    solos: List[str] = []
+    for rec in sorted(records, key=lambda r: r.tenant_id):
+        base = donors[rec.tenant_id]
+        if kernels.is_approx(base.config.kernel):
+            # odd corpus: the approximate primal regime has no dual
+            # warm seed and refresh_fit rejects it by name — surfaced
+            # as a solo attempt so the failure is a counted per-tenant
+            # outcome, not a dead launch
+            solos.append(rec.tenant_id)
+            continue
+        groups.setdefault(_static_key(rec, base), []).append(
+            rec.tenant_id)
+    launches = []
+    for key in sorted(groups, key=repr):
+        ids = groups[key]
+        if len(ids) >= max(2, min_fleet):
+            launches.append(ids)
+        else:
+            solos.extend(ids)
+    return CoalescePlan(launches=launches, solos=sorted(solos))
+
+
+def _launch_fingerprint(Xs, batch, tenant_ids, opts) -> dict:
+    """JSON-able identity of one coalesced launch: corpus bytes, packed
+    per-problem axes, hyperparameter vectors, statics. A checkpoint
+    from any other launch is refused with the differing fields named."""
+    Xs = np.asarray(Xs)
+    fp = {
+        "n": int(Xs.shape[0]),
+        "d": int(Xs.shape[1]),
+        "x_dtype": str(Xs.dtype),
+        "x_crc32": zlib.crc32(np.ascontiguousarray(Xs).tobytes()),
+        "ys_crc32": zlib.crc32(
+            np.ascontiguousarray(batch.Ys).tobytes()),
+        "valids_crc32": (
+            None if batch.valids is None
+            else zlib.crc32(np.ascontiguousarray(batch.valids).tobytes())),
+        "alpha0s_crc32": (
+            None if batch.alpha0s is None
+            else zlib.crc32(
+                np.ascontiguousarray(batch.alpha0s).tobytes())),
+        "Cs": [float(c) for c in batch.Cs],
+        "gammas": [float(g) for g in batch.gammas],
+        "bucket": int(batch.bucket),
+        "tenant_ids": list(tenant_ids),
+    }
+    for k in sorted(opts):
+        v = opts[k]
+        fp[k] = str(v) if not isinstance(
+            v, (int, float, str, bool, type(None))) else v
+    return fp
+
+
+def checkpointed_fleet_refresh(Xs, batch, *, checkpoint_path: str,
+                               checkpoint_every: int = 64,
+                               resume: bool = False,
+                               fingerprint: dict,
+                               dtype=None,
+                               **opts):
+    """One coalesced launch to convergence, durably checkpointed.
+
+    Runs the packed FleetBatch through fleet_smo_solve in
+    `checkpoint_every`-outer-round segments; after each segment the
+    batched carry is persisted atomically. resume=True restarts from
+    the file when it exists (missing file = fresh start); the
+    fingerprint refuses a checkpoint from any other launch. Returns the
+    batched SMOResult.
+
+    The checkpoint is NOT deleted here — deliberately. Deleting at
+    convergence would open a crash window between solve termination and
+    the per-tenant artifact saves where a kill forces a full re-fit.
+    The file stays until the CALLER has durably committed everything
+    derived from it (the supervisor deletes after its swapping-stage
+    store commit); re-entering a completed checkpoint is cheap — the
+    carry has no RUNNING lane, so the solve returns it immediately.
+
+    The segment schedule is an invariant of (checkpoint_every): an
+    interrupted run resumes at the SAME boundaries an uninterrupted run
+    pauses at, so the trajectory — and every lane's final alpha bytes —
+    is bit-identical (numpy round-trips the carry exactly)."""
+    import jax.numpy as jnp
+
+    from tpusvm.fleet.solve import fleet_smo_solve
+    from tpusvm.solver.blocked import _OuterState
+
+    if checkpoint_every < 1:
+        raise ValueError(
+            f"checkpoint_every must be >= 1, got {checkpoint_every}")
+    state = None
+    if resume and os.path.exists(checkpoint_path):
+        state = load_fleet_checkpoint(checkpoint_path, fingerprint)
+
+    Xd = jnp.asarray(Xs, dtype if dtype is not None else jnp.float32)
+    Ys_d = jnp.asarray(batch.Ys)
+    valids_d = None if batch.valids is None else jnp.asarray(batch.valids)
+    adt = opts.get("accum_dtype")
+    alpha0s_d = (None if batch.alpha0s is None
+                 else jnp.asarray(batch.alpha0s,
+                                  adt if adt is not None else Xd.dtype))
+    if batch.alpha0s is not None:
+        opts.setdefault("warm_start", True)
+    Cs_d = jnp.asarray(batch.Cs)
+    gs_d = jnp.asarray(batch.gammas)
+
+    while True:
+        if state is None:
+            start = 0
+        else:
+            running = np.asarray(state.status) == int(Status.RUNNING)
+            start = int(np.max(np.asarray(state.n_outer)[running])) \
+                if running.any() else int(np.max(np.asarray(state.n_outer)))
+        res, st = fleet_smo_solve(
+            Xd, Ys_d, valids_d, alpha0s_d, Cs=Cs_d, gammas=gs_d,
+            resume_states=state,
+            pause_at=jnp.int32(start + checkpoint_every),
+            return_state=True, **opts,
+        )
+        # one host sync materialises the whole batched carry (the
+        # checkpoint payload); segments make this a per-K-rounds cost
+        state = _OuterState(*(np.asarray(x) for x in st))
+        if not (np.asarray(state.status) == int(Status.RUNNING)).any():
+            return res
+        save_fleet_checkpoint(checkpoint_path, state, fingerprint)
+
+
+def _lane_model(cfg, scale, scaler, Xs, Y, lane):
+    """One tenant's refreshed estimator from its fleet lane result —
+    the _fit_scaled SV-extraction recipe applied to a lane (the solo
+    refresh's exact postprocessing, so a coalesced artifact has the
+    same shape, provenance fields and scaled-SV layout a solo one
+    has)."""
+    import jax.numpy as jnp
+
+    from tpusvm.models import BinarySVC
+    from tpusvm.oracle.smo import get_sv_indices
+
+    model = BinarySVC(config=cfg, dtype=jnp.float32, scale=scale,
+                      accum_dtype="auto", solver="blocked")
+    model.scaler_ = scaler if scale else None
+    alpha = np.asarray(lane.alpha)
+    sv = get_sv_indices(alpha, cfg.sv_tol)
+    model.sv_X_ = np.asarray(Xs)[sv]
+    model.sv_Y_ = np.asarray(Y)[sv].astype(np.int32)
+    model.sv_alpha_ = alpha[sv]
+    model.sv_ids_ = sv.astype(np.int32)
+    model.b_ = float(lane.b)
+    model.b_high_ = float(lane.b_high)
+    model.b_low_ = float(lane.b_low)
+    model.n_iter_ = int(lane.n_iter)
+    model.status_ = Status(int(lane.status))
+    return model
+
+
+def refresh_drifted(X, labels, records: Sequence[TenantRecord], *,
+                    artifacts_dir: str,
+                    checkpoint_dir: Optional[str] = None,
+                    checkpoint_every: int = 64,
+                    resume: bool = False,
+                    warm: bool = True,
+                    plan: Optional[CoalescePlan] = None,
+                    min_fleet: int = 2,
+                    solver_opts: Optional[dict] = None,
+                    log=None) -> dict:
+    """Refresh the drifted tenant set: coalesced fleet launches + solo
+    fallbacks, every artifact saved atomically.
+
+    X/labels are the SHARED corpus arrays (one load for every tenant).
+    Returns {tenant_id: {"out_path", "status", "n_iter", "sv_count",
+    "mode", "error"?}} — a failed tenant is a counted outcome carrying
+    its error, never a dead launch (the other lanes' artifacts still
+    land). `plan` pins a previously-persisted coalescing decision
+    (resume path); omitted, the plan is computed here."""
+    import jax.numpy as jnp
+
+    from tpusvm.config import resolve_accum_dtype
+    from tpusvm.data.scaler import MinMaxScaler
+    from tpusvm.fleet.batch import pack_problems
+    from tpusvm.fleet.results import lane_result
+    from tpusvm.models import BinarySVC
+    from tpusvm.serve.refresh import refresh_fit
+    from tpusvm.tune.warm import deployed_seed
+
+    say = log or (lambda msg: None)
+    X = np.asarray(X)
+    labels = np.asarray(labels)
+    n = int(X.shape[0])
+    ckdir = checkpoint_dir or artifacts_dir
+    os.makedirs(artifacts_dir, exist_ok=True)
+    os.makedirs(ckdir, exist_ok=True)
+    opts = dict(solver_opts or {})
+    by_id = {r.tenant_id: r for r in records}
+    donors = {r.tenant_id: BinarySVC.load(r.model_path)
+              for r in records}
+    if plan is None:
+        plan = coalesce_drifted(records, donors, min_fleet=min_fleet)
+    outcomes: dict = {}
+
+    # scale ONCE: every scale=True tenant shares X, so the fitted
+    # min/max — and therefore the scaled matrix — is identical to what
+    # each solo fit would compute (BinarySVC._scale_fit)
+    scaler = MinMaxScaler().fit(X)
+    Xs_scaled = scaler.transform(X)
+
+    for ids in plan.launches:
+        recs = [by_id[t] for t in ids]
+        bases = [donors[t] for t in ids]
+        base0 = bases[0]
+        cfg0 = base0.config
+        Xs = Xs_scaled if base0.scale else X
+        Ys, valids, seeds, Cs, gammas = [], [], [], [], []
+        for rec, base in zip(recs, bases):
+            Y, valid = tenant_labels(labels, rec)
+            Ys.append(Y)
+            valids.append(valid)
+            a0 = None
+            if warm:
+                a0 = deployed_seed(base.sv_ids_, base.sv_alpha_, n,
+                                   Y, rec.C)
+                if not a0.any():
+                    a0 = None
+            seeds.append(a0)
+            Cs.append(rec.C)
+            gammas.append(rec.gamma)
+        launch_opts = dict(
+            eps=cfg0.eps, tau=cfg0.tau, max_iter=cfg0.max_iter,
+            kernel=cfg0.kernel, degree=cfg0.degree, coef0=cfg0.coef0,
+            accum_dtype=resolve_accum_dtype("auto"),
+            **opts,
+        )
+        batch = pack_problems(
+            Ys, Cs, gammas,
+            valids=None if all(v is None for v in valids) else valids,
+            alpha0s=None if all(a is None for a in seeds) else seeds,
+        )
+        ck = os.path.join(ckdir, "fleet_%s.ck.npz"
+                          % zlib.crc32(",".join(ids).encode()))
+        fp = _launch_fingerprint(Xs, batch, ids, launch_opts)
+        say(f"tenants: fleet launch of {len(ids)} tenants "
+            f"(bucket {batch.bucket}, warm "
+            f"{sum(a is not None for a in seeds)}/{len(ids)})")
+        res = checkpointed_fleet_refresh(
+            Xs, batch, checkpoint_path=ck,
+            checkpoint_every=checkpoint_every, resume=resume,
+            fingerprint=fp, dtype=jnp.float32, **launch_opts,
+        )
+        for i, (rec, base) in enumerate(zip(recs, bases)):
+            lane = lane_result(res, i)
+            cfg = dataclasses.replace(base.config, C=rec.C,
+                                      gamma=rec.gamma)
+            out_path = os.path.join(artifacts_dir,
+                                    rec.tenant_id + ".npz")
+            try:
+                model = _lane_model(cfg, base.scale, scaler, Xs,
+                                    Ys[i], lane)
+                model.save(out_path)
+                outcomes[rec.tenant_id] = {
+                    "out_path": out_path, "mode": "fleet",
+                    "checkpoint": ck,
+                    "status": model.status_,
+                    "n_iter": model.n_iter_,
+                    "sv_count": int(model.sv_ids_.shape[0]),
+                }
+            except Exception as e:  # noqa: BLE001 — one tenant's save
+                # failure must not drop its bucket-mates' artifacts
+                outcomes[rec.tenant_id] = {
+                    "out_path": out_path, "mode": "fleet",
+                    "checkpoint": ck,
+                    "status": None, "n_iter": 0, "sv_count": 0,
+                    "error": f"{type(e).__name__}: {e}",
+                }
+
+    for tid in plan.solos:
+        rec = by_id[tid]
+        out_path = os.path.join(artifacts_dir, tid + ".npz")
+        try:
+            Y, valid = tenant_labels(labels, rec)
+            solo_opts = dict(opts)
+            if valid is not None:
+                solo_opts["valid"] = valid
+            ck = os.path.join(ckdir, tid + ".solo_ck.npz")
+            model = refresh_fit(
+                rec.model_path, X, Y, out_path=out_path,
+                checkpoint_path=ck, checkpoint_every=checkpoint_every,
+                resume=resume, warm=warm, solver_opts=solo_opts,
+            )
+            outcomes[tid] = {
+                "out_path": out_path, "mode": "solo",
+                "status": model.status_,
+                "n_iter": model.n_iter_,
+                "sv_count": int(model.sv_ids_.shape[0]),
+            }
+        except Exception as e:  # noqa: BLE001 — counted per-tenant
+            # outcome; the rest of the drifted set still refreshes
+            outcomes[tid] = {
+                "out_path": out_path, "mode": "solo",
+                "status": None, "n_iter": 0, "sv_count": 0,
+                "error": f"{type(e).__name__}: {e}",
+            }
+            say(f"tenants: solo refresh of {tid} FAILED "
+                f"({type(e).__name__}: {e})")
+    return outcomes
+
+
+def provision_tenants(X, labels, records: Sequence[TenantRecord], *,
+                      artifacts_dir: str, scale: bool = True,
+                      config=None, solver_opts: Optional[dict] = None,
+                      log=None) -> dict:
+    """Cold-start a whole tenant fleet in ONE coalesced launch.
+
+    The bootstrap analogue of refresh_drifted: every record's initial
+    artifact is fitted from scratch in a single power-of-two
+    fleet_smo_solve over the shared corpus (X scaled once, per-tenant
+    C/gamma as per-problem axes) and saved atomically as
+    artifacts_dir/<tenant_id>.npz; each record's model_path is filled
+    in. `config` is the shared static template (kernel/eps/tau/...;
+    default SVMConfig()); C and gamma always come from the records.
+    Returns the refresh_drifted-shaped outcomes dict."""
+    import jax.numpy as jnp
+
+    from tpusvm.config import SVMConfig, resolve_accum_dtype
+    from tpusvm.data.scaler import MinMaxScaler
+    from tpusvm.fleet.batch import pack_problems
+    from tpusvm.fleet.results import lane_result
+
+    say = log or (lambda msg: None)
+    X = np.asarray(X)
+    labels = np.asarray(labels)
+    os.makedirs(artifacts_dir, exist_ok=True)
+    cfg0 = config if config is not None else SVMConfig()
+    opts = dict(solver_opts or {})
+    scaler = MinMaxScaler().fit(X) if scale else None
+    Xs = scaler.transform(X) if scale else X
+
+    Ys, valids, Cs, gammas = [], [], [], []
+    for rec in records:
+        rec.validate()
+        Y, valid = tenant_labels(labels, rec)
+        Ys.append(Y)
+        valids.append(valid)
+        Cs.append(rec.C)
+        gammas.append(rec.gamma)
+    launch_opts = dict(
+        eps=cfg0.eps, tau=cfg0.tau, max_iter=cfg0.max_iter,
+        kernel=cfg0.kernel, degree=cfg0.degree, coef0=cfg0.coef0,
+        accum_dtype=resolve_accum_dtype("auto"),
+        **opts,
+    )
+    batch = pack_problems(
+        Ys, Cs, gammas,
+        valids=None if all(v is None for v in valids) else valids,
+    )
+    say(f"tenants: provisioning {len(records)} tenants in one fleet "
+        f"launch (bucket {batch.bucket})")
+    from tpusvm.fleet.solve import fleet_smo_solve
+
+    res = fleet_smo_solve(
+        jnp.asarray(Xs, jnp.float32), jnp.asarray(batch.Ys),
+        None if batch.valids is None else jnp.asarray(batch.valids),
+        None, Cs=jnp.asarray(batch.Cs), gammas=jnp.asarray(batch.gammas),
+        **launch_opts,
+    )
+    outcomes: dict = {}
+    for i, rec in enumerate(records):
+        cfg = dataclasses.replace(cfg0, C=rec.C, gamma=rec.gamma)
+        out_path = os.path.join(artifacts_dir, rec.tenant_id + ".npz")
+        model = _lane_model(cfg, scale, scaler, Xs, Ys[i],
+                            lane_result(res, i))
+        model.save(out_path)
+        rec.model_path = out_path
+        rec.rows_at_refresh = int(X.shape[0])
+        outcomes[rec.tenant_id] = {
+            "out_path": out_path, "mode": "fleet",
+            "status": model.status_, "n_iter": model.n_iter_,
+            "sv_count": int(model.sv_ids_.shape[0]),
+        }
+    return outcomes

@@ -1,0 +1,294 @@
+"""Crash-safe tenant registry + the coalesced refresh's fleet checkpoint.
+
+One file holds the WHOLE platform's decision memory: every tenant's
+view spec, hyperparameters, deployed artifact, drift counters and
+refresh provenance, plus the supervisor's stage machine and the
+in-flight coalesced launch. The autopilot/state.py discipline applies
+unchanged — format-versioned, CRC-fingerprinted canonical JSON, atomic
+temp + fsync_replace write behind the ``tenants.store`` injection
+point — so a torn or hand-edited store is a named error, never a
+silently wrong fleet of decisions, and a killed supervisor resumes with
+exactly the record set the last durable commit left.
+
+The second durable artifact here is the coalesced refresh's
+fleet-segment checkpoint: the batched outer-loop carry (every lane's
+alpha/f/counters, solver/blocked._OuterState with a leading problem
+axis) snapshotted between fleet_smo_solve segments. It rides the
+solver-checkpoint format (np.savez + fingerprint + atomic replace) so
+a supervisor SIGKILLed mid-fleet-refresh re-enters the SAME batched
+solve from the last segment boundary — bit-identical per lane, the
+checkpointed_blocked_solve argument applied to the whole fleet. Both
+writes share the one injection point: a kill rule on ``tenants.store``
+dies exactly where a real crash would, before the rename.
+
+Stage machine (persisted in the store, validated both ways):
+
+  "idle"      no coalesced refresh in flight;
+  "fitting"   a launch is in flight — `inflight` names the EXACT tenant
+              set, row count and checkpoint path, so a resumed
+              supervisor finishes THAT launch (not a re-planned one,
+              which later appends could have changed);
+  "swapping"  every in-flight artifact is saved (atomically); only the
+              staggered swap roll-out remains.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import zlib
+from typing import Dict, Optional
+
+import numpy as np
+
+from tpusvm import faults
+from tpusvm.utils.durable import fsync_replace
+
+STORE_VERSION = 1
+
+STAGES = ("idle", "fitting", "swapping")
+
+FLEET_CKPT_VERSION = 1
+
+
+@dataclasses.dataclass
+class TenantRecord:
+    """One tenant's slice of the platform: its view over the shared
+    corpus, its hyperparameters, its deployed artifact and its drift
+    state. `positive_label` defines the label-column view (Y = +1 on
+    rows carrying it, -1 elsewhere); `row_mod`/`row_ofs` optionally
+    restrict the tenant to the row subset ``idx % row_mod == row_ofs``
+    (threaded through the fleet's per-problem valid mask — X itself is
+    never copied per tenant)."""
+
+    tenant_id: str
+    positive_label: int
+    C: float
+    gamma: float
+    row_mod: Optional[int] = None
+    row_ofs: int = 0
+    model_path: str = ""              # current warm-start donor artifact
+    generation: int = 0
+    rows_at_refresh: int = 0
+    last_refresh_t: float = 0.0       # supervisor clock domain
+    consecutive_triggered: int = 0
+    refreshes: int = 0
+    failures: int = 0
+
+    def to_json(self) -> dict:
+        return {f.name: getattr(self, f.name)
+                for f in dataclasses.fields(self)}
+
+    def validate(self) -> None:
+        if not self.tenant_id:
+            raise ValueError("tenant_id must be non-empty")
+        if not (np.isfinite(self.C) and self.C > 0):
+            raise ValueError(
+                f"tenant {self.tenant_id!r}: C must be positive finite, "
+                f"got {self.C!r}")
+        if not (np.isfinite(self.gamma) and self.gamma > 0):
+            raise ValueError(
+                f"tenant {self.tenant_id!r}: gamma must be positive "
+                f"finite, got {self.gamma!r}")
+        if self.row_mod is not None:
+            if self.row_mod < 1:
+                raise ValueError(
+                    f"tenant {self.tenant_id!r}: row_mod must be >= 1, "
+                    f"got {self.row_mod}")
+            if not (0 <= self.row_ofs < self.row_mod):
+                raise ValueError(
+                    f"tenant {self.tenant_id!r}: row_ofs {self.row_ofs} "
+                    f"outside [0, row_mod={self.row_mod})")
+
+
+@dataclasses.dataclass
+class TenantsState:
+    """The platform's whole decision memory: tenant records keyed by id
+    plus the supervisor's stage machine and fleet-level counters."""
+
+    seed: int
+    tick: int = 0
+    stage: str = "idle"
+    # the in-flight coalesced refresh: {"tenant_ids": [...], "plan":
+    # CoalescePlan.to_json(), "stage_rows": int, "outcomes": {...}} —
+    # persisted BEFORE the launch starts so a resumed supervisor
+    # finishes the same launch over the same row prefix
+    inflight: Optional[dict] = None
+    generation: int = 0               # completed coalesced refresh rounds
+    refreshes: int = 0                # per-tenant refreshes landed, total
+    failures: int = 0
+    breaker: Optional[dict] = None    # faults.CircuitBreaker.snapshot()
+    tenants: Dict[str, TenantRecord] = dataclasses.field(
+        default_factory=dict)
+
+    def to_json(self) -> dict:
+        out = {
+            "store_version": STORE_VERSION,
+            **{f.name: getattr(self, f.name)
+               for f in dataclasses.fields(self) if f.name != "tenants"},
+            "tenants": {tid: rec.to_json()
+                        for tid, rec in sorted(self.tenants.items())},
+        }
+        return out
+
+
+def _canonical(payload: dict) -> bytes:
+    return json.dumps(payload, sort_keys=True,
+                      separators=(",", ":")).encode()
+
+
+def save_store(path: str, state: TenantsState) -> None:
+    """Atomic write (temp + fsync_replace) with a CRC32 fingerprint of
+    the canonical payload — a kill mid-write leaves the previous
+    store."""
+    if state.stage not in STAGES:
+        raise ValueError(f"unknown tenants stage {state.stage!r}")
+    for rec in state.tenants.values():
+        rec.validate()
+    payload = state.to_json()
+    obj = {"crc32": zlib.crc32(_canonical(payload)) & 0xFFFFFFFF,
+           **payload}
+    faults.point("tenants.store", path=path, stage=state.stage,
+                 tenants=len(state.tenants))
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(obj, f, indent=1, sort_keys=True)
+        f.write("\n")
+    fsync_replace(tmp, path)
+
+
+def is_tenant_store(path: str) -> bool:
+    """Cheap sniff for `tpusvm info`: a JSON file carrying
+    store_version."""
+    if not os.path.isfile(path):
+        return False
+    try:
+        with open(path) as f:
+            head = json.load(f)
+    except (OSError, ValueError):
+        return False
+    return isinstance(head, dict) and "store_version" in head \
+        and "tenants" in head
+
+
+def load_store(path: str) -> TenantsState:
+    """Version gate + CRC verification first; corruption and version
+    skew are named errors, not wrong replays."""
+    with open(path) as f:
+        try:
+            obj = json.load(f)
+        except json.JSONDecodeError as e:
+            raise ValueError(
+                f"tenant store {path!r} is not valid JSON ({e}); "
+                "delete it to start fresh"
+            ) from e
+    if "store_version" not in obj:
+        raise ValueError(
+            f"{path!r} is not a tpusvm tenant store (no store_version)"
+        )
+    v = obj["store_version"]
+    if v != STORE_VERSION:
+        raise ValueError(
+            f"unsupported tenant store version {v!r} in {path!r} "
+            f"(this build reads version {STORE_VERSION})"
+        )
+    crc = obj.pop("crc32", None)
+    want = zlib.crc32(_canonical(obj)) & 0xFFFFFFFF
+    if crc != want:
+        raise ValueError(
+            f"tenant store {path!r} fails its CRC fingerprint "
+            f"(stored {crc!r}, computed {want}) — torn write or manual "
+            "edit; delete it to start fresh"
+        )
+    obj.pop("store_version")
+    raw_tenants = obj.pop("tenants", {})
+    fields = {f.name for f in dataclasses.fields(TenantsState)} - {
+        "tenants"}
+    unknown = set(obj) - fields
+    if unknown:
+        raise ValueError(
+            f"tenant store {path!r} carries unknown fields "
+            f"{sorted(unknown)} (written by a newer tpusvm?)"
+        )
+    rec_fields = {f.name for f in dataclasses.fields(TenantRecord)}
+    tenants = {}
+    for tid, rec in raw_tenants.items():
+        bad = set(rec) - rec_fields
+        if bad:
+            raise ValueError(
+                f"tenant store {path!r}: tenant {tid!r} carries unknown "
+                f"fields {sorted(bad)} (written by a newer tpusvm?)"
+            )
+        tenants[tid] = TenantRecord(**rec)
+        tenants[tid].validate()
+        if tenants[tid].tenant_id != tid:
+            raise ValueError(
+                f"tenant store {path!r}: key {tid!r} names record "
+                f"{tenants[tid].tenant_id!r}"
+            )
+    st = TenantsState(tenants=tenants, **obj)
+    if st.stage not in STAGES:
+        raise ValueError(
+            f"tenant store {path!r} names unknown stage {st.stage!r}"
+        )
+    if st.stage != "idle" and not st.inflight:
+        raise ValueError(
+            f"tenant store {path!r}: stage {st.stage!r} with no "
+            "inflight launch record"
+        )
+    return st
+
+
+# ------------------------------------------------- fleet checkpointing
+def save_fleet_checkpoint(path: str, states, fingerprint: dict) -> None:
+    """Atomically persist a BATCHED outer-loop carry + its fingerprint.
+
+    `states` is the solver/blocked._OuterState the fleet launch returned
+    with return_state=True — every field carries the leading problem
+    axis; numpy round-trips the float arrays bit-exact, which is the
+    whole resume-bit-identical argument. The injection point fires
+    before the write, so a kill rule dies with the PREVIOUS checkpoint
+    (or none) intact — exactly a real mid-refresh crash."""
+    faults.point("tenants.store", path=path, stage="fleet_checkpoint")
+    tmp = path + ".tmp"
+    arrays = {f: np.asarray(getattr(states, f))
+              for f in type(states)._fields}
+    np.savez(tmp, fleet_ckpt_version=FLEET_CKPT_VERSION,
+             fingerprint=json.dumps(fingerprint, sort_keys=True),
+             **arrays)
+    fsync_replace(tmp + ".npz", path)  # np.savez appends .npz
+
+
+def load_fleet_checkpoint(path: str, fingerprint: dict):
+    """Load a batched carry; refuse (with the differing fields named)
+    any checkpoint that does not belong to this exact launch."""
+    from tpusvm.solver.blocked import _OuterState
+
+    with np.load(path, allow_pickle=False) as z:
+        if "fleet_ckpt_version" not in z.files:
+            raise ValueError(
+                f"{path!r} is not a tpusvm fleet checkpoint "
+                "(no fleet_ckpt_version)"
+            )
+        v = int(z["fleet_ckpt_version"])
+        if v != FLEET_CKPT_VERSION:
+            raise ValueError(
+                f"unsupported fleet checkpoint version {v} (this build "
+                f"reads version {FLEET_CKPT_VERSION})"
+            )
+        saved = json.loads(str(z["fingerprint"]))
+        want = json.loads(json.dumps(fingerprint, sort_keys=True))
+        if saved != want:
+            diff = sorted(
+                k for k in set(saved) | set(want)
+                if saved.get(k) != want.get(k)
+            )
+            raise ValueError(
+                "fleet checkpoint does not belong to this launch "
+                f"(differing fields: {diff}); it was written for "
+                f"{ {k: saved.get(k) for k in diff} }, this launch has "
+                f"{ {k: want.get(k) for k in diff} }"
+            )
+        return _OuterState(*(np.asarray(z[f])
+                             for f in _OuterState._fields))
