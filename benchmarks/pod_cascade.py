@@ -1,0 +1,229 @@
+#!/usr/bin/env python
+"""Out-of-core pod cascade vs the in-memory cascade: parity + cost.
+
+The pod tier's acceptance harness: the same rings workload is trained
+two ways per (topology, P) cell —
+
+  inmem  ``BinarySVC.fit_cascade`` with every row materialized up front
+         (the shard_map cascade's host fallback on plain CPU jax)
+  pod    ``BinarySVC.fit_pod`` over P worker PROCESSES, each streaming
+         ONLY its manifest shards (tpusvm.pod) — nothing holds the full
+         array, residency is bounded by the reader's prefetch window
+
+with HARD parity gates (the whole point of the pod tier: going
+out-of-core must cost zero model quality):
+
+  * sv_parity / alpha_parity / b_parity: the pod fit reproduces the
+    in-memory cascade bit-for-bit — same SV-ID set, byte-identical
+    alpha vector over that set, bitwise-equal b;
+  * accuracy: held-out accuracy equal across arms (implied by the
+    bitwise gates, kept as an independent end-to-end check);
+  * rows_ok: the leaf partition conserves rows (sum over workers == n);
+  * max_live_shards: every worker's reader stayed within
+    prefetch_depth + 1 resident shards (the bounded-RSS contract);
+
+plus the cost axis benchdiff tracks release-over-release: pod wall
+clock per cell and its overhead ratio over the in-memory arm (worker
+processes + sockets are pure overhead at benchmark scale; the ratio is
+the price of the out-of-core capability and must not silently grow).
+
+Timing rows keep the MIN over --repeats interleaved passes; benchdiff
+gates them at --level full only (Rule.timing) so the committed smoke
+baseline stays machine-portable.
+
+Usage:
+  python benchmarks/pod_cascade.py --smoke --jsonl out.jsonl
+  python benchmarks/pod_cascade.py --workers 2,4 --repeats 3
+"""
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from benchmarks.common import emit, log, pin_platform
+
+pin_platform()
+
+import numpy as np  # noqa: E402
+
+from tpusvm.config import CascadeConfig, SVMConfig  # noqa: E402
+from tpusvm.data import rings  # noqa: E402
+from tpusvm.models import BinarySVC  # noqa: E402
+from tpusvm.stream.format import ingest_arrays  # noqa: E402
+
+PREFETCH_DEPTH = 2  # fit_pod default; the residency gate derives from it
+
+
+def _fit_inmem(X, Y, cfg, cc):
+    model = BinarySVC(cfg, solver="pair")
+    t0 = time.perf_counter()
+    model.fit_cascade(X, Y, cc)
+    return model, time.perf_counter() - t0
+
+
+def _fit_pod(data_dir, cfg, cc):
+    model = BinarySVC(cfg, solver="pair")
+    t0 = time.perf_counter()
+    model.fit_pod(data_dir, cc, prefetch_depth=PREFETCH_DEPTH)
+    return model, time.perf_counter() - t0
+
+
+def _sv_key(model):
+    ids = np.asarray(model.sv_ids_)
+    order = np.argsort(ids)
+    alpha = np.asarray(model.sv_alpha_)[order]
+    return (set(int(i) for i in ids),
+            alpha.tobytes(),
+            float(np.asarray(model.b_)))
+
+
+def run(args) -> int:
+    n = 192 if args.smoke else args.n
+    workers = [int(w) for w in args.workers.split(",")]
+    repeats = 1 if args.smoke else args.repeats
+    topologies = (["tree", "star"] if args.topology == "both"
+                  else [args.topology])
+    cfg = SVMConfig(C=args.C, gamma=args.gamma, max_rounds=args.max_rounds)
+
+    X, Y = rings(n=n + args.n_test, seed=args.seed)
+    Xtr, Ytr = X[:n], Y[:n]
+    Xte, Yte = X[n:], Y[n:]
+    d = int(X.shape[1])
+
+    rows, violations = [], []
+    with tempfile.TemporaryDirectory(prefix="pod_cascade_bench_") as tmp:
+        data_dir = os.path.join(tmp, "ds")
+        ingest_arrays(data_dir, Xtr, Ytr,
+                      rows_per_shard=args.rows_per_shard)
+
+        for topo in topologies:
+            for P in workers:
+                cc = CascadeConfig(n_shards=P,
+                                   sv_capacity=args.sv_capacity,
+                                   topology=topo)
+                best = {}   # arm -> (train_s, model)
+                for _ in range(repeats):  # interleave arms, keep min
+                    for arm, fit in (("inmem", None), ("pod", None)):
+                        if arm == "inmem":
+                            m, dt = _fit_inmem(Xtr, Ytr, cfg, cc)
+                        else:
+                            m, dt = _fit_pod(data_dir, cfg, cc)
+                        if arm not in best or dt < best[arm][0]:
+                            best[arm] = (dt, m)
+                im_s, im = best["inmem"]
+                pod_s, pod = best["pod"]
+                cell = f"{topo}/P={P}"
+                log(f"pod_cascade {cell}: inmem {im_s:.2f}s, "
+                    f"pod {pod_s:.2f}s, {len(pod.sv_ids_)} SVs, "
+                    f"{pod.cascade_rounds_} rounds")
+
+                im_ids, im_alpha, im_b = _sv_key(im)
+                pd_ids, pd_alpha, pd_b = _sv_key(pod)
+                sv_parity = pd_ids == im_ids
+                alpha_parity = pd_alpha == im_alpha
+                b_parity = pd_b == im_b
+                acc_im = float(im.score(Xte, Yte))
+                acc_pod = float(pod.score(Xte, Yte))
+                live = int(pod.stream_max_live_shards_)
+                rows_ok = sum(pod.pod_worker_rows_) == n
+                if not rows_ok:
+                    violations.append(
+                        f"{cell}: leaf partition lost rows "
+                        f"({sum(pod.pod_worker_rows_)} != {n})")
+
+                if not sv_parity:
+                    violations.append(
+                        f"{cell}: pod SV-ID set diverged from in-memory "
+                        f"cascade ({len(pd_ids)} vs {len(im_ids)} SVs)")
+                elif not alpha_parity:
+                    violations.append(
+                        f"{cell}: pod alpha bytes differ on an identical "
+                        f"SV-ID set")
+                if not b_parity:
+                    violations.append(
+                        f"{cell}: pod b={pd_b!r} != inmem b={im_b!r}")
+                if acc_pod != acc_im:
+                    violations.append(
+                        f"{cell}: held-out accuracy diverged "
+                        f"({acc_pod} vs {acc_im})")
+                if live > PREFETCH_DEPTH + 1:
+                    violations.append(
+                        f"{cell}: a worker held {live} live shards, over "
+                        f"the prefetch_depth+1={PREFETCH_DEPTH + 1} bound")
+                for arm, m, dt in (("inmem", im, im_s), ("pod", pod, pod_s)):
+                    if m.status_.name != "CONVERGED":
+                        violations.append(
+                            f"{cell}: {arm} arm ended {m.status_.name}")
+                    row = {
+                        "bench": "pod_cascade", "arm": arm,
+                        "topology": topo, "P": P, "n": n, "d": d,
+                        "smoke": bool(args.smoke),
+                        "converged": m.status_.name == "CONVERGED",
+                        "sv_count": len(m.sv_ids_),
+                        "rounds": int(m.cascade_rounds_),
+                        "accuracy": acc_im if arm == "inmem" else acc_pod,
+                        "train_s": round(dt, 4),
+                        "rows_per_s": round(n / dt, 1),
+                    }
+                    if arm == "pod":
+                        row.update({
+                            "sv_parity": sv_parity and alpha_parity,
+                            "b_parity": b_parity,
+                            "rows_ok": rows_ok,
+                            "max_live_shards": live,
+                            "pod_overhead_x": round(pod_s / im_s, 2),
+                        })
+                    rows.append(row)
+
+    rows.append({
+        "bench": "pod_cascade", "summary": True,
+        "n": n, "d": d, "smoke": bool(args.smoke),
+        "cells": len(topologies) * len(workers),
+        "violations": violations,
+    })
+
+    out = open(args.jsonl, "a") if args.jsonl else None
+    for row in rows:
+        emit(row)  # prints to stdout, injects provenance in place
+        if out:
+            out.write(json.dumps(row, sort_keys=True) + "\n")
+    if out:
+        out.close()
+
+    for v in violations:
+        log(f"GATE FAILED: {v}")
+    return 1 if violations else 0
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--smoke", action="store_true",
+                    help="CI shape: n=192, one timing pass per arm")
+    ap.add_argument("--n", type=int, default=512,
+                    help="training rows (smoke pins 192)")
+    ap.add_argument("--n-test", type=int, default=128,
+                    help="held-out rows for the accuracy gate")
+    ap.add_argument("--workers", default="2,4",
+                    help="comma-separated worker-process sweep")
+    ap.add_argument("--topology", choices=["tree", "star", "both"],
+                    default="both")
+    ap.add_argument("--rows-per-shard", type=int, default=24)
+    ap.add_argument("--sv-capacity", type=int, default=128)
+    ap.add_argument("--C", type=float, default=10.0)
+    ap.add_argument("--gamma", type=float, default=10.0)
+    ap.add_argument("--max-rounds", type=int, default=12)
+    ap.add_argument("--repeats", type=int, default=2,
+                    help="interleaved timing passes, min kept (smoke: 1)")
+    ap.add_argument("--seed", type=int, default=3)
+    ap.add_argument("--jsonl", help="append result rows to this file")
+    args = ap.parse_args()
+    return run(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -311,6 +311,7 @@ def test_derived_points_are_claimed_by_scenarios():
         "ingest.write_shard", "stream.journal", "stream.append",
         "solver.outer_checkpoint", "models.save", "serve.state_write",
         "autopilot.state", "cascade.checkpoint", "tenants.store",
+        "pod.merge",
     }, "write-guarding point universe drifted — update the scenarios"
     claimed = set()
     for sc in SCENARIOS.values():

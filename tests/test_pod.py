@@ -1,0 +1,298 @@
+"""tpusvm.pod tests: wire protocol, durable round state, leaf loading,
+and the pod-vs-in-process parity gates.
+
+The pod tier's whole claim is "same cascade, different transport": a
+coordinator plus worker PROCESSES connected by framed socket messages
+must walk the identical SV-ID fixed point as the in-process cascade on
+the same rows — bit-identical alpha bytes and b, not tolerances — while
+each worker streams only its own manifest shards. The parity tests here
+run on plain CPU jax with zero shard_map skips (cascade_fit's host
+fallback is the in-process control arm).
+"""
+
+import os
+import socket
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpusvm.config import CascadeConfig, SVMConfig
+from tpusvm.data import MinMaxScaler, rings
+from tpusvm.oracle import get_sv_indices, smo_train
+from tpusvm.parallel.cascade import _leaf_buf, cascade_fit
+from tpusvm.parallel.svbuffer import SVBuffer
+from tpusvm.pod import pod_fit
+from tpusvm.pod.protocol import recv_msg, send_msg
+from tpusvm.pod.state import (
+    check_pod_round_state_config,
+    load_pod_round_state,
+    save_pod_round_state,
+)
+from tpusvm.stream import (
+    ShardReader,
+    ingest_arrays,
+    open_dataset,
+    partition_from_dataset,
+)
+
+CFG = SVMConfig(C=10.0, gamma=10.0, max_rounds=12)
+P = 4
+
+
+@pytest.fixture(scope="module")
+def rings_data():
+    return rings(n=192, seed=3)
+
+
+@pytest.fixture(scope="module")
+def dataset(tmp_path_factory, rings_data):
+    X, Y = rings_data
+    out = str(tmp_path_factory.mktemp("pod") / "ds")
+    ingest_arrays(out, X, Y, rows_per_shard=24)
+    return open_dataset(out)
+
+
+@pytest.fixture(scope="module")
+def oracle(rings_data):
+    X, Y = rings_data
+    Xs = MinMaxScaler().fit_transform(X)
+    return Xs, Y, smo_train(Xs, Y, CFG)
+
+
+# ---------------------------------------------------------------- protocol
+def test_protocol_roundtrip_bit_exact():
+    a, b = socket.socketpair()
+    try:
+        arrays = {
+            "f64": np.linspace(-1, 1, 7, dtype=np.float64),
+            "f32": np.float32([[1.5, -2.25], [0.0, 3e-8]]),
+            "i32": np.arange(-3, 3, dtype=np.int32),
+            "mask": np.array([True, False, True]),
+        }
+        send_msg(a, {"op": "train", "req": 7, "b": 0.5}, arrays)
+        meta, got = recv_msg(b)
+        assert meta == {"op": "train", "req": 7, "b": 0.5}
+        assert sorted(got) == sorted(arrays)
+        for k, v in arrays.items():
+            assert got[k].dtype == v.dtype
+            assert got[k].tobytes() == v.tobytes()
+
+        # array-less message: empty npz section, meta only
+        send_msg(b, {"op": "bye"})
+        meta, got = recv_msg(a)
+        assert meta == {"op": "bye"} and got == {}
+    finally:
+        a.close()
+        b.close()
+
+
+def test_protocol_short_frame_is_peer_death():
+    # a worker SIGKILLed mid-write leaves a short frame: the reader must
+    # surface ConnectionError (peer death), never a truncated message
+    a, b = socket.socketpair()
+    try:
+        import struct
+
+        a.sendall(struct.pack(">I", 64) + b"\x00\x00\x00\x04abcd")
+        a.close()
+        with pytest.raises(ConnectionError, match="mid-frame"):
+            recv_msg(b)
+    finally:
+        b.close()
+
+
+def test_protocol_bad_lengths_rejected():
+    import struct
+
+    for frame in (struct.pack(">I", 2) + b"xx",          # total < 4
+                  struct.pack(">II", 8, 100) + b"xxxx"):  # meta > frame
+        a, b = socket.socketpair()
+        try:
+            a.sendall(frame)
+            a.close()
+            with pytest.raises(ConnectionError):
+                recv_msg(b)
+        finally:
+            b.close()
+
+
+# ------------------------------------------------------------- round state
+def _rand_buf(rng, cap=16, dim=4):
+    return SVBuffer(
+        X=jnp.asarray(rng.normal(size=(cap, dim)), jnp.float32),
+        Y=jnp.asarray(np.where(rng.random(cap) < 0.5, 1, -1)),
+        alpha=jnp.asarray(rng.random(cap), jnp.float64),
+        ids=jnp.arange(cap, dtype=jnp.int32),
+        valid=jnp.asarray(rng.random(cap) < 0.75),
+    )
+
+
+def test_pod_round_state_roundtrip(tmp_path):
+    rng = np.random.default_rng(7)
+    path = str(tmp_path / "round.npz")
+    first = _rand_buf(rng)
+    save_pod_round_state(path, first, prev_ids={3, 1}, rnd=1, b=0.25,
+                         n_leaves=P, topology="tree")
+    buf = _rand_buf(rng)
+    save_pod_round_state(path, buf, prev_ids={5, 2, 9}, rnd=2, b=-1.5,
+                         n_leaves=P, topology="tree")
+    sv, prev_ids, next_round, b = load_pod_round_state(path)
+    for f in SVBuffer._fields:
+        assert np.asarray(getattr(sv, f)).tobytes() == \
+            np.asarray(getattr(buf, f)).tobytes(), f
+    # alpha keeps its STORED dtype: truncating mixed-precision duals
+    # would fork the resumed trajectory from an uninterrupted run
+    assert np.asarray(sv.alpha).dtype == np.float64
+    assert prev_ids == {2, 5, 9}
+    assert next_round == 3 and b == -1.5
+    check_pod_round_state_config(path, P, "tree")
+
+
+def test_pod_round_state_config_gate(tmp_path):
+    path = str(tmp_path / "round.npz")
+    save_pod_round_state(path, _rand_buf(np.random.default_rng(0)),
+                         prev_ids=set(), rnd=1, b=0.0,
+                         n_leaves=P, topology="star")
+    with pytest.raises(ValueError, match="n_leaves=4"):
+        check_pod_round_state_config(path, 8, "star")
+    with pytest.raises(ValueError, match="topology"):
+        check_pod_round_state_config(path, P, "tree")
+
+
+# ------------------------------------------------------------ leaf loading
+@pytest.mark.parametrize("stratified", [False, True])
+def test_leaf_rows_pin_partition_semantics(dataset, stratified):
+    # satellite pin: a worker's load_leaf must fill its padded buffer
+    # BYTE-identically to slicing stream.partition_from_dataset — same
+    # assignment, same scaler, same float64 staging before the cast —
+    # so pod SV IDs live in the global row space every other path uses
+    from tpusvm.pod.worker import load_leaf
+
+    part = partition_from_dataset(dataset, P, stratified=stratified,
+                                  scaler=dataset.scaler())
+    stacked = SVBuffer(
+        X=jnp.asarray(part.X, jnp.float32),
+        Y=jnp.asarray(part.Y),
+        alpha=jnp.zeros(part.Y.shape, jnp.float32),
+        ids=jnp.asarray(part.ids),
+        valid=jnp.asarray(part.valid),
+    )
+    for r in range(P):
+        want = _leaf_buf(stacked, r)
+        got, rows, shards_read, live = load_leaf(
+            dataset, r, P, stratified=stratified, prefetch_depth=2,
+            scale=True, dtype=jnp.float32)
+        for f in SVBuffer._fields:
+            assert np.asarray(getattr(got, f)).tobytes() == \
+                np.asarray(getattr(want, f)).tobytes(), (r, f)
+        assert rows == int(part.count[r])
+        # the residency contract: only the leaf's OWN shards are read,
+        # never more than prefetch_depth + 1 resident at once
+        assert shards_read <= dataset.n_shards
+        assert live <= 3
+
+
+def test_shard_reader_subset(dataset, rings_data):
+    X, _ = rings_data
+    sub = [1, 3, 4]
+    reader = ShardReader(dataset, prefetch_depth=2, shards=sub)
+    chunks = list(reader)
+    assert len(chunks) == len(sub)
+    for (Xc, _), i in zip(chunks, sub):
+        info = dataset.manifest.shards[i]
+        want = np.ascontiguousarray(
+            X[info.row_start:info.row_start + info.n_rows])
+        assert Xc.tobytes() == want.tobytes()
+    assert reader.max_live_shards <= 3
+    with pytest.raises(ValueError, match="unique"):
+        ShardReader(dataset, shards=[1, 1])
+    with pytest.raises(IndexError):
+        ShardReader(dataset, shards=[dataset.n_shards])
+
+
+# ----------------------------------------------------------------- parity
+@pytest.mark.parametrize("topology", ["tree", "star"])
+def test_pod_parity_with_inprocess_cascade(dataset, oracle, topology):
+    # THE pod acceptance gate: worker processes fed by manifest shards
+    # must reproduce the in-process cascade BIT-identically (same SV-ID
+    # set, same alpha bytes, same b) and recover the serial oracle's SV
+    # set — with every dataset row accounted for across the workers and
+    # per-worker shard residency within the prefetch bound
+    Xs, Y, o = oracle
+    cc = CascadeConfig(n_shards=P, sv_capacity=128, topology=topology)
+    ctrl = cascade_fit(Xs, Y, CFG, cc)
+    res = pod_fit(str(dataset.path), CFG, cc)
+
+    assert res.converged and ctrl.converged
+    assert set(res.sv_ids.tolist()) == set(ctrl.sv_ids.tolist())
+    assert np.asarray(res.sv_alpha).tobytes() == \
+        np.asarray(ctrl.sv_alpha).tobytes()
+    assert res.b == ctrl.b
+    assert res.rounds == ctrl.rounds
+    assert set(res.sv_ids.tolist()) == set(get_sv_indices(o.alpha).tolist())
+    np.testing.assert_allclose(res.b, o.b, atol=1e-4)
+
+    assert res.topology == topology and res.n_leaves == P
+    assert sum(res.worker_rows) == len(Y)
+    assert res.revives == 0
+    assert max(res.worker_max_live_shards) <= 3
+
+
+def test_pod_shrinking_leaves_recover_oracle(dataset, oracle):
+    # the PR 9 ladder the shard_map cascade REJECTS runs on pod leaves:
+    # the shrinking driver segments each leaf solve host-side, and the
+    # SV-ID fixed point still lands on the oracle's set
+    _, Y, o = oracle
+    cc = CascadeConfig(n_shards=P, sv_capacity=128)
+    res = pod_fit(str(dataset.path), CFG, cc, solver="blocked",
+                  solver_opts={"q": 64, "shrink_every": 2})
+    assert res.converged
+    assert set(res.sv_ids.tolist()) == set(get_sv_indices(o.alpha).tolist())
+    np.testing.assert_allclose(res.b, o.b, atol=1e-4)
+
+
+def test_pod_rejects_shrink_driver_keys_for_pair_solver(dataset):
+    with pytest.raises(ValueError, match="shrinking driver"):
+        pod_fit(str(dataset.path), CFG, CascadeConfig(n_shards=P),
+                solver="pair", solver_opts={"shrink_every": 2})
+    with pytest.raises(ValueError, match="unknown solver"):
+        pod_fit(str(dataset.path), CFG, CascadeConfig(n_shards=P),
+                solver="fleet")
+
+
+@pytest.mark.slow
+def test_pod_coordinator_kill_resume_bit_identical(dataset, tmp_path):
+    # the chaos contract in-test (the CI gate is `python -m tpusvm.faults
+    # pod-chaos-smoke`): a coordinator killed entering round 2 leaves a
+    # durable round-1 checkpoint; a fresh coordinator resumed from it is
+    # bit-identical to an uninterrupted control
+    from tpusvm import faults
+
+    cc = CascadeConfig(n_shards=P, sv_capacity=128, topology="tree")
+    ctrl = pod_fit(str(dataset.path), CFG, cc)
+    ck = str(tmp_path / "ck.npz")
+    with pytest.raises(faults.SimulatedKill):
+        with faults.active(faults.FaultPlan(
+                [faults.FaultRule(point="pod.round", kind="kill",
+                                  at_hit=2)])):
+            pod_fit(str(dataset.path), CFG, cc, checkpoint_path=ck)
+    assert os.path.exists(ck)
+    res = pod_fit(str(dataset.path), CFG, cc, checkpoint_path=ck,
+                  resume=True)
+    assert set(res.sv_ids.tolist()) == set(ctrl.sv_ids.tolist())
+    assert np.asarray(res.sv_alpha).tobytes() == \
+        np.asarray(ctrl.sv_alpha).tobytes()
+    assert res.b == ctrl.b
+
+
+def test_pod_checkpoint_topology_mismatch_refused(dataset, tmp_path):
+    ck = str(tmp_path / "ck.npz")
+    pod_fit(str(dataset.path), CFG,
+            CascadeConfig(n_shards=P, sv_capacity=128, topology="tree"),
+            checkpoint_path=ck)
+    with pytest.raises(ValueError, match="topology"):
+        pod_fit(str(dataset.path), CFG,
+                CascadeConfig(n_shards=P, sv_capacity=128,
+                              topology="star"),
+                checkpoint_path=ck, resume=True)

@@ -342,6 +342,37 @@ def _cascade_ckpt_exec(workdir: str, seed: int, resume: bool) -> str:
     })
 
 
+def _pod_round_exec(workdir: str, seed: int, resume: bool) -> str:
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tpusvm.parallel.svbuffer import SVBuffer
+    from tpusvm.pod.state import load_pod_round_state, save_pod_round_state
+
+    path = os.path.join(workdir, "pod_round.npz")
+    if resume and os.path.exists(path):
+        load_pod_round_state(path)  # version gate + shapes parse whole
+    rng = np.random.default_rng(4100 + seed)
+    cap, dim = 16, 4
+    for rnd in (1, 2):
+        buf = SVBuffer(
+            X=jnp.asarray(rng.normal(size=(cap, dim)), jnp.float32),
+            Y=jnp.asarray(np.where(rng.random(cap) < 0.5, 1, -1)),
+            alpha=jnp.asarray(rng.random(cap), jnp.float32),
+            ids=jnp.arange(cap, dtype=jnp.int32),
+            valid=jnp.asarray(rng.random(cap) < 0.75),
+        )
+        save_pod_round_state(path, buf, prev_ids={1, 2, 3}, rnd=rnd,
+                             b=0.5 * rnd, n_leaves=4, topology="tree")
+    sv, prev_ids, next_round, b = load_pod_round_state(path)
+    return _digest({
+        "sv": [_arr(np.asarray(x)) for x in sv],
+        "prev_ids": sorted(prev_ids),
+        "next_round": int(next_round),
+        "b": float(b),
+    })
+
+
 SCENARIOS: Dict[str, Scenario] = {
     s.name: s for s in (
         Scenario(
@@ -397,6 +428,14 @@ SCENARIOS: Dict[str, Scenario] = {
                 "pass on any survivor and the recovered pair matches "
                 "the control digests",
             execute=_tenant_store_exec,
+        ),
+        Scenario(
+            name="pod_round",
+            points=frozenset({"pod.merge"}),
+            doc="pod coordinator round checkpoint killed mid-commit; "
+                "survivor loads whole (a torn write leaves the previous "
+                "round) and a resumed coordinator matches the control",
+            execute=_pod_round_exec,
         ),
         Scenario(
             name="cascade_ckpt",

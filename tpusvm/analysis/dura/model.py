@@ -49,6 +49,7 @@ DURABLE_MODULES: Dict[str, bool] = {
     "tpusvm/solver/checkpoint.py": True,
     "tpusvm/autopilot/state.py": True,
     "tpusvm/tenants/store.py": True,
+    "tpusvm/pod/state.py": True,
     "tpusvm/models/serialization.py": False,
     "tpusvm/serve/cache.py": False,
     "tpusvm/serve/refresh.py": False,

@@ -138,9 +138,12 @@ def _build_parser() -> argparse.ArgumentParser:
 
     mode = tr.add_argument_group("training mode")
     mode.add_argument(
-        "--mode", choices=["single", "cascade", "oracle"], default="single",
+        "--mode", choices=["single", "cascade", "pod", "oracle"],
+        default="single",
         help="single = on-device SMO (GPU-build capability); cascade = "
         "distributed cascade over the device mesh (MPI capability); "
+        "pod = out-of-core cascade over worker PROCESSES (tpusvm.pod: "
+        "each leaf streams only its manifest shards; requires --data); "
         "oracle = serial NumPy SMO (main3.cpp capability)",
     )
     mode.add_argument(
@@ -173,7 +176,9 @@ def _build_parser() -> argparse.ArgumentParser:
                       help="cascade merge topology (tree = mpi_svm_main3, "
                       "star = mpi_svm_main2)")
     mode.add_argument("--shards", type=int, default=None,
-                      help="cascade shard count P (default: all local devices)")
+                      help="cascade shard count P (default: all local "
+                      "devices; --mode pod: worker process count, "
+                      "default 4)")
     mode.add_argument("--stratify", action="store_true",
                       help="cascade: per-class round-robin sharding instead "
                       "of the reference's contiguous scatter (safe on "
@@ -182,7 +187,7 @@ def _build_parser() -> argparse.ArgumentParser:
     mode.add_argument("--sv-capacity", type=int, default=4096,
                       help="padded SV buffer capacity per shard")
     mode.add_argument("--checkpoint", metavar="NPZ",
-                      help="crash-safe training: cascade mode writes "
+                      help="crash-safe training: cascade/pod mode writes "
                       "per-round state here; single mode (blocked "
                       "solver) writes the solver's outer-loop carry "
                       "every --checkpoint-every rounds (atomic, "
@@ -906,6 +911,43 @@ def _build_parser() -> argparse.ArgumentParser:
                       "engaged, and the winner model beats chance")
     out2.add_argument("-q", "--quiet", action="store_true")
 
+    po = sub.add_parser(
+        "pod", parents=[common],
+        help="self-contained pod-cascade run (tpusvm.pod): coordinator "
+        "+ worker processes train out-of-core from a sharded dataset, "
+        "each leaf streaming only its manifest shards; gates SV-set/b "
+        "parity against the in-memory cascade on the same rows")
+    po.add_argument("--data", metavar="DIR", default=None,
+                    help="existing sharded dataset dir to train on "
+                    "(default: ingest a synthetic rings set into a "
+                    "temp dir, which enables the in-memory parity gate)")
+    po.add_argument("--workers", type=int, default=4, metavar="P",
+                    help="worker process count = cascade leaf count "
+                    "(default 4)")
+    po.add_argument("--topology", choices=["tree", "star", "both"],
+                    default="both",
+                    help="merge topology to run (default both: the "
+                    "tree and star rounds share leaf results only "
+                    "through the wire protocol, so running both is the "
+                    "transport-parity check)")
+    po.add_argument("--n", type=int, default=192,
+                    help="synthetic row count (ignored with --data)")
+    po.add_argument("--rows-per-shard", type=int, default=24,
+                    help="synthetic ingest shard size (ignored with "
+                    "--data)")
+    po.add_argument("--sv-capacity", type=int, default=128,
+                    help="padded SV buffer capacity per leaf")
+    po.add_argument("--C", type=float, default=10.0)
+    po.add_argument("--gamma", type=float, default=10.0)
+    po.add_argument("--max-rounds", type=int, default=12)
+    po.add_argument("--smoke", action="store_true",
+                    help="CI gate: non-zero exit unless every topology "
+                    "converges, matches the in-memory cascade's SV-ID "
+                    "set / alpha bytes / b exactly, conserves every row "
+                    "across the workers, and keeps per-worker shard "
+                    "residency within the prefetch bound")
+    po.add_argument("-q", "--quiet", action="store_true")
+
     inf = sub.add_parser("info", parents=[common],
                          help="print device / backend information, or "
                          "describe a model / tune-results artifact")
@@ -1298,21 +1340,23 @@ def _cmd_train(args) -> int:
                 raise SystemExit("--shrink-every needs the blocked "
                                  "solver (working-set rounds are what "
                                  "gets compacted)")
-            if args.mode != "single":
+            if args.mode not in ("single", "pod"):
                 raise SystemExit(
-                    "--shrink-every needs --mode single: the shrinking "
-                    "driver segments the solve host-side, which the "
-                    "cascade's shard_map leaves cannot do"
+                    "--shrink-every needs --mode single or --mode pod: "
+                    "the shrinking driver segments the solve host-side, "
+                    "which the cascade's shard_map leaves cannot do (pod "
+                    "leaves are host processes, so they can)"
                 )
-            if args.checkpoint:
+            if args.checkpoint and args.mode == "single":
                 raise SystemExit(
                     "--shrink-every and --checkpoint both segment the "
-                    "outer loop and cannot be combined yet; crash-safe "
-                    "shrinking is a future PR"
+                    "outer loop and cannot be combined yet (--mode pod "
+                    "checkpoints per ROUND, which composes); crash-safe "
+                    "single-mode shrinking is a future PR"
                 )
             if args.multiclass:
                 raise SystemExit("--shrink-every supports binary/svr "
-                                 "--mode single training for now")
+                                 "--mode single/pod training for now")
     if args.kernel in ("rff", "nystrom"):
         if args.mode == "oracle":
             raise SystemExit(
@@ -1321,13 +1365,13 @@ def _cmd_train(args) -> int:
                 "gated against (benchmarks/fuzz_parity.py mode rff); "
                 "train --kernel rbf --mode oracle instead"
             )
-        if args.mode == "cascade" and args.data:
+        if args.mode in ("cascade", "pod") and args.data:
             raise SystemExit(
-                "--mode cascade --data with an approximate kernel is "
-                "not supported yet (leaf partitions carry RAW rows; the "
-                "mapped width would change every buffer shape): drop "
-                "--mode cascade for the streaming primal path, or load "
-                "the data in-memory for a mapped cascade"
+                f"--mode {args.mode} --data with an approximate kernel "
+                "is not supported yet (leaf partitions carry RAW rows; "
+                "the mapped width would change every buffer shape): "
+                f"drop --mode {args.mode} for the streaming primal "
+                "path, or load the data in-memory for a mapped cascade"
             )
         if args.data and args.convergence:
             raise SystemExit(
@@ -1385,9 +1429,20 @@ def _cmd_train(args) -> int:
                 )
         if args.checkpoint_every < 1:
             raise SystemExit("--checkpoint-every must be >= 1")
-    if args.stratify and args.mode != "cascade":
-        raise SystemExit("--stratify only applies to --mode cascade (it "
-                         "changes how shards are dealt over the mesh)")
+    if args.stratify and args.mode not in ("cascade", "pod"):
+        raise SystemExit("--stratify only applies to --mode cascade/pod "
+                         "(it changes how rows are dealt over the leaves)")
+    if args.mode == "pod":
+        # pod leaves stream their manifest shards from disk — there is
+        # no in-memory source to hand them
+        if not args.data:
+            raise SystemExit(
+                "--mode pod trains out-of-core from a sharded dataset "
+                "dir: pass --data DIR (`tpusvm ingest` builds one)"
+            )
+        if (args.solver or "blocked") not in ("blocked", "pair"):
+            raise SystemExit("--mode pod leaves run the blocked or pair "
+                             "solver")
     if args.convergence:
         if args.convergence < 0:
             raise SystemExit("--convergence must be >= 0")
@@ -1482,7 +1537,22 @@ def _cmd_train(args) -> int:
                           solver=args.solver or "blocked",
                           solver_opts=solver_opts)
         with timer.phase("training"), trace(args.profile):
-            if args.mode == "cascade":
+            if args.mode == "pod":
+                # worker PROCESSES, not mesh devices: the default count
+                # is a small multiprocess pod, not the device count
+                shards = args.shards or 4
+                cc = CascadeConfig(n_shards=shards,
+                                   sv_capacity=args.sv_capacity,
+                                   topology=args.topology)
+                model.fit_pod(args.data, cc, verbose=not args.quiet,
+                              checkpoint_path=args.checkpoint,
+                              resume=args.resume,
+                              stratified=args.stratify,
+                              tracer=tracer)
+                log.info("pod: %d workers, %d rounds, converged = %s",
+                         shards, model.cascade_rounds_,
+                         model.status_.name == "CONVERGED")
+            elif args.mode == "cascade":
                 shards = args.shards or len(jax.devices())
                 cc = CascadeConfig(n_shards=shards,
                                    sv_capacity=args.sv_capacity,
@@ -1656,6 +1726,98 @@ def _fit_oracle(X, Y, cfg, timer, log):
     model.n_iter_ = res.n_iter
     model.status_ = res.status
     return model
+
+
+def _cmd_pod(args) -> int:
+    """Self-contained pod-cascade run: out-of-core multiprocess training
+    with a bit-level parity gate against the in-memory cascade."""
+    import tempfile
+    import warnings
+
+    from tpusvm.config import (
+        CascadeConfig,
+        SVMConfig,
+        resolve_accum_dtype,
+    )
+    from tpusvm.pod import pod_fit
+    from tpusvm.stream import open_dataset
+
+    topologies = (["tree", "star"] if args.topology == "both"
+                  else [args.topology])
+    cfg = SVMConfig(C=args.C, gamma=args.gamma, max_rounds=args.max_rounds)
+    with warnings.catch_warnings():
+        # the enabling-x64 advice warning; the pod command always runs
+        # the library's "auto" f64-accumulator resolution
+        warnings.simplefilter("ignore", UserWarning)
+        accum = resolve_accum_dtype("auto")
+    failures = []
+    summaries = []
+    with tempfile.TemporaryDirectory() as td:
+        if args.data:
+            data, X, Y = args.data, None, None
+        else:
+            import os as _os
+
+            from tpusvm.data.synthetic import rings
+            from tpusvm.stream import ingest_arrays
+
+            X, Y = rings(n=args.n, seed=3)
+            data = _os.path.join(td, "ds")
+            ingest_arrays(data, X, Y,
+                          rows_per_shard=args.rows_per_shard)
+        n_rows = open_dataset(data).n_rows
+        for topo in topologies:
+            cc = CascadeConfig(n_shards=args.workers,
+                               sv_capacity=args.sv_capacity,
+                               topology=topo)
+            res = pod_fit(data, cfg, cc, accum_dtype=accum,
+                          verbose=not args.quiet)
+            if not args.quiet:
+                print(f"pod[{topo}]: {res.rounds} rounds, "
+                      f"{len(res.sv_ids)} SVs, b = {res.b:.12f}, "
+                      f"rows {list(res.worker_rows)}, "
+                      f"live shards {list(res.worker_max_live_shards)}, "
+                      f"revives {res.revives}")
+            if not res.converged:
+                failures.append(f"[{topo}] pod did not converge in "
+                                f"{res.rounds} rounds")
+            if sum(res.worker_rows) != n_rows:
+                failures.append(
+                    f"[{topo}] rows lost: workers hold "
+                    f"{sum(res.worker_rows)} of {n_rows}")
+            summaries.append((topo, res.rounds, len(res.sv_ids)))
+            if X is None:
+                continue
+            # parity gate: the in-memory cascade on the identically
+            # scaled rows must be BIT-identical — same SV-ID set, same
+            # alpha bytes, same b (the pod moves leaf results over the
+            # wire protocol; any serialization loss shows up here)
+            from tpusvm.data import MinMaxScaler
+            from tpusvm.parallel.cascade import cascade_fit
+
+            ctrl = cascade_fit(MinMaxScaler().fit_transform(X), Y,
+                               cfg, cc, accum_dtype=accum)
+            if set(res.sv_ids.tolist()) != set(
+                    np.asarray(ctrl.sv_ids).tolist()):
+                failures.append(f"[{topo}] SV-ID set diverges from the "
+                                "in-memory cascade")
+            elif np.asarray(res.sv_alpha).tobytes() != np.asarray(
+                    ctrl.sv_alpha).tobytes():
+                failures.append(f"[{topo}] alpha bytes diverge from "
+                                "the in-memory cascade")
+            if res.b != ctrl.b:
+                failures.append(f"[{topo}] b diverges: pod {res.b!r} "
+                                f"vs in-memory {ctrl.b!r}")
+    if failures:
+        for f in failures:
+            print(f"POD{' SMOKE' if args.smoke else ''} FAILED: {f}")
+        return 1
+    parity = "bit-identical to in-memory cascade" if X is not None \
+        else "parity gate skipped (--data)"
+    print("pod ok: " + "; ".join(
+        f"{t} {r} rounds/{s} SVs" for t, r, s in summaries)
+        + f", {args.workers} workers, {parity}")
+    return 0
 
 
 def _cmd_ingest(args) -> int:
@@ -3151,6 +3313,14 @@ def _info_artifact(path: str) -> int:
                   f"(stable {int(state['shrink_stable'])})"
                   if se else "off")
         print(f"trained: precision={prec} shrinking={shrink}")
+        if "cascade_topology" in state:
+            # distributed-training provenance (v4-additive keys):
+            # cascade/pod-trained artifacts record which merge topology
+            # and leaf count produced them, and how many rounds the
+            # SV-ID fixed point took
+            print(f"cascade: topology={str(state['cascade_topology'])} "
+                  f"leaves={int(state['cascade_leaves'])} "
+                  f"rounds={int(state['cascade_rounds'])}")
     if task == "svc":
         if "platt_a" in state:
             print(f"calibrated: yes (Platt A={float(state['platt_a']):.6f} "
@@ -3389,7 +3559,7 @@ def main(argv=None) -> int:
         if args.process_id is not None:
             kw["process_id"] = args.process_id
         jax.distributed.initialize(**kw)
-    return {"train": _cmd_train, "ingest": _cmd_ingest,
+    return {"train": _cmd_train, "pod": _cmd_pod, "ingest": _cmd_ingest,
             "predict": _cmd_predict, "serve": _cmd_serve,
             "refresh": _cmd_refresh, "autopilot": _cmd_autopilot,
             "tenants": _cmd_tenants, "router": _cmd_router,

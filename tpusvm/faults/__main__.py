@@ -59,6 +59,21 @@ Commands:
                       degraded, and the post-recovery refreshed model
                       is BIT-IDENTICAL (alpha bytes / SV ids / b) to
                       the uninterrupted control run's.
+  pod-chaos-smoke     The pod-cascade CI gate (tpusvm.pod): an
+                      out-of-core multiprocess cascade trains from a
+                      sharded dataset three ways — an uninterrupted
+                      control, a run whose worker 1 REALLY SIGKILLs
+                      itself mid-round (revived by the coordinator, the
+                      round re-run from coordinator-held state), and a
+                      run whose COORDINATOR is killed entering round 2
+                      then resumed from its fsync'd per-round
+                      checkpoint. Asserts: both recovery arms are
+                      BIT-IDENTICAL to the control (SV-ID set, alpha
+                      bytes, b), the worker kill actually fired (>= 1
+                      revive) and the coordinator kill left a durable
+                      checkpoint behind, no stale pre-kill reply leaks
+                      into the re-run round, and every dataset row is
+                      accounted for across the workers in every arm.
   tenant-chaos-smoke  The multi-tenant platform CI gate: 64 tenants
                       (one shared corpus, per-tenant label/row-subset
                       views) provisioned in ONE cold fleet launch and
@@ -175,6 +190,102 @@ def _kill_resume_smoke() -> int:
     print(f"kill-resume smoke ok: {n_ckpts} kill points, "
           f"{int(plain.n_outer)} outer rounds, {len(ref_sv)} SVs — every "
           "resumed solve bit-identical to the uninterrupted run")
+    return 0
+
+
+def _pod_chaos_smoke() -> int:
+    import json
+    import os
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+
+    from tpusvm import faults
+    from tpusvm.config import CascadeConfig, SVMConfig
+    from tpusvm.data.synthetic import rings
+    from tpusvm.pod import pod_fit
+    from tpusvm.stream.format import ingest_arrays
+
+    import warnings
+
+    warnings.filterwarnings("ignore", category=UserWarning)
+
+    X, Y = rings(n=192, seed=3)
+    cfg = SVMConfig(C=10.0, gamma=10.0, max_rounds=12)
+    cc = CascadeConfig(n_shards=4, sv_capacity=128, topology="tree")
+    failures = []
+    with tempfile.TemporaryDirectory() as td:
+        ds = os.path.join(td, "ds")
+        ingest_arrays(ds, X, Y, rows_per_shard=24)
+
+        ctrl = pod_fit(ds, cfg, cc)
+        ctrl_ids = set(np.asarray(ctrl.sv_ids).tolist())
+        ctrl_alpha = np.asarray(ctrl.sv_alpha).tobytes()
+        if not ctrl.converged:
+            print("POD CHAOS SMOKE FAILED: control run did not converge")
+            return 1
+
+        def check(arm, res):
+            if set(np.asarray(res.sv_ids).tolist()) != ctrl_ids:
+                failures.append(f"{arm}: SV-ID set diverges from control")
+            elif np.asarray(res.sv_alpha).tobytes() != ctrl_alpha:
+                failures.append(f"{arm}: alpha bytes diverge from control")
+            if res.b != ctrl.b:
+                failures.append(f"{arm}: b diverges "
+                                f"({res.b!r} vs {ctrl.b!r})")
+            if sum(res.worker_rows) != len(Y):
+                failures.append(f"{arm}: rows lost — workers hold "
+                                f"{sum(res.worker_rows)} of {len(Y)}")
+
+        # arm 1: worker 1 REALLY SIGKILLs itself on its 2nd request
+        # (mid round 2, after its round-1 result already merged); the
+        # coordinator revives it and re-runs the round from its own
+        # held state — any stale pre-kill reply a surviving worker
+        # queued must be discarded, or alpha bytes diverge here
+        plan = os.path.join(td, "plan.json")
+        tmp = plan + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({"format_version": 1, "seed": 0, "rules": [
+                {"point": "pod.worker", "kind": "kill", "at_hit": 2}]}, f)
+        os.replace(tmp, plan)
+        r1 = pod_fit(ds, cfg, cc, worker_faults={1: plan})
+        if r1.revives < 1:
+            failures.append("worker-kill arm: the kill never fired "
+                            "(zero revives)")
+        check("worker-kill arm", r1)
+
+        # arm 2: the COORDINATOR dies entering round 2, leaving the
+        # round-1 checkpoint (fsync_replace'd) behind; a fresh
+        # coordinator resumes from it — workers reload their leaves
+        # from the manifest and the merged trajectory replays
+        ck = os.path.join(td, "ck.npz")
+        killed = False
+        try:
+            with faults.active(faults.FaultPlan(
+                    [faults.FaultRule(point="pod.round", kind="kill",
+                                      at_hit=2)])):
+                pod_fit(ds, cfg, cc, checkpoint_path=ck)
+        except faults.SimulatedKill:
+            killed = True
+        if not killed:
+            failures.append("coordinator-kill arm: the kill never fired")
+        elif not os.path.exists(ck):
+            failures.append("coordinator-kill arm: no durable checkpoint "
+                            "at the kill")
+        else:
+            r2 = pod_fit(ds, cfg, cc, checkpoint_path=ck, resume=True)
+            check("coordinator-resume arm", r2)
+
+    if failures:
+        for f in failures:
+            print(f"POD CHAOS SMOKE FAILED: {f}")
+        return 1
+    print(f"pod chaos smoke ok: {ctrl.rounds} rounds, "
+          f"{len(ctrl_ids)} SVs, worker SIGKILL revived "
+          f"({r1.revives} revive) and coordinator kill resumed — both "
+          "bit-identical to the uninterrupted control, zero rows lost")
     return 0
 
 
@@ -1223,6 +1334,8 @@ def main(argv=None) -> int:
         return _autopilot_chaos_smoke()
     if cmd == "tenant-chaos-smoke":
         return _tenant_chaos_smoke()
+    if cmd == "pod-chaos-smoke":
+        return _pod_chaos_smoke()
     if cmd == "validate":
         if len(rest) != 1:
             print("usage: python -m tpusvm.faults validate PLAN.json")

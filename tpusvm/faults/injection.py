@@ -72,6 +72,20 @@ rejected at load time):
                               rules here die mid-fleet-refresh with the
                               previous durable state intact
                               (tenants/store.py)
+  ``pod.round``               the pod coordinator's per-round entry —
+                              kills here die between rounds, and resume
+                              must reproduce the uninterrupted cascade
+                              (pod/coordinator.py)
+  ``pod.merge``               the pod coordinator's durable round-state
+                              commit (fsync_replace) — kills here leave
+                              the previous complete checkpoint or none
+                              (pod/state.py)
+  ``pod.worker``              a pod worker's per-request entry — kill
+                              rules here die mid-round on the WORKER
+                              side (the worker escalates SimulatedKill
+                              to a real SIGKILL on itself), and the
+                              coordinator must revive it and finish the
+                              round bit-identically (pod/worker.py)
 
 Kill semantics: :class:`SimulatedKill` subclasses ``BaseException`` (like
 ``KeyboardInterrupt``), so no ``except Exception`` recovery path — not
@@ -115,6 +129,9 @@ POINTS = frozenset({
     "router.forward",
     "tenants.tick",
     "tenants.store",
+    "pod.round",
+    "pod.merge",
+    "pod.worker",
 })
 
 KINDS = ("transient", "latency", "corrupt", "kill")

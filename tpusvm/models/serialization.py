@@ -27,6 +27,13 @@ Format history:
       regenerates bit-identically from (d, rff_dim, gamma, map_seed),
       so a saved rff model predicts without retraining OR storing the
       map). Exact-family states are unchanged byte-for-byte.
+      v4-ADDITIVE (no version bump — readers gate on key presence, so
+      pre-existing v4 files load bit-identically with the keys absent):
+      cascade/pod-trained artifacts carry the distributed-training
+      provenance `cascade_topology` ("tree" | "star"),
+      `cascade_leaves` (worker/leaf count) and `cascade_rounds`
+      (rounds to SV-ID stabilization); `tpusvm info` prints them.
+      Scoring never reads them.
 
 Compatibility contract: v1/v2/v3 files LOAD — configs predating the
 kernel fields default to the implicit RBF family, configs predating the

@@ -108,6 +108,17 @@ class BinarySVC:
         # streamed approx fits record the reader residency high-water
         # mark (the prefetch_depth + 1 bound the tests audit)
         self.stream_max_live_shards_: Optional[int] = None
+        # cascade/pod training provenance (v4-additive serialization
+        # keys): merge topology, leaf count and rounds-to-stabilize of a
+        # cascade- or pod-trained artifact — `tpusvm info` prints them;
+        # None/0 for single-solver fits and older files
+        self.cascade_topology_: Optional[str] = None
+        self.cascade_leaves_: Optional[int] = None
+        self.cascade_rounds_: int = 0
+        self.cascade_history_: Optional[list] = None
+        # pod fits keep the per-worker leaf row counts so callers can
+        # audit that the partition conserved every ingested row
+        self.pod_worker_rows_: Optional[tuple] = None
 
     # ------------------------------------------------------------------ fit
     def _scale_fit(self, X: np.ndarray) -> np.ndarray:
@@ -424,7 +435,7 @@ class BinarySVC:
             solver=self.solver, solver_opts=self.solver_opts,
             stratified=stratified, tracer=tracer,
         )
-        return self._finish_cascade(res, t0)
+        return self._finish_cascade(res, t0, cascade_config)
 
     def fit_cascade_stream(
         self,
@@ -474,9 +485,72 @@ class BinarySVC:
             solver=self.solver, solver_opts=self.solver_opts,
             partition=part, tracer=tracer,
         )
-        return self._finish_cascade(res, t0)
+        return self._finish_cascade(res, t0, cascade_config)
 
-    def _finish_cascade(self, res, t0: float) -> "BinarySVC":
+    def fit_pod(
+        self,
+        data: str,
+        cascade_config: CascadeConfig = CascadeConfig(),
+        verbose: bool = False,
+        checkpoint_path: Optional[str] = None,
+        resume: bool = False,
+        stratified: bool = False,
+        prefetch_depth: int = 2,
+        tracer=None,
+    ) -> "BinarySVC":
+        """Pod (multi-process) cascade training from a sharded dataset.
+
+        Each cascade leaf runs in its OWN worker process that streams
+        only its manifest shards (tpusvm.pod) — the out-of-core,
+        shard_map-free sibling of fit_cascade_stream, with the same
+        SV-ID fixed point and the same manifest-fitted scaler. Because
+        leaves are host processes, the full solver ladder applies:
+        shrink_every and friends in solver_opts run the shrinking
+        driver per leaf (solver="blocked"), which the shard_map cascade
+        rejects.
+
+        checkpoint_path/resume: crash-safe per-round coordinator
+        checkpoints (pod/state.py, fsync_replace); a killed coordinator
+        resumes bit-identically, a killed worker is revived mid-round.
+        """
+        if _kernels.is_approx(self.config.kernel):
+            raise ValueError(
+                "fit_pod does not support the approximate families yet "
+                f"(kernel={self.config.kernel!r}): leaf partitions are "
+                "filled with RAW rows and the mapped width would change "
+                "every buffer shape; use fit_stream (the streaming "
+                "primal path) or in-memory fit_cascade over mapped "
+                "features"
+            )
+        t0 = time.perf_counter()
+        from tpusvm.pod import pod_fit
+        from tpusvm.stream.format import open_dataset
+
+        if self.scale:
+            self.scaler_ = open_dataset(data).scaler()
+        res = pod_fit(
+            data, self.config, cascade_config, dtype=self.dtype,
+            accum_dtype=self.accum_dtype, verbose=verbose,
+            checkpoint_path=checkpoint_path, resume=resume,
+            solver=self.solver, solver_opts=self.solver_opts,
+            stratified=stratified, prefetch_depth=prefetch_depth,
+            scale=self.scale, tracer=tracer,
+        )
+        self.stream_max_live_shards_ = int(
+            max(res.worker_max_live_shards))
+        self.pod_worker_rows_ = tuple(int(r) for r in res.worker_rows)
+        # ladder provenance, as fit() records it: pod leaves run the
+        # shrinking driver and precision rungs for real
+        self.train_precision_ = (
+            self.solver_opts.get("matmul_precision") or "f32")
+        self.shrink_every_ = int(
+            self.solver_opts.get("shrink_every", 0) or 0)
+        self.shrink_stable_ = int(self.solver_opts.get(
+            "shrink_stable", 3 if self.shrink_every_ else 0))
+        return self._finish_cascade(res, t0, cascade_config)
+
+    def _finish_cascade(self, res, t0: float,
+                        cascade_config: CascadeConfig) -> "BinarySVC":
         self.train_time_s_ = time.perf_counter() - t0
         self.sv_X_ = res.sv_X
         self.sv_Y_ = res.sv_Y
@@ -489,6 +563,8 @@ class BinarySVC:
         )
         self.cascade_history_ = res.history
         self.cascade_rounds_ = res.rounds
+        self.cascade_topology_ = cascade_config.topology
+        self.cascade_leaves_ = int(cascade_config.n_shards)
         return self
 
     # -------------------------------------------------------------- predict
@@ -633,6 +709,13 @@ class BinarySVC:
         state["train_precision"] = self.train_precision_
         state["shrink_every"] = self.shrink_every_
         state["shrink_stable"] = self.shrink_stable_
+        # cascade/pod provenance (format v4, additive): topology, leaf
+        # count, rounds-to-stabilize — absent for single-solver fits
+        # and in older files, which load bit-identically without them
+        if self.cascade_topology_ is not None:
+            state["cascade_topology"] = self.cascade_topology_
+            state["cascade_leaves"] = int(self.cascade_leaves_ or 0)
+            state["cascade_rounds"] = int(self.cascade_rounds_)
         # approximate-map provenance (format v4): the raw input width
         # for both families, landmark rows + inverse-root weights for
         # nystrom; rff's omega regenerates from the config alone
@@ -663,6 +746,12 @@ class BinarySVC:
         if "shrink_every" in state:
             model.shrink_every_ = int(state["shrink_every"])
             model.shrink_stable_ = int(state["shrink_stable"])
+        # cascade/pod provenance is optional at every version: absent
+        # keys leave the single-solver defaults (None/0)
+        if "cascade_topology" in state:
+            model.cascade_topology_ = str(state["cascade_topology"])
+            model.cascade_leaves_ = int(state["cascade_leaves"])
+            model.cascade_rounds_ = int(state["cascade_rounds"])
         if _kernels.is_approx(config.kernel):
             # v4: rebuild the fitted map (rff regenerates omega from the
             # config; nystrom reads its stored landmark/weight arrays) —

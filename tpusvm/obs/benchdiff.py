@@ -217,6 +217,26 @@ SCHEMA_RULES: Dict[str, Tuple[Rule, ...]] = {
         Rule("refresh_s", "<=", rel_tol=0.4, timing=True),
         Rule("tenants_per_s", ">=", rel_tol=0.25, timing=True),
     ),
+    # out-of-core pod cascade (benchmarks/pod_cascade.py): the pod arm
+    # must stay bit-identical to the in-memory cascade (sv_parity folds
+    # the alpha-byte check in; b_parity is bitwise), conserve leaf rows
+    # and keep reader residency within the prefetch bound — all exact.
+    # Worker-process overhead (pod_overhead_x, train_s) is the price of
+    # the capability and is direction-gated at full level only so the
+    # committed smoke baseline stays machine-portable.
+    "pod_cascade": (
+        Rule("sv_parity", "=="),
+        Rule("b_parity", "=="),
+        Rule("rows_ok", "=="),
+        Rule("converged", "=="),
+        Rule("accuracy", "=="),
+        Rule("sv_count", "=="),
+        Rule("rounds", "=="),
+        Rule("max_live_shards", "<="),
+        Rule("train_s", "<=", rel_tol=0.5, timing=True),
+        Rule("rows_per_s", ">=", rel_tol=0.35, timing=True),
+        Rule("pod_overhead_x", "<=", rel_tol=0.5, timing=True),
+    ),
 }
 
 

@@ -34,6 +34,14 @@ instead of chewing on garbage — SPMD lockstep without wasted wall-clock.
 Everything is SPMD with static shapes; per-rank SV sets are capacity-padded
 SVBuffers (tpusvm.parallel.svbuffer). Dedup-by-ID and the warm-start alpha
 rules match the reference exactly (see merge_dedup docstring).
+
+Host fallback (no shard_map): on a jax build without `jax.shard_map`
+the same rounds run as a plain Python loop over ranks
+(_tree_round_host/_star_round_host) — identical merges, identical
+solves, identical diagnostics shapes — so the cascade trains on stock
+CPU jax and the pod tier (tpusvm.pod) has an in-process control to be
+bit-compared against. Idle tree ranks are skipped outright (the SPMD
+path masks their outputs away, so skipping them is value-identical).
 """
 
 from __future__ import annotations
@@ -380,10 +388,128 @@ def _star_round_device(
     return _replicate_outputs(new_global, res2.b, diag)
 
 
+def _leaf_buf(part_bufs: SVBuffer, r: int) -> SVBuffer:
+    """Rank r's slice of the stacked (n_shards, ...) partition buffers."""
+    return SVBuffer(*(x[r] for x in part_bufs))
+
+
+def star_merge(svs, merged_cap: int):
+    """The star's layer-2 union: rank 0's buffer is primary (alpha kept),
+    ranks 1..P-1 are concatenated — FULL padded buffers, in rank order —
+    as secondary (alpha zeroed). The concatenation keeps padding rows in
+    place because dedup_first's (id, position) sort makes positions
+    semantic: this is byte-for-byte the flattened all_gather[1:] the
+    device round feeds merge_dedup, and the pod coordinator reuses it so
+    both engines walk the same merge.
+
+    Returns (merged buffer of capacity merged_cap, pre-truncation count).
+    """
+    primary = svs[0]
+    if len(svs) > 1:
+        secondary = SVBuffer(*(
+            jnp.concatenate([getattr(s, f) for s in svs[1:]])
+            for f in SVBuffer._fields
+        ))
+    else:
+        secondary = empty(0, primary.X.shape[1], primary.X.dtype)
+    return merge_dedup(primary, secondary, merged_cap)
+
+
+def _tree_round_host(
+    part_bufs, global_sv, *, n_shards, train_cap, sv_cap, cfg, accum_dtype,
+    solver, solver_opts,
+):
+    """One classical-cascade round as a host loop over ranks.
+
+    Value-identical to _tree_round_device: same merges, same solves (the
+    per-leaf jit executable is shared across ranks — identical shapes),
+    same diag layout ((n_shards, n_steps), idle entries 0 / status -1).
+    Idle ranks are skipped — the SPMD path invalidates their buffers and
+    masks their outputs, so nothing they compute is ever read."""
+    n_steps = n_shards.bit_length()
+    own = {r: _leaf_buf(part_bufs, r) for r in range(n_shards)}
+    recv = {r: global_sv for r in range(n_shards)}
+    mc = np.zeros((n_shards, n_steps), np.int64)
+    sc = np.zeros((n_shards, n_steps), np.int64)
+    it = np.zeros((n_shards, n_steps), np.int64)
+    st = np.full((n_shards, n_steps), -1, np.int64)
+    b = None
+    step, si = 1, 0
+    while step <= n_shards:
+        for r in range(0, n_shards, step):  # active ranks: r % step == 0
+            train, mcount = merge_dedup(recv[r], own[r], train_cap)
+            res = _solve(train, cfg, accum_dtype, solver, solver_opts)
+            own[r], svcount = extract_svs(train, res.alpha, cfg.sv_tol,
+                                          sv_cap)
+            mc[r, si] = int(mcount)
+            sc[r, si] = int(svcount)
+            it[r, si] = int(res.n_iter)
+            st[r, si] = int(res.status)
+            if r == 0:
+                b = res.b
+        if step < n_shards:
+            for r in range(step, n_shards, 2 * step):  # senders
+                recv[r - step] = own[r]
+        step *= 2
+        si += 1
+    diag = {"merged_count": mc, "sv_count": sc, "iters": it, "status": st}
+    return own[0], b, diag
+
+
+def _star_round_host(
+    part_bufs, global_sv, *, n_shards, train_cap, merged_cap, sv_cap, cfg,
+    accum_dtype, solver, solver_opts,
+):
+    """One modified-cascade round as a host loop over ranks.
+
+    Value-identical to _star_round_device; diag layout (n_shards, 2) with
+    the layer-2 merged solve's numbers replicated down column 1, exactly
+    as the all_gather of the replicated solve produces them."""
+    svs, layer1 = [], []
+    for r in range(n_shards):
+        train, mcount = merge_dedup(global_sv, _leaf_buf(part_bufs, r),
+                                    train_cap)
+        res = _solve(train, cfg, accum_dtype, solver, solver_opts)
+        sv, svcount = extract_svs(train, res.alpha, cfg.sv_tol, sv_cap)
+        svs.append(sv)
+        layer1.append((int(mcount), int(svcount), int(res.n_iter),
+                       int(res.status)))
+    merged, merged_count = star_merge(svs, merged_cap)
+    res2 = _solve(merged, cfg, accum_dtype, solver, solver_opts)
+    new_global, gcount = extract_svs(merged, res2.alpha, cfg.sv_tol, sv_cap)
+    diag = {
+        "merged_count": np.array(
+            [[m, int(merged_count)] for m, _, _, _ in layer1], np.int64),
+        "sv_count": np.array(
+            [[s, int(gcount)] for _, s, _, _ in layer1], np.int64),
+        "iters": np.array(
+            [[i, int(res2.n_iter)] for _, _, i, _ in layer1], np.int64),
+        "status": np.array(
+            [[s, int(res2.status)] for _, _, _, s in layer1], np.int64),
+    }
+    return new_global, res2.b, diag
+
+
 def _build_round_fn(
     mesh, topology, n_shards, train_cap, merged_cap, sv_cap, cfg, accum_dtype,
     solver, solver_opts,
 ):
+    common = dict(
+        n_shards=n_shards,
+        train_cap=train_cap,
+        sv_cap=sv_cap,
+        cfg=cfg,
+        accum_dtype=accum_dtype,
+        solver=solver,
+        solver_opts=solver_opts,
+    )
+    if mesh is None:
+        # host fallback: no shard_map on this jax build — the same round
+        # as a Python loop over ranks (see module docstring)
+        if topology == "tree":
+            return functools.partial(_tree_round_host, **common)
+        return functools.partial(_star_round_host, merged_cap=merged_cap,
+                                 **common)
     if topology == "tree":
         device_fn = functools.partial(
             _tree_round_device,
@@ -516,7 +642,10 @@ def cascade_fit(
     accum_dtype = resolve_accum_dtype(accum_dtype)
     cc = cascade_config
     n_shards = cc.n_shards
-    if mesh is None:
+    if mesh is None and hasattr(jax, "shard_map"):
+        # mesh=None on a shard_map-less jax build selects the host-loop
+        # round functions instead of raising from make_mesh/shard_map —
+        # same merges and solves, rank loop on the host (module docstring)
         mesh = make_mesh(n_shards)
     sv_cap = cc.sv_capacity
 
@@ -629,10 +758,15 @@ def cascade_fit(
                 # the round executable is the cascade's one jit entry:
                 # profiled_call records its (one-off) lower/compile cost
                 # and FLOPs when the compile observatory is on, and is
-                # the plain call otherwise
-                out_global, b_all, diag = prof.profiled_call(
-                    "cascade.round_fn", round_fn, part_bufs, global_sv
-                )
+                # the plain call otherwise. The host fallback has no
+                # single jit entry — its per-leaf solves are themselves
+                # profiled jit points — so it is called directly.
+                if mesh is None:
+                    out_global, b_all, diag = round_fn(part_bufs, global_sv)
+                else:
+                    out_global, b_all, diag = prof.profiled_call(
+                        "cascade.round_fn", round_fn, part_bufs, global_sv
+                    )
                 diag = {k: np.asarray(v) for k, v in diag.items()}
                 if (
                     cc.topology == "star"

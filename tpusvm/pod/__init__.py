@@ -1,0 +1,30 @@
+"""Pod-scale out-of-core cascade: coordinator + worker subprocesses.
+
+The reference's MPI cascade (PAPER.md L4) as a process-transport tier:
+a coordinator drives N worker subprocesses over stdlib sockets
+(length-prefixed framed messages, tpusvm.pod.protocol), each worker
+being one cascade LEAF that loads only its manifest shards via
+stream.ShardReader (prefetch pipelined against solver compute, never a
+full-array materialization) and trains with the single-chip solvers.
+SV sets merge through parallel.svbuffer.merge_dedup semantics
+bit-for-bit under both reference topologies (binary tree and star),
+iterating rounds until the global SV-ID set stabilizes — the same
+fixed point as parallel.cascade.cascade_fit, which stays the
+in-process parity control.
+
+Because leaves are host-driven processes (not shard_map bodies), they
+inherit the full solver ladder the shard_map cascade had to reject:
+the shrinking driver, the K-row cache, the bf16 rungs — anything
+blocked_smo_solve/shrinking_blocked_solve accepts.
+
+Crash safety: the coordinator checkpoints inter-round state through
+fsync_replace (pod/state.py, fault point ``pod.merge``), a killed
+worker is revived and the in-flight round re-runs from its round-start
+state bit-identically (``pod.worker``), and a killed coordinator
+resumes from the checkpoint (``pod.round``) — all exercised by
+``python -m tpusvm.faults pod-chaos-smoke``.
+"""
+
+from tpusvm.pod.coordinator import PodResult, pod_fit
+
+__all__ = ["PodResult", "pod_fit"]

@@ -1,0 +1,101 @@
+"""Durable pod round-state checkpoint (fault point ``pod.merge``).
+
+The cascade's inter-round state — the global SV buffer, the previous
+round's ID set, b — written with the full dura discipline: staged to a
+``.tmp`` sibling, committed by ``fsync_replace`` (flush THEN rename),
+so a kill at any instant leaves either the previous complete
+checkpoint or the new complete checkpoint, never a torn file. This is
+the one durability upgrade over parallel.cascade.save_round_state
+(plain os.replace): a pod run spans processes and is expected to be
+killed, so its checkpoint is registered kill-safe in the dura model
+(analysis/dura/model.py DURABLE_MODULES) and covered by the derived
+crash-window matrix's ``pod_round`` scenario.
+
+The stored config (n_leaves, topology) is checked on resume: a
+checkpoint written under a different partitioning or merge topology is
+refused with a config error instead of silently walking a different
+cascade.
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax.numpy as jnp
+import numpy as np
+
+from tpusvm import faults
+from tpusvm.parallel.svbuffer import SVBuffer
+from tpusvm.utils.durable import fsync_replace
+
+POD_CKPT_VERSION = 1
+
+
+def save_pod_round_state(path: str, global_sv: SVBuffer, prev_ids,
+                         rnd: int, b: float, n_leaves: int,
+                         topology: str) -> None:
+    """Atomically commit one round's inter-round state."""
+    faults.point("pod.merge", path=path, round=rnd)
+    tmp = path + ".tmp"
+    np.savez_compressed(
+        tmp,
+        ckpt_version=POD_CKPT_VERSION,
+        round=rnd,
+        b=b,
+        prev_ids=np.asarray(sorted(prev_ids), np.int32),
+        n_leaves=n_leaves,
+        topology=topology,
+        sv_X=np.asarray(global_sv.X),
+        sv_Y=np.asarray(global_sv.Y),
+        sv_alpha=np.asarray(global_sv.alpha),
+        sv_ids=np.asarray(global_sv.ids),
+        sv_valid=np.asarray(global_sv.valid),
+    )
+    # np.savez appends .npz to the temp name; flush-then-rename commit
+    fsync_replace(tmp + ".npz", path)
+
+
+def check_pod_round_state_config(path: str, n_leaves: int,
+                                 topology: str) -> None:
+    """Refuse a checkpoint written under a different pod config."""
+    with np.load(path, allow_pickle=False) as z:
+        if int(z["n_leaves"]) != n_leaves:
+            raise ValueError(
+                f"pod checkpoint config mismatch: it was written for "
+                f"n_leaves={int(z['n_leaves'])}, this run partitions "
+                f"into {n_leaves}; resume with the original leaf count "
+                "or start fresh without resume"
+            )
+        if str(z["topology"]) != topology:
+            raise ValueError(
+                f"pod checkpoint config mismatch: it was written for "
+                f"topology={str(z['topology'])!r}, this run uses "
+                f"{topology!r}; resume with the original topology or "
+                "start fresh without resume"
+            )
+
+
+def load_pod_round_state(path: str, dtype=jnp.float32):
+    """Returns (global_sv: SVBuffer, prev_ids: set, next_round: int, b)."""
+    with np.load(path, allow_pickle=False) as z:
+        if int(z["ckpt_version"]) != POD_CKPT_VERSION:
+            raise ValueError(
+                f"unsupported pod checkpoint version "
+                f"{int(z['ckpt_version'])}"
+            )
+        buf = SVBuffer(
+            X=jnp.asarray(z["sv_X"], dtype),
+            Y=jnp.asarray(z["sv_Y"]),
+            # keep the stored dual dtype: in mixed-precision runs alpha
+            # is float64 between rounds, and truncating it would make
+            # the resumed trajectory diverge from an uninterrupted run
+            alpha=jnp.asarray(z["sv_alpha"]),
+            ids=jnp.asarray(z["sv_ids"]),
+            valid=jnp.asarray(z["sv_valid"]),
+        )
+        return (
+            buf,
+            set(z["prev_ids"].tolist()),
+            int(z["round"]) + 1,
+            float(z["b"]),
+        )

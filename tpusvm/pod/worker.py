@@ -1,0 +1,274 @@
+"""One cascade leaf as a worker process (``python -m tpusvm.pod.worker``).
+
+The worker connects back to the coordinator, loads ONLY the manifest
+shards overlapping its leaf's row set (stream.ShardReader with the
+``shards=`` subset — prefetch pipelined, residency bounded at
+prefetch_depth + 1 shards, audited via ``max_live_shards`` in READY),
+scatters those rows into the exact (slot-addressed) leaf buffer
+``stream.assign.partition_from_dataset`` would have built for this
+leaf — byte-identical rows, order, padding and global IDs — then
+answers TRAIN requests: merge_dedup(recv, own) -> solve -> extract_svs,
+the per-rank body of one cascade step. The worker is stateless across
+requests (the coordinator owns all round state and ships buffers
+explicitly), which is what makes SIGKILL + revive trivially resumable:
+a respawned worker re-derives the identical leaf and the coordinator
+re-runs the round from its round-start state.
+
+Fault point ``pod.worker`` fires at every request entry; an injected
+SimulatedKill is escalated to a REAL ``SIGKILL`` on the worker's own
+pid — no atexit, no socket shutdown, no flush — so chaos runs measure
+exactly what survives genuine process death.
+
+Because leaves are host processes (not shard_map bodies) they accept
+the full solver ladder: the host-side shrinking driver
+(shrink_every/shrink_min/...), the K-row cache, the bf16 matmul rungs
+— everything the shard_map cascade rejects.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import socket
+import sys
+
+import numpy as np
+
+from tpusvm import faults
+from tpusvm.pod.protocol import recv_msg, send_msg
+
+#: solver_opts keys routed to the host-side shrinking driver
+#: (solver/shrink.py) instead of blocked_smo_solve directly
+SHRINK_DRIVER_KEYS = frozenset({
+    "shrink_every", "shrink_min", "shrink_gap_factor", "max_unshrinks",
+})
+
+
+def leaf_solve(train, cfg, accum_dtype, solver: str, solver_opts):
+    """One leaf solve, shrinking-driver aware.
+
+    With any shrink-driver knob present (solver="blocked" only — the
+    coordinator validates), the solve runs under
+    solver.shrink.shrinking_blocked_solve — the PR 9 ladder the
+    shard_map cascade cannot host because compaction is a host-side
+    segmenting loop. Otherwise this is exactly parallel.cascade._solve,
+    so a knob-free pod run is solve-for-solve identical to the
+    in-process cascade.
+    """
+    opts = dict(solver_opts or {})
+    if solver == "blocked" and (SHRINK_DRIVER_KEYS & set(opts)):
+        from tpusvm.solver.shrink import shrinking_blocked_solve
+
+        return shrinking_blocked_solve(
+            train.X,
+            train.Y,
+            valid=train.valid,
+            alpha0=train.alpha,
+            C=cfg.C,
+            gamma=cfg.gamma,
+            eps=cfg.eps,
+            tau=cfg.tau,
+            max_iter=cfg.max_iter,
+            kernel=cfg.kernel,
+            degree=cfg.degree,
+            coef0=cfg.coef0,
+            warm_start=True,
+            accum_dtype=accum_dtype,
+            **opts,
+        )
+    from tpusvm.parallel.cascade import _solve
+
+    return _solve(train, cfg, accum_dtype, solver, opts)
+
+
+def leaf_shards(dataset, part_mask: np.ndarray):
+    """Manifest shard indices whose row ranges intersect this leaf.
+
+    part_mask: (n_rows,) bool — True where the row belongs to the leaf.
+    Contiguous assignment intersects a contiguous shard run; stratified
+    deals touch every shard. Either way only these shards' bytes are
+    ever read.
+    """
+    out = []
+    for i, info in enumerate(dataset.manifest.shards):
+        if part_mask[info.row_start:info.row_start + info.n_rows].any():
+            out.append(i)
+    return out
+
+
+def load_leaf(dataset, leaf: int, n_leaves: int, stratified: bool,
+              prefetch_depth: int, scale: bool, dtype):
+    """Build this leaf's padded SVBuffer by streaming its shards.
+
+    Byte-identical to row ``_leaf_buf(partition_from_dataset(dataset,
+    n_leaves, stratified, scaler), leaf)``: same assignment
+    (stream.assign.assign_rows), same scaler, same float64 staging
+    before the cast to ``dtype`` — so pod SV IDs live in the same
+    global row space as the in-memory and streamed cascade paths.
+    Returns (part_buf: SVBuffer, rows_loaded, shards_read,
+    max_live_shards).
+    """
+    import jax.numpy as jnp
+
+    from tpusvm.parallel.svbuffer import SVBuffer
+    from tpusvm.stream.assign import assign_rows
+    from tpusvm.stream.reader import ShardReader
+
+    n, d = dataset.n_rows, dataset.n_features
+    Y_all = dataset.load_labels() if stratified else None
+    asg = assign_rows(n, n_leaves, Y=Y_all, stratified=stratified)
+    mask = asg.part == leaf
+    subset = leaf_shards(dataset, mask)
+
+    cap = asg.cap
+    Xp = np.zeros((cap, d), np.float64)
+    Yp = np.zeros((cap,), np.int32)
+    ids = np.full((cap,), -1, np.int32)
+    valid = np.zeros((cap,), bool)
+
+    scaler = dataset.scaler() if scale else None
+    reader = ShardReader(dataset, prefetch_depth=prefetch_depth,
+                         scaler=scaler, shards=subset)
+    infos = [dataset.manifest.shards[i] for i in subset]
+    for (X, Y), info in zip(reader, infos):
+        g = np.arange(info.row_start, info.row_start + len(X))
+        sel = np.flatnonzero(mask[g])
+        if not sel.size:
+            continue
+        s = asg.slot[g[sel]]
+        Xp[s] = X[sel]
+        Yp[s] = Y[sel]
+        ids[s] = g[sel].astype(np.int32)
+        valid[s] = True
+    rows = int(valid.sum())
+    buf = SVBuffer(
+        X=jnp.asarray(Xp, dtype),
+        Y=jnp.asarray(Yp),
+        alpha=jnp.zeros((cap,), dtype),
+        ids=jnp.asarray(ids),
+        valid=jnp.asarray(valid),
+    )
+    return buf, rows, len(subset), reader.max_live_shards
+
+
+def _buf_from_arrays(arrays, prefix: str):
+    import jax.numpy as jnp
+
+    from tpusvm.parallel.svbuffer import SVBuffer
+
+    return SVBuffer(*(jnp.asarray(arrays[prefix + f])
+                      for f in SVBuffer._fields))
+
+
+def _buf_to_arrays(buf, prefix: str):
+    from tpusvm.parallel.svbuffer import SVBuffer
+
+    return {prefix + f: np.asarray(getattr(buf, f))
+            for f in SVBuffer._fields}
+
+
+def serve(sock: socket.socket, worker_id: int) -> int:
+    """HELLO -> INIT -> READY, then the TRAIN request loop."""
+    send_msg(sock, {"op": "hello", "worker_id": worker_id})
+    meta, _ = recv_msg(sock)
+    if meta["op"] != "init":
+        raise RuntimeError(f"expected init, got {meta['op']!r}")
+
+    import jax
+
+    # the coordinator pins the worker to its own backend and x64 state
+    # (env vars are unreliable here: site customization may override
+    # JAX_PLATFORMS, and the x64 flip must match the coordinator's
+    # resolve_accum_dtype decision for bit-identical solves)
+    jax.config.update("jax_platforms", meta["platform"])
+    jax.config.update("jax_enable_x64", bool(meta["x64"]))
+    import jax.numpy as jnp
+
+    from tpusvm.config import SVMConfig
+    from tpusvm.parallel.svbuffer import extract_svs, merge_dedup
+    from tpusvm.stream.format import open_dataset
+
+    cfg = SVMConfig(**meta["svm_config"])
+    dtype = jnp.dtype(meta["dtype"])
+    accum = jnp.dtype(meta["accum_dtype"]) if meta["accum_dtype"] else None
+    solver = meta["solver"]
+    solver_opts = meta["solver_opts"] or {}
+    train_cap = int(meta["train_cap"])
+    sv_cap = int(meta["sv_cap"])
+
+    dataset = open_dataset(meta["data"])
+    part_buf, rows, shards_read, live_hwm = load_leaf(
+        dataset, int(meta["leaf"]), int(meta["n_leaves"]),
+        bool(meta["stratified"]), int(meta["prefetch_depth"]),
+        bool(meta["scale"]), dtype,
+    )
+    send_msg(sock, {
+        "op": "ready",
+        "worker_id": worker_id,
+        "rows": rows,
+        "shards_read": shards_read,
+        "max_live_shards": int(live_hwm),
+    })
+
+    while True:
+        meta, arrays = recv_msg(sock)
+        op = meta["op"]
+        faults.point("pod.worker", op=op, worker=worker_id,
+                     req=meta.get("req"))
+        if op == "shutdown":
+            send_msg(sock, {"op": "bye", "worker_id": worker_id})
+            return 0
+        if op != "train":
+            raise RuntimeError(f"unknown pod request {op!r}")
+        recv_buf = _buf_from_arrays(arrays, "recv_")
+        own = (part_buf if meta["use_partition"]
+               else _buf_from_arrays(arrays, "own_"))
+        train, mcount = merge_dedup(recv_buf, own, train_cap)
+        res = leaf_solve(train, cfg, accum, solver, solver_opts)
+        sv, svcount = extract_svs(train, res.alpha, cfg.sv_tol, sv_cap)
+        send_msg(
+            sock,
+            {
+                "op": "result",
+                "req": meta["req"],
+                "worker_id": worker_id,
+                "merged_count": int(mcount),
+                "sv_count": int(svcount),
+                "n_iter": int(res.n_iter),
+                "status": int(res.status),
+                "b": float(res.b),
+            },
+            _buf_to_arrays(sv, "sv_"),
+        )
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tpusvm.pod.worker",
+        description="pod cascade leaf worker (spawned by the coordinator)",
+    )
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, required=True)
+    ap.add_argument("--worker-id", type=int, required=True)
+    ap.add_argument("--faults", default=None,
+                    help="JSON fault plan for chaos runs (initial spawn "
+                         "only; the coordinator revives without it)")
+    args = ap.parse_args(argv)
+    if args.faults:
+        faults.activate(faults.load_plan(args.faults))
+    sock = socket.create_connection((args.host, args.port), timeout=120)
+    sock.settimeout(None)
+    try:
+        return serve(sock, args.worker_id)
+    except faults.SimulatedKill:
+        # escalate to REAL process death: no flush, no socket shutdown,
+        # no atexit — what the coordinator observes is a genuine SIGKILL
+        os.kill(os.getpid(), signal.SIGKILL)
+        raise  # unreachable
+    finally:
+        sock.close()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -50,6 +50,12 @@ class ShardReader:
         shards, enforced by a permit per resident shard.
       seed: None = manifest order; an int = a deterministic shuffled
         shard order (np.random.default_rng(seed).permutation).
+      shards: optional subset of shard indices to read — only those
+        shards are ever loaded (manifest order unless seed shuffles the
+        subset). The pod tier's leaf loader: a leaf streams exactly the
+        shards overlapping its row range, with per-shard blocks
+        byte-identical to a full-manifest pass, and the residency bound
+        unchanged. Indices must be unique and in range.
       scaler: optional fitted MinMaxScaler applied on the fly (e.g. the
         manifest-fitted global scaler), so consumers see scaled rows
         without a second pass over the data.
@@ -81,7 +87,7 @@ class ShardReader:
                  seed: Optional[int] = None, scaler=None, dtype=None,
                  verify: bool = False, metrics=None,
                  retry_policy: Optional[faults.RetryPolicy] = None,
-                 transform=None):
+                 transform=None, shards=None):
         if prefetch_depth < 1:
             raise ValueError(
                 f"prefetch_depth must be >= 1, got {prefetch_depth}"
@@ -92,7 +98,20 @@ class ShardReader:
         self.dtype = dtype
         self.transform = transform
         self.verify = verify
-        order = np.arange(dataset.n_shards)
+        if shards is None:
+            order = np.arange(dataset.n_shards)
+        else:
+            order = np.asarray(shards, np.int64)
+            if order.ndim != 1 or len(set(order.tolist())) != len(order):
+                raise ValueError(
+                    "shards must be a flat sequence of unique indices; "
+                    f"got {shards!r}"
+                )
+            if order.size and (order.min() < 0
+                               or order.max() >= dataset.n_shards):
+                raise IndexError(
+                    f"shard indices out of range [0, {dataset.n_shards})"
+                )
         if seed is not None:
             order = np.random.default_rng(seed).permutation(order)
         self.shard_order = order
