@@ -1,0 +1,221 @@
+#!/usr/bin/env python
+"""Distributed-trace fabric: traced vs untraced pod fit, cost + parity.
+
+The observability fabric's acceptance harness (ISSUE 20): running the
+out-of-core pod cascade with the distributed tracer on — coordinator
+trace file, one trace file per worker PROCESS, trace contexts
+propagated inside the pod wire frames — must be
+
+  * FREE of model consequence: the traced fit reproduces the untraced
+    control bit-for-bit (same SV-ID set, byte-identical alpha vector,
+    bitwise-equal b) — `bit_identical`, the hard exact gate;
+  * USABLE: merging the trace directory stitches ONE cross-process
+    timeline — every worker root span re-parents under the
+    coordinator's via the propagated context (zero unresolved), and
+    `render_report` renders the merged records without raising —
+    `reparented_ok` / `report_ok`;
+  * CHEAP: tracing costs <= 3% of pod wall clock (`overhead_frac`,
+    full-size runs only — smoke checks the identity/usability gates).
+
+Timing protocol: arms run INTERLEAVED (untraced/traced per repeat) with
+the per-arm MIN kept — the standard noise-rejection protocol for a
+host-timed multiprocess measurement. benchdiff gates the timing columns
+at --level full only (Rule.timing), so the committed smoke baseline
+stays machine-portable.
+
+Usage:
+  python benchmarks/obs_fabric.py --smoke --jsonl out.jsonl
+  python benchmarks/obs_fabric.py --n 512 --repeats 3
+"""
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from benchmarks.common import emit, log, pin_platform
+
+pin_platform()
+
+import numpy as np  # noqa: E402
+
+from tpusvm.config import CascadeConfig, SVMConfig  # noqa: E402
+from tpusvm.data import rings  # noqa: E402
+from tpusvm.obs.trace import Tracer  # noqa: E402
+from tpusvm.pod import pod_fit  # noqa: E402
+from tpusvm.stream.format import ingest_arrays  # noqa: E402
+
+OVERHEAD_GATE = 0.03  # full-size runs only; --smoke gates identity/usability
+
+
+def _fit(data_dir, cfg, cc, trace_dir=None):
+    """One pod fit; trace_dir=None is the untraced control arm."""
+    tracer = None
+    if trace_dir is not None:
+        os.makedirs(trace_dir, exist_ok=True)
+        tracer = Tracer(os.path.join(trace_dir, "coordinator.jsonl"),
+                        role="pod-coordinator", argv=["obs_fabric"])
+    t0 = time.perf_counter()
+    try:
+        res = pod_fit(data_dir, cfg, cc, tracer=tracer,
+                      trace_dir=trace_dir)
+    finally:
+        if tracer is not None:
+            tracer.close()
+    return res, time.perf_counter() - t0
+
+
+def _sv_key(res):
+    ids = np.asarray(res.sv_ids)
+    order = np.argsort(ids)
+    alpha = np.asarray(res.sv_alpha)[order]
+    return (set(int(i) for i in ids), alpha.tobytes(), float(res.b))
+
+
+def run(args) -> int:
+    n = 192 if args.smoke else args.n
+    repeats = 1 if args.smoke else args.repeats
+    cfg = SVMConfig(C=args.C, gamma=args.gamma, max_rounds=args.max_rounds)
+    cc = CascadeConfig(n_shards=args.workers, sv_capacity=args.sv_capacity,
+                       topology=args.topology)
+
+    X, Y = rings(n=n, seed=args.seed)
+    d = int(X.shape[1])
+    violations = []
+    with tempfile.TemporaryDirectory(prefix="obs_fabric_bench_") as tmp:
+        data_dir = os.path.join(tmp, "ds")
+        ingest_arrays(data_dir, X, Y, rows_per_shard=args.rows_per_shard)
+
+        best = {}  # arm -> (wall_s, result)
+        last_trace_dir = None
+        for rep in range(repeats):  # interleave arms, keep min
+            for arm in ("off", "on"):
+                tdir = None
+                if arm == "on":
+                    tdir = os.path.join(tmp, f"trace{rep}")
+                    last_trace_dir = tdir
+                res, dt = _fit(data_dir, cfg, cc, trace_dir=tdir)
+                if arm not in best or dt < best[arm][0]:
+                    best[arm] = (dt, res)
+        t_off, r_off = best["off"]
+        t_on, r_on = best["on"]
+        overhead = (t_on - t_off) / t_off
+        log(f"obs_fabric {cc.topology}/P={args.workers}: "
+            f"untraced {t_off:.2f}s, traced {t_on:.2f}s "
+            f"({overhead:+.2%}), {len(r_on.sv_ids)} SVs, "
+            f"{r_on.rounds} rounds")
+
+        bit_identical = _sv_key(r_off) == _sv_key(r_on)
+        if not bit_identical:
+            violations.append("traced fit is not bit-identical to the "
+                              "untraced control")
+        if not (r_off.converged and r_on.converged):
+            violations.append("an arm did not converge")
+
+        # usability gates over the LAST traced run's directory
+        from tpusvm.obs.report import (
+            merge_trace_files,
+            render_report,
+            reparent_stats,
+        )
+
+        tfiles = sorted(
+            os.path.join(last_trace_dir, f)
+            for f in os.listdir(last_trace_dir) if f.endswith(".jsonl"))
+        trace_files = len(tfiles)
+        if trace_files < args.workers + 1:
+            violations.append(
+                f"expected >={args.workers + 1} trace files "
+                f"(coordinator + {args.workers} workers), "
+                f"found {trace_files}")
+        stats = {"spans": 0, "reparented": 0, "unresolved": -1,
+                 "roles": []}
+        reparented_ok = report_ok = False
+        try:
+            recs = merge_trace_files(tfiles)
+            stats = reparent_stats(recs)
+            reparented_ok = (stats["unresolved"] == 0
+                             and stats["reparented"] > 0
+                             and "pod-worker" in stats["roles"]
+                             and "pod-coordinator" in stats["roles"])
+            body = render_report(recs)
+            report_ok = "cross-process timeline" in body
+        except (ValueError, KeyError) as e:
+            violations.append(f"merged trace unusable: {e}")
+        if not reparented_ok:
+            violations.append(
+                f"re-parenting broken: {stats['unresolved']} unresolved "
+                f"root span(s), {stats['reparented']} re-parented, "
+                f"roles {stats['roles']}")
+        if not report_ok:
+            violations.append("merged report did not render the "
+                              "cross-process timeline")
+        if not args.smoke and overhead > OVERHEAD_GATE:
+            violations.append(
+                f"tracing overhead {overhead:.4f} exceeds the "
+                f"{OVERHEAD_GATE:.0%} gate")
+
+    record = {
+        "bench": "obs_fabric",
+        "topology": cc.topology, "P": args.workers, "n": n, "d": d,
+        "smoke": bool(args.smoke),
+        "repeats": repeats,
+        "t_off_s": round(t_off, 4),
+        "t_on_s": round(t_on, 4),
+        "overhead_frac": round(overhead, 6),
+        "gate_frac": OVERHEAD_GATE,
+        "bit_identical": bit_identical,
+        "converged": bool(r_off.converged and r_on.converged),
+        "sv_count": len(r_on.sv_ids),
+        "rounds": int(r_on.rounds),
+        "trace_files": trace_files,
+        "spans": stats["spans"],
+        "reparented_spans": stats["reparented"],
+        "unresolved_spans": stats["unresolved"],
+        "reparented_ok": reparented_ok,
+        "report_ok": report_ok,
+        "violations": violations,
+    }
+    emit(record)
+    if args.jsonl:
+        with open(args.jsonl, "a") as f:
+            f.write(json.dumps(record, sort_keys=True) + "\n")
+    if violations:
+        for v in violations:
+            log(f"GATE FAILED: {v}")
+        return 1
+    log(f"obs fabric ok: {trace_files} files / {stats['spans']} spans "
+        f"stitched ({stats['reparented']} re-parented, 0 unresolved), "
+        f"fit bit-identical, overhead {overhead:+.2%}")
+    return 0
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--smoke", action="store_true",
+                    help="CI shape: n=192, one pass per arm, no "
+                    "overhead floor")
+    ap.add_argument("--n", type=int, default=512,
+                    help="training rows (smoke pins 192)")
+    ap.add_argument("--workers", type=int, default=4,
+                    help="worker-process count = cascade leaves")
+    ap.add_argument("--topology", choices=["tree", "star"], default="tree")
+    ap.add_argument("--rows-per-shard", type=int, default=24)
+    ap.add_argument("--sv-capacity", type=int, default=128)
+    ap.add_argument("--C", type=float, default=10.0)
+    ap.add_argument("--gamma", type=float, default=10.0)
+    ap.add_argument("--max-rounds", type=int, default=12)
+    ap.add_argument("--repeats", type=int, default=2,
+                    help="interleaved timing passes, min kept (smoke: 1)")
+    ap.add_argument("--seed", type=int, default=3)
+    ap.add_argument("--jsonl", help="append the record to this file")
+    args = ap.parse_args()
+    return run(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,506 @@
+"""Tests for the distributed observability fabric (ISSUE 20).
+
+Contracts:
+  * TraceContext round-trips through its dict form (pod frames) and the
+    X-Tpusvm-Trace header form; every malformed input degrades to None,
+    never to an exception (a peer speaking another dialect must not
+    crash the receiver);
+  * pod protocol frames carry the context as a free meta key — old
+    frames (no key) parse unchanged and attach_ctx(meta, None) is a
+    no-op passthrough;
+  * a role-ful Tracer writes its fleet identity into the meta record
+    and mints contexts naming the innermost open span; a role-less
+    tracer keeps the exact meta shape older builds wrote;
+  * obs.report stitches merged trace files into ONE timeline: worker
+    root spans re-parent under the coordinator span named by the
+    propagated context (file-level meta ctx), per-request spans under
+    exactly the originating span (span-level attrs ctx), and
+    reparent_stats machine-checks it (0 unresolved);
+  * fleet aggregation: merge_fleet tags every series with its origin
+    (role, instance) and the merged page equals the sum of the
+    per-process pages exactly; FleetCollector derives qps from counter
+    deltas on an injected clock; the `tpusvm top` table is a pure
+    function of its inputs (golden);
+  * the serve HTTP frontend exports /metrics.json as a parseable fleet
+    payload and lands traced predicts as serve.request spans carrying
+    the propagated ctx; the router injects a fresh context into its
+    outbound header (and keeps the 3-arg transport form for injected
+    transports that predate trace propagation);
+  * benchdiff knows the obs_fabric schema: identity/usability columns
+    are exact, the overhead columns are timing rules skipped at smoke.
+"""
+
+import json
+import socket
+import urllib.request
+
+import numpy as np
+import pytest
+
+from tpusvm.obs.fleet import (
+    FleetCollector,
+    FleetView,
+    format_top,
+    merge_fleet,
+    parse_payload,
+    read_snapshot_file,
+    render_fleet_text,
+    snapshot_payload,
+    top_rows,
+    write_snapshot_file,
+)
+from tpusvm.obs.registry import MetricsRegistry
+from tpusvm.obs.report import (
+    cross_process_spans,
+    format_round_gantt,
+    format_timeline,
+    merge_trace_files,
+    render_report,
+    reparent_stats,
+)
+from tpusvm.obs.trace import TRACE_HEADER, TraceContext, Tracer, read_trace
+from tpusvm.pod.protocol import attach_ctx, extract_ctx, recv_msg, send_msg
+
+
+class FakeClock:
+    """Deterministic monotonic clock for bit-stable trace files."""
+
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        self.t += 1.0
+        return self.t
+
+
+# ----------------------------------------------------------- TraceContext
+def test_trace_context_dict_roundtrip_and_junk():
+    ctx = TraceContext(trace_id="abcd1234", span_id=7, role="router", pid=99)
+    assert TraceContext.from_dict(ctx.to_dict()) == ctx
+    # span_id None survives (minted outside any span)
+    root = TraceContext(trace_id="abcd1234", span_id=None, role="serve",
+                        pid=1)
+    assert TraceContext.from_dict(root.to_dict()) == root
+    # malformed payloads degrade to None, never raise
+    for junk in (None, "x", 7, [], {},
+                 {"trace_id": "t", "role": "r"},              # missing pid
+                 {"trace_id": 5, "role": "r", "pid": 1},       # bad trace_id
+                 {"trace_id": "t", "role": None, "pid": 1},    # bad role
+                 {"trace_id": "t", "role": "r", "pid": True},  # bool pid
+                 {"trace_id": "t", "role": "r", "pid": 1,
+                  "span_id": "3"}):                            # str span_id
+        assert TraceContext.from_dict(junk) is None
+
+
+def test_trace_context_header_roundtrip_and_junk():
+    ctx = TraceContext(trace_id="abcd1234", span_id=7, role="router", pid=99)
+    assert ctx.to_header() == "1;abcd1234;7;router;99"
+    assert TraceContext.from_header(ctx.to_header()) == ctx
+    # span_id None serializes as "-"
+    root = TraceContext(trace_id="t0", span_id=None, role="serve", pid=2)
+    assert root.to_header() == "1;t0;-;serve;2"
+    assert TraceContext.from_header(root.to_header()) == root
+    for junk in (None, "", "garbage", "2;t;3;r;1",   # unknown version
+                 "1;t;3;r",                           # 4 parts
+                 "1;;3;r;1",                          # empty trace_id
+                 "1;t;3;;1",                          # empty role
+                 "1;t;x;r;1",                         # bad span_id
+                 "1;t;3;r;nope"):                     # bad pid
+        assert TraceContext.from_header(junk) is None
+
+
+# ----------------------------------------------------- protocol ctx frames
+def test_protocol_frames_carry_ctx_and_stay_back_compatible():
+    ctx = TraceContext(trace_id="feed01", span_id=3, role="pod-coordinator",
+                       pid=17)
+    a, b = socket.socketpair()
+    try:
+        meta = {"op": "train", "round": 2}
+        send_msg(a, attach_ctx(meta, ctx), arrays={"x": np.arange(3)})
+        got, arrays = recv_msg(b)
+        assert extract_ctx(got) == ctx
+        assert got["op"] == "train" and got["round"] == 2
+        assert np.array_equal(arrays["x"], np.arange(3))
+        # the sender's meta dict was not mutated (attach_ctx copies)
+        assert "ctx" not in meta
+        # an old frame (no ctx key) parses unchanged; extract degrades
+        send_msg(a, meta)
+        old, _ = recv_msg(b)
+        assert old == meta and extract_ctx(old) is None
+        # junk under the key degrades to None too
+        send_msg(a, {"op": "x", "ctx": "not-a-dict"})
+        junk, _ = recv_msg(b)
+        assert extract_ctx(junk) is None
+    finally:
+        a.close()
+        b.close()
+    # None passthrough keeps call sites branch-free
+    m = {"op": "shutdown"}
+    assert attach_ctx(m, None) is m
+
+
+# -------------------------------------------------------- Tracer identity
+def test_tracer_role_identity_and_ctx_minting(tmp_path):
+    path = str(tmp_path / "coord.jsonl")
+    with Tracer(path, clock=FakeClock(), wall=lambda: 0.0,
+                role="pod-coordinator", trace_id="tid0") as tr:
+        assert tr.ctx() == TraceContext("tid0", None, "pod-coordinator",
+                                        tr.pid)
+        with tr.span("pod.fit"):
+            inner = tr.ctx()
+            assert inner.span_id == 1  # the innermost open span's id
+    meta = read_trace(path)[0]
+    assert meta["trace_id"] == "tid0"
+    assert meta["role"] == "pod-coordinator" and meta["pid"] == tr.pid
+    assert "ctx" not in meta  # not spawned with one
+
+    # a role-ful tracer without an explicit trace_id mints one
+    auto = Tracer(str(tmp_path / "auto.jsonl"), role="serve")
+    assert isinstance(auto.trace_id, str) and len(auto.trace_id) == 16
+    auto.close()
+
+    # roles must survive the ';'-separated header wire format
+    with pytest.raises(ValueError, match=";"):
+        Tracer(str(tmp_path / "bad.jsonl"), role="a;b")
+
+
+def test_anonymous_tracer_meta_is_identity_free(tmp_path):
+    path = str(tmp_path / "anon.jsonl")
+    with Tracer(path, clock=FakeClock(), wall=lambda: 0.0) as tr:
+        with pytest.raises(ValueError, match="role"):
+            tr.ctx()
+    meta = read_trace(path)[0]
+    # byte-compat contract: no cross-process keys unless opted in
+    for key in ("trace_id", "role", "pid", "ctx"):
+        assert key not in meta
+
+
+# ------------------------------------------------- cross-process stitching
+def _two_process_trace(tmp_path):
+    """A coordinator file + a worker file linked both ways: file-level
+    (worker spawned with the fit-span ctx) and span-level (the worker's
+    train span carries the round-span ctx in its attrs)."""
+    cpath = str(tmp_path / "coordinator.jsonl")
+    wpath = str(tmp_path / "worker0.p1.jsonl")
+    coord = Tracer(cpath, clock=FakeClock(), wall=lambda: 1000.0,
+                   role="pod-coordinator", trace_id="tid0")
+    with coord.span("pod.fit", topology="tree"):
+        ctx_spawn = coord.ctx()  # names the fit span
+        with coord.span("pod.round", round=0):
+            ctx_req = coord.ctx()  # names the round span
+    coord.close()
+    worker = Tracer(wpath, clock=FakeClock(), wall=lambda: 1000.5,
+                    role="pod-worker", ctx=ctx_spawn)
+    with worker.span("pod.leaf_load", leaf=0):
+        pass
+    with worker.span("pod.leaf_train", round=0,
+                     ctx=ctx_req.to_dict()):
+        pass
+    worker.close()
+    return cpath, wpath
+
+
+def test_cross_process_reparenting(tmp_path):
+    cpath, wpath = _two_process_trace(tmp_path)
+    recs = merge_trace_files([cpath, wpath])
+    spans, roles = cross_process_spans(recs)
+    assert roles == ["pod-coordinator", "pod-worker"]
+    by_name = {s["name"]: s for s in spans}
+    fit, rnd = by_name["pod.fit"], by_name["pod.round"]
+    load, train = by_name["pod.leaf_load"], by_name["pod.leaf_train"]
+    # the worker inherited its ctx= trace_id, so the origin index
+    # resolves both links into the coordinator's file
+    assert load["_gparent"] == fit["_gid"]      # file-level (meta ctx)
+    assert train["_gparent"] == rnd["_gid"]     # span-level (attrs ctx)
+    assert rnd["_gparent"] == fit["_gid"]       # plain local parentage
+    assert fit["_gparent"] is None
+    assert load["_role"] == "pod-worker" and fit["_role"] == "pod-coordinator"
+
+    stats = reparent_stats(recs)
+    assert stats == {"files": 2, "roles": roles, "spans": 4,
+                     "reparented": 2, "unresolved": 0}
+
+    timeline = format_timeline(recs)
+    assert "pod-coordinator" in timeline and "pod-worker" in timeline
+    # resolved depth: the train span indents under coordinator spans
+    train_line = next(ln for ln in timeline.splitlines()
+                      if "pod.leaf_train" in ln)
+    assert "    pod.leaf_train" in train_line  # depth >= 2
+    gantt = format_round_gantt(recs)
+    assert "#" in gantt and "round" in gantt
+
+    body = render_report(recs)
+    assert "cross-process timeline" in body
+    assert "2 spans re-parented, 0 unresolved" in body
+
+
+def test_single_roleless_file_degrades_to_local_report(tmp_path):
+    path = str(tmp_path / "t.jsonl")
+    with Tracer(path, clock=FakeClock(), wall=lambda: 0.0) as tr:
+        with tr.span("training"):
+            pass
+    recs = merge_trace_files([path])
+    _, roles = cross_process_spans(recs)
+    assert roles == ["main"]
+    assert "cross-process timeline" not in render_report(recs)
+
+
+def test_unresolved_contexts_are_counted_not_invented(tmp_path):
+    cpath, wpath = _two_process_trace(tmp_path)
+    # merge the worker file ALONE: its contexts name a file that is not
+    # in the merged set, so nothing re-parents and both its root spans
+    # count as unresolved — the --smoke / chaos-gate failure signal
+    recs = merge_trace_files([wpath])
+    stats = reparent_stats(recs)
+    assert stats["reparented"] == 0
+    assert stats["unresolved"] == 2
+
+
+# ------------------------------------------------------- fleet aggregation
+def _payload_with(role, instance, pid=None, status=None, **counters):
+    reg = MetricsRegistry()
+    for name, val in counters.items():
+        reg.counter(name.replace("__", ".")).inc(val)
+    return snapshot_payload(role, instance, reg.snapshot(), pid=pid,
+                            status=status)
+
+
+def test_merge_fleet_tags_origin_and_conserves_totals():
+    p1 = _payload_with("serve", "r-1", serve__ok=3)
+    p2 = _payload_with("serve", "r-2", serve__ok=4)
+    merged = merge_fleet([p1, p2])
+    entries = [e for e in merged["metrics"] if e["name"] == "serve.ok"]
+    # label-disjoint after tagging: one series per process, sum exact
+    assert {e["labels"]["instance"] for e in entries} == {"r-1", "r-2"}
+    assert all(e["labels"]["role"] == "serve" for e in entries)
+    assert sum(e["value"] for e in entries) == 7
+    assert merge_fleet([]) == {"v": 1, "metrics": []}
+
+
+def test_fleet_labels_beat_process_local_labels():
+    reg = MetricsRegistry()
+    reg.counter("serve.ok", instance="imposter").inc(2)
+    p = snapshot_payload("serve", "r-real", reg.snapshot())
+    merged = merge_fleet([p])
+    (entry,) = [e for e in merged["metrics"] if e["name"] == "serve.ok"]
+    # the collector's identity assignment wins, or two processes could
+    # alias one series and double-count
+    assert entry["labels"]["instance"] == "r-real"
+
+
+def test_fleet_collector_rates_and_merge_parity():
+    count = {"n": 0}
+
+    def src():
+        return _payload_with("serve", "r-1", pid=1, serve__ok=count["n"])
+
+    def dead():
+        raise OSError("connection refused")
+
+    clk = iter([10.0, 12.0])
+    coll = FleetCollector(clock=lambda: next(clk))
+    coll.add_callable(src, name="r-1")
+    coll.add_callable(dead, name="dead")
+    count["n"] = 5
+    v1 = coll.scrape_once()
+    assert coll.rates() == {}  # no deltas until a second scrape
+    assert "OSError" in v1.errors["dead"]
+    count["n"] = 9
+    v2 = coll.scrape_once()
+    # qps = counter delta / clock delta = (9-5)/(12-10)
+    assert coll.rates() == {("serve", "r-1"): {"qps": 2.0, "serve.ok": 2.0}}
+    # the acceptance contract: the published merged view IS the fold of
+    # the per-process payloads, exactly
+    assert v2.merged == merge_fleet(v2.processes)
+    assert coll.view() is v2
+    assert render_fleet_text(v2).startswith(
+        "# fleet: 1 process(es), 1 error(s)")
+
+
+def test_snapshot_file_roundtrip_and_payload_gates(tmp_path):
+    p = _payload_with("autopilot", "ap-1", pid=7, serve__ok=1)
+    path = str(tmp_path / "drop.json")
+    write_snapshot_file(path, p)
+    assert read_snapshot_file(path) == p
+    # version / shape gates
+    with pytest.raises(ValueError, match="v"):
+        parse_payload({**p, "v": 999})
+    with pytest.raises(ValueError, match="role"):
+        parse_payload({"v": 1, "instance": "x", "snapshot": p["snapshot"]})
+    with pytest.raises(ValueError):
+        parse_payload("not a dict")
+    # an unsupported registry snapshot is refused at payload build time
+    with pytest.raises(ValueError, match="snapshot version"):
+        snapshot_payload("serve", "r-1", {"v": 99, "metrics": []})
+
+
+def test_format_top_golden():
+    p1 = _payload_with(
+        "serve", "r-1", pid=42,
+        status={"models": {"m": {"generation": 3, "breaker": "closed",
+                                 "p99_s": 0.0123, "burning": False}}},
+        serve__ok=7)
+    reg = MetricsRegistry()
+    reg.counter("pod.worker_requests").inc(5)
+    reg.gauge("pod.live_shards").set(2)
+    p2 = snapshot_payload("pod-worker", "w0", reg.snapshot(), pid=43)
+    view = FleetView([p1, p2], {}, merge_fleet([p1, p2]), 12.0)
+    rows = top_rows(view, rates={("serve", "r-1"): {"qps": 2.5}})
+    text = format_top(rows, errors={"http://dead": "URLError: x"},
+                      clock_s=12.0)
+    assert text == (
+        "tpusvm fleet — 2 process(es) — t=12.0s\n"
+        "ROLE        INSTANCE  PID  GEN  REQS  QPS  P99MS  BURN  BREAKER  SHARDS\n"
+        "pod-worker  w0        43   -    5     -    -      -     -        2\n"
+        "serve       r-1       42   3    7     2.5  12.3   no    closed   -\n"
+        "! http://dead: URLError: x\n"
+    )
+
+
+def test_fleet_collector_thread_lifecycle():
+    coll = FleetCollector()
+    coll.add_callable(lambda: _payload_with("serve", "r-1", serve__ok=1),
+                      name="r-1")
+    with coll:
+        coll.start(interval_s=60.0)  # first scrape is synchronous
+        assert coll.view() is not None
+        assert coll._thread is not None and coll._thread.daemon
+        with pytest.raises(RuntimeError, match="already started"):
+            coll.start()
+    assert coll._thread is None  # stop() joined and cleared it
+
+
+# ------------------------------------------------ serve + router transport
+def test_serve_http_exports_fleet_payload_and_traced_spans(tmp_path):
+    jnp = pytest.importorskip("jax.numpy")
+    from tpusvm.config import SVMConfig
+    from tpusvm.data import rings
+    from tpusvm.models import BinarySVC
+    from tpusvm.serve import ServeConfig, Server
+    from tpusvm.serve.http import make_http_server, start_http_thread
+
+    X, Y = rings(n=96, seed=1)
+    model = BinarySVC(SVMConfig(C=10.0, gamma=10.0),
+                      dtype=jnp.float32).fit(X, Y)
+    srv = Server(ServeConfig(max_batch=8), dtype=jnp.float32)
+    srv.add_model("m", model)
+    srv.warmup()
+    tracer = Tracer(str(tmp_path / "serve.jsonl"), role="serve")
+    httpd = make_http_server(srv, port=0)
+    httpd.tpusvm_tracer = tracer
+    srv.attach_http(httpd, start_http_thread(httpd))
+    host, port = httpd.server_address[:2]
+    base = f"http://{host}:{port}"
+    ctx = TraceContext(trace_id="deadbeef", span_id=7, role="router", pid=1)
+    try:
+        with urllib.request.urlopen(base + "/metrics.json",
+                                    timeout=10) as resp:
+            payload = parse_payload(json.loads(resp.read()))
+        assert payload["role"] == "serve"
+        assert payload["instance"] == srv.replica_id
+
+        body = json.dumps(
+            {"instances": np.asarray(X[:2], float).tolist()}).encode()
+        req = urllib.request.Request(
+            base + "/v1/models/m:predict", data=body,
+            headers={"Content-Type": "application/json",
+                     TRACE_HEADER: ctx.to_header()}, method="POST")
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            assert resp.status == 200
+    finally:
+        srv.close()
+        tracer.close()
+    spans = [r for r in read_trace(tracer.path) if r["kind"] == "span"]
+    (span,) = [s for s in spans if s["name"] == "serve.request"]
+    assert span["attrs"]["model"] == "m" and span["attrs"]["rows"] == 2
+    # the propagated context landed verbatim — this is what the merged
+    # report re-parents the request under
+    assert span["attrs"]["ctx"] == ctx.to_dict()
+
+
+def _replica_health(url, timeout_s=0.0):
+    return {"status": "ok", "replica_id": "r-x", "uptime_s": 1.0,
+            "models": {"m": "closed"}, "swap": {"m": {"generation": 1}},
+            "slo": {}}
+
+
+def test_router_injects_trace_header_and_keeps_3arg_transport(tmp_path):
+    from tpusvm.router import Router, RouterConfig
+
+    calls = []
+
+    def transport4(url, body, timeout_s, headers):
+        calls.append((url, headers))
+        return 200, b"{}", None
+
+    tracer = Tracer(str(tmp_path / "router.jsonl"), role="router",
+                    trace_id="rtid")
+    r = Router(RouterConfig(replicas=("http://a",), replication=1,
+                            poll_interval_s=10.0),
+               transport=transport4, fetch=_replica_health,
+               registry=MetricsRegistry(), log_fn=None, tracer=tracer)
+    r.poller.poll_once()
+    inbound = TraceContext(trace_id="cli", span_id=2, role="client", pid=5)
+    code, _, _ = r.forward("m", b"{}", ctx=inbound)
+    assert code == 200
+    (_, headers), = calls
+    out = TraceContext.from_header(headers[TRACE_HEADER])
+    # the outbound context is the ROUTER's (minted inside router.forward),
+    # not the inbound one passed through — replicas parent into the
+    # router's timeline
+    assert out.role == "router" and out.trace_id == "rtid"
+    assert out.span_id is not None
+    r.poller.stop()
+    tracer.close()
+    spans = [rec for rec in read_trace(tracer.path)
+             if rec["kind"] == "span"]
+    (fwd,) = [s for s in spans if s["name"] == "router.forward"]
+    assert fwd["attrs"]["ctx"] == inbound.to_dict()
+    assert fwd["id"] == out.span_id
+
+    # a tracer-less router calls the legacy 3-arg transport form
+    calls3 = []
+
+    def transport3(url, body, timeout_s):
+        calls3.append(url)
+        return 200, b"{}", None
+
+    r2 = Router(RouterConfig(replicas=("http://a",), replication=1,
+                             poll_interval_s=10.0),
+                transport=transport3, fetch=_replica_health,
+                registry=MetricsRegistry(), log_fn=None)
+    r2.poller.poll_once()
+    code, _, _ = r2.forward("m", b"{}")
+    assert code == 200 and calls3
+    payload = parse_payload(r2.fleet_payload())
+    assert payload["role"] == "router"
+    assert payload["instance"].startswith("router-")
+    r2.poller.stop()
+
+
+# ------------------------------------------------------------- benchdiff
+def test_benchdiff_knows_the_obs_fabric_schema():
+    from tpusvm.obs.benchdiff import diff_records
+
+    base = {"bench": "obs_fabric", "topology": "tree", "P": 4, "n": 512,
+            "smoke": False, "bit_identical": True, "reparented_ok": True,
+            "report_ok": True, "converged": True, "sv_count": 40,
+            "rounds": 3, "unresolved_spans": 0, "overhead_frac": 0.02,
+            "t_off_s": 1.0, "t_on_s": 1.02, "violations": []}
+    broken = dict(base, bit_identical=False, reparented_ok=False,
+                  unresolved_spans=7, overhead_frac=0.2,
+                  violations=["traced fit is not bit-identical"])
+    res = diff_records([base], [broken], level="full")
+    bad = {f.metric for f in res.regressions}
+    assert {"bit_identical", "reparented_ok", "unresolved_spans",
+            "overhead_frac", "violations"} <= bad
+
+    # overhead columns are timing rules: a slow CI box must not fail
+    # the smoke gate, but the full gate still catches it
+    slow = dict(base, overhead_frac=0.5, t_on_s=9.0)
+    assert diff_records([base], [slow], level="smoke").ok
+    full = diff_records([base], [slow], level="full")
+    assert {"overhead_frac", "t_on_s"} <= {f.metric
+                                           for f in full.regressions}
+    # identical artifacts pass at both levels
+    assert diff_records([base], [dict(base)], level="full").ok

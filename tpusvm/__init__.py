@@ -12,6 +12,6 @@ training. See SURVEY.md for the capability map.
 from tpusvm.config import CascadeConfig, SVMConfig, preset
 from tpusvm.status import Status
 
-__version__ = "0.22.0"
+__version__ = "0.23.0"
 
 __all__ = ["SVMConfig", "CascadeConfig", "preset", "Status", "__version__"]

@@ -77,6 +77,11 @@ class AutopilotConfig:
     breaker_threshold: int = 3
     breaker_cooldown_s: float = 60.0
     seed: int = 0
+    # fleet observability for a process with no HTTP listener: when set,
+    # every tick publishes this process's obs.fleet snapshot payload
+    # here (staged + fsync_replace — never torn), and the fleet
+    # collector picks it up as a file source
+    metrics_snapshot_path: Optional[str] = None
 
     def resolved(self) -> "AutopilotConfig":
         if self.hysteresis < 1:
@@ -259,9 +264,29 @@ class Autopilot:
             reg.counter("autopilot.refreshes_suppressed",
                         reason="hysteresis").inc()
         self._save()
+        if self.cfg.metrics_snapshot_path is not None:
+            self._drop_fleet_snapshot(status)
         return {"status": status, "report": report,
                 "tick": st.tick, "rows": dataset.n_rows,
                 "generation": st.generation}
+
+    def _drop_fleet_snapshot(self, status: AutopilotStatus) -> None:
+        """Publish the on-disk fleet payload (best-effort: telemetry
+        must never fail a tick — a full disk loses one drop, not the
+        supervisor)."""
+        from tpusvm.obs.fleet import snapshot_payload, write_snapshot_file
+
+        try:
+            write_snapshot_file(
+                self.cfg.metrics_snapshot_path,
+                snapshot_payload(
+                    "autopilot", self.cfg.name, _registry().snapshot(),
+                    status={"stage": self.state.stage,
+                            "tick": self.state.tick,
+                            "status": status.name,
+                            "generation": self.state.generation}))
+        except OSError as e:
+            self.log(f"autopilot: fleet snapshot drop failed: {e}")
 
     # ------------------------------------------------------------ refresh
     def _refresh(self, dataset) -> AutopilotStatus:

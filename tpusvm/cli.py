@@ -802,6 +802,12 @@ def _build_parser() -> argparse.ArgumentParser:
     ro.add_argument("--smoke-threads", type=int, default=4)
     ro.add_argument("--smoke-requests", type=int, default=40,
                     help="requests per smoke thread")
+    ro.add_argument("--trace", metavar="PATH",
+                    help="write router.forward spans (one per proxied "
+                    "request, carrying the minted trace context that "
+                    "replicas honor) to a JSONL trace")
+    ro.add_argument("--trace-max-bytes", type=int, default=None,
+                    metavar="N")
 
     tu = sub.add_parser(
         "tune", parents=[common],
@@ -946,6 +952,14 @@ def _build_parser() -> argparse.ArgumentParser:
                     "set / alpha bytes / b exactly, conserves every row "
                     "across the workers, and keeps per-worker shard "
                     "residency within the prefetch bound")
+    po.add_argument("--trace-dir", metavar="DIR", default=None,
+                    help="distributed trace directory: the coordinator "
+                    "writes coordinator.jsonl and every worker process "
+                    "its own worker<id>.p<pid>.jsonl, stitched by "
+                    "propagated trace contexts — `tpusvm report DIR` "
+                    "renders the fleet as ONE timeline")
+    po.add_argument("--trace-max-bytes", type=int, default=None,
+                    metavar="N", help="per-file trace rotation bound")
     po.add_argument("-q", "--quiet", action="store_true")
 
     inf = sub.add_parser("info", parents=[common],
@@ -972,6 +986,56 @@ def _build_parser() -> argparse.ArgumentParser:
                      help="CI gate: non-zero exit unless the trace(s) "
                      "parse at the current schema version and carry "
                      "at least one phase span and one convergence record")
+
+    def add_fleet_sources(p):
+        p.add_argument("--router", metavar="URL", default=None,
+                       help="router base URL: adopts its /fleet/"
+                       "metrics.json (the router scrapes its replicas)")
+        p.add_argument("--replica", action="append", default=[],
+                       metavar="URL", dest="replicas",
+                       help="serve replica base URL (scrapes "
+                       "/metrics.json), repeatable")
+        p.add_argument("--snapshot-file", action="append", default=[],
+                       metavar="PATH", dest="snapshot_files",
+                       help="on-disk snapshot payload (e.g. an "
+                       "autopilot metrics_snapshot_path drop), "
+                       "repeatable")
+        p.add_argument("--timeout-s", type=float, default=2.0,
+                       help="per-scrape fetch timeout (default 2.0)")
+
+    fm = sub.add_parser(
+        "fleet-metrics", parents=[common],
+        help="scrape every fleet process (serve replicas' "
+        "/metrics.json, a router's /fleet/metrics.json, on-disk "
+        "snapshot drops) and print ONE merged, (role, instance)-"
+        "labelled metrics view (obs.fleet.merge_fleet: counters sum, "
+        "gauges max, histograms add)")
+    add_fleet_sources(fm)
+    fm.add_argument("--format", choices=["text", "json"], default="text")
+    fm.add_argument("--smoke", action="store_true",
+                    help="CI gate: an in-process two-replica fleet "
+                    "behind a router; non-zero exit unless the merged "
+                    "fleet view equals merge_fleet() of the per-process "
+                    "snapshots scraped directly (exact counter totals, "
+                    "label-tagged)")
+    fm.add_argument("-q", "--quiet", action="store_true")
+
+    tp = sub.add_parser(
+        "top", parents=[common],
+        help="live fleet table (one row per process: role, instance, "
+        "pid, generation, request totals, qps, p99, burn, breaker, "
+        "live shards) refreshed from the same sources as "
+        "fleet-metrics")
+    add_fleet_sources(tp)
+    tp.add_argument("--interval-s", type=float, default=2.0,
+                    help="refresh period (default 2.0)")
+    tp.add_argument("--once", action="store_true",
+                    help="scrape once, print one table, exit (scripts "
+                    "and CI; no screen clearing)")
+    tp.add_argument("--iterations", type=int, default=0, metavar="N",
+                    help="exit after N refreshes (0 = until Ctrl-C)")
+    tp.add_argument("--no-clear", action="store_true",
+                    help="append tables instead of clearing the screen")
 
     bd = sub.add_parser(
         "benchdiff", parents=[common],
@@ -1086,18 +1150,22 @@ def _parse_solver_opts(items) -> dict:
     return opts
 
 
-def _make_tracer(args, command: str):
+def _make_tracer(args, command: str, role=None):
     """The shared --trace plumbing (train/tune/serve/ingest): one Tracer
     receiving fault/retry/breaker lifecycle events AND the compile
     observatory's prof.compile records (lower/compile wall time, XLA
     cost analysis — tpusvm.obs.prof), plus a profile.capture event when
-    --profile/--xprof is also set so the trace names the capture dir."""
+    --profile/--xprof is also set so the trace names the capture dir.
+
+    role= makes the tracer a trace-context origin (serve/router): its
+    spans can be the resolved parents of other processes' spans when
+    trace files merge in `tpusvm report`."""
     if not getattr(args, "trace", None):
         return None
     from tpusvm import faults
     from tpusvm.obs import Tracer, prof
 
-    tracer = Tracer(args.trace, argv=[command],
+    tracer = Tracer(args.trace, argv=[command], role=role,
                     max_bytes=getattr(args, "trace_max_bytes", None))
     faults.set_event_sink(tracer.event)
     prof.enable_profiling(event_sink=tracer.event)
@@ -1750,6 +1818,21 @@ def _cmd_pod(args) -> int:
         # the library's "auto" f64-accumulator resolution
         warnings.simplefilter("ignore", UserWarning)
         accum = resolve_accum_dtype("auto")
+    tracer = None
+    if getattr(args, "trace_dir", None):
+        import os as _os
+
+        from tpusvm import faults
+        from tpusvm.obs import Tracer
+
+        _os.makedirs(args.trace_dir, exist_ok=True)
+        # the coordinator's own trace file; workers open theirs at spawn
+        # (pod_fit hands them the dir + this tracer's context) so the
+        # whole fleet stitches into one `tpusvm report` timeline
+        tracer = Tracer(_os.path.join(args.trace_dir, "coordinator.jsonl"),
+                        role="pod-coordinator", argv=["pod"],
+                        max_bytes=args.trace_max_bytes)
+        faults.set_event_sink(tracer.event)
     failures = []
     summaries = []
     with tempfile.TemporaryDirectory() as td:
@@ -1771,7 +1854,11 @@ def _cmd_pod(args) -> int:
                                sv_capacity=args.sv_capacity,
                                topology=topo)
             res = pod_fit(data, cfg, cc, accum_dtype=accum,
-                          verbose=not args.quiet)
+                          verbose=not args.quiet,
+                          tracer=tracer,
+                          trace_dir=getattr(args, "trace_dir", None),
+                          trace_max_bytes=getattr(
+                              args, "trace_max_bytes", None))
             if not args.quiet:
                 print(f"pod[{topo}]: {res.rounds} rounds, "
                       f"{len(res.sv_ids)} SVs, b = {res.b:.12f}, "
@@ -1808,6 +1895,14 @@ def _cmd_pod(args) -> int:
             if res.b != ctrl.b:
                 failures.append(f"[{topo}] b diverges: pod {res.b!r} "
                                 f"vs in-memory {ctrl.b!r}")
+    if tracer is not None:
+        from tpusvm import faults
+
+        faults.set_event_sink(None)
+        tracer.close()
+        if not args.quiet:
+            print(f"trace: {args.trace_dir} "
+                  f"(render with `tpusvm report {args.trace_dir}`)")
     if failures:
         for f in failures:
             print(f"POD{' SMOKE' if args.smoke else ''} FAILED: {f}")
@@ -2062,7 +2157,7 @@ def _cmd_serve(args) -> int:
         slo_window_s=args.slo_window_s,
         slo_shed=args.slo_shed,
     )
-    tracer = _make_tracer(args, "serve")
+    tracer = _make_tracer(args, "serve", role="serve")
 
     def _trace_final_metrics():
         if tracer is not None:
@@ -2175,6 +2270,9 @@ def _cmd_serve(args) -> int:
     from tpusvm.serve.http import make_http_server
 
     httpd = make_http_server(server, host=args.host, port=args.port)
+    # per-request serve.request spans honoring propagated X-Tpusvm-Trace
+    # contexts (a router in front re-parents them under its forwards)
+    httpd.tpusvm_tracer = tracer
     # close() now owns the HTTP teardown: shutdown + server_close (the
     # bound port is released) + thread join — no leaked listener
     server.attach_http(httpd)
@@ -2263,7 +2361,8 @@ def _cmd_router(args) -> int:
         forward_timeout_s=args.forward_timeout_s,
         skew_window=args.skew_window,
     )
-    router = Router(cfg).start()
+    tracer = _make_tracer(args, "router", role="router")
+    router = Router(cfg, tracer=tracer).start()
     httpd = make_router_http(router, host=args.host, port=args.port)
     router.attach_http(httpd)
     host, port = httpd.server_address[:2]
@@ -2280,6 +2379,7 @@ def _cmd_router(args) -> int:
         print(router.metrics_text(), end="")
         print(json.dumps(router.health()))
         router.close()
+        _close_tracer(tracer)
     return 0
 
 
@@ -3422,13 +3522,17 @@ def _cmd_report(args) -> int:
         autopilot_rows,
         compile_rows,
         convergence_rows,
+        cross_process_spans,
         format_autopilot_table,
         format_compile_table,
         format_convergence_table,
+        format_round_gantt,
+        format_timeline,
         merge_trace_files,
         nonzero_counters,
         phase_summary,
         render_phase_lines,
+        reparent_stats,
     )
 
     paths = _report_paths(args.path)
@@ -3474,6 +3578,24 @@ def _cmd_report(args) -> int:
         for line in counters:
             print(f"  {line}")
         print()
+    _, roles = cross_process_spans(records)
+    stats = None
+    if len(roles) > 1:
+        # a merged multi-process trace: the distributed-observability
+        # payoff — ONE timeline across the fleet, spans re-parented by
+        # the trace contexts propagated over frames/headers
+        stats = reparent_stats(records)
+        print(f"cross-process timeline ({stats['files']} files, "
+              f"roles: {', '.join(roles)}; "
+              f"{stats['reparented']} spans re-parented, "
+              f"{stats['unresolved']} unresolved):")
+        print(format_timeline(records, max_rows=args.max_rows))
+        print()
+        gantt = format_round_gantt(records)
+        if gantt:
+            print("pod rounds (gantt over the fit wall window):")
+            print(gantt)
+            print()
     print(render_phase_lines(phases, total))
 
     if args.smoke:
@@ -3482,12 +3604,216 @@ def _cmd_report(args) -> int:
             failures.append("no phase spans in the trace")
         if not conv:
             failures.append("no convergence records in the trace")
+        if stats is not None and stats["unresolved"]:
+            # every ctx-carrying file's root spans must have found their
+            # origin span — a propagation break would silently flatten
+            # the timeline otherwise
+            failures.append(
+                f"{stats['unresolved']} cross-process root span(s) "
+                "failed to re-parent under their propagated context")
         if failures:
             for f in failures:
                 print(f"REPORT SMOKE FAILED: {f}")
             return 1
+        extra = ""
+        if stats is not None:
+            extra = (f", {stats['files']} files/"
+                     f"{len(stats['roles'])} roles stitched "
+                     f"({stats['reparented']} re-parented)")
         print(f"report smoke ok: {len(phases)} phases, "
-              f"{len(conv)} convergence rounds")
+              f"{len(conv)} convergence rounds" + extra)
+    return 0
+
+
+def _fleet_collector(args):
+    """Build a FleetCollector from the shared --router/--replica/
+    --snapshot-file source flags (fleet-metrics and top)."""
+    from tpusvm.obs.fleet import FleetCollector
+
+    c = FleetCollector(timeout_s=args.timeout_s)
+    n = 0
+    if args.router:
+        c.add_router(args.router)
+        n += 1
+    for url in args.replicas:
+        c.add_replica(url)
+        n += 1
+    for path in args.snapshot_files:
+        c.add_file(path)
+        n += 1
+    if not n:
+        raise SystemExit(
+            f"{args.command}: no fleet sources — pass --router URL, "
+            "--replica URL (repeatable), and/or --snapshot-file PATH"
+            + (" (or --smoke)" if args.command == "fleet-metrics" else ""))
+    return c
+
+
+def _fleet_metrics_smoke(args) -> int:
+    """CI gate: an in-process two-replica fleet behind a router; the
+    merged fleet view must equal merge_fleet() of the per-process
+    payloads it scraped (exact), and the merged serve.ok total must
+    conserve the request count across the replicas (label-tagged)."""
+    import json as _json
+    import os
+    import tempfile
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tpusvm.config import SVMConfig
+    from tpusvm.data import rings
+    from tpusvm.models import BinarySVC
+    from tpusvm.obs.fleet import (
+        FleetCollector,
+        merge_fleet,
+        render_fleet_text,
+    )
+    from tpusvm.obs.registry import render_snapshot_text
+    from tpusvm.router import Router, RouterConfig
+    from tpusvm.serve import ServeConfig, Server
+    from tpusvm.serve.http import make_http_server, start_http_thread
+
+    failures = []
+    X, Y = rings(n=96, seed=2)
+    model = BinarySVC(SVMConfig(C=10.0, gamma=10.0),
+                      dtype=jnp.float32).fit(X, Y)
+    Xq = np.asarray(X[:8], float)
+    with tempfile.TemporaryDirectory() as td:
+        path = os.path.join(td, "m.npz")
+        model.save(path)
+        replicas, router = [], None
+        try:
+            urls = []
+            for _ in range(2):
+                srv = Server(ServeConfig(max_batch=8), dtype=jnp.float32)
+                srv.load_model("m", path)
+                srv.warmup()
+                httpd = make_http_server(srv, port=0)
+                srv.attach_http(httpd, start_http_thread(httpd))
+                host, port = httpd.server_address[:2]
+                urls.append(f"http://{host}:{port}")
+                replicas.append(srv)
+            router = Router(RouterConfig(
+                replicas=tuple(urls), replication=2, seed=3,
+                poll_interval_s=0.2, forward_timeout_s=10.0),
+                log_fn=lambda m: None)
+            router.start()
+            n_req, ok = 12, 0
+            for i in range(n_req):
+                body = _json.dumps(
+                    {"instances":
+                     [Xq[i % len(Xq)].tolist()]}).encode()
+                code, _, _ra = router.forward("m", body)
+                ok += int(code == 200)
+            if ok != n_req:
+                failures.append(f"only {ok}/{n_req} requests scored "
+                                "through the router")
+
+            # scrape the fleet the way `tpusvm fleet-metrics` does:
+            # every replica directly, plus the router's own payload
+            coll = FleetCollector(timeout_s=2.0)
+            for url in urls:
+                coll.add_replica(url)
+            coll.add_callable(router.fleet_payload, name="router")
+            view = coll.scrape_once()
+            if view.errors:
+                failures.append(f"scrape errors: {view.errors}")
+
+            # THE machine check: the published merged view is exactly
+            # merge_fleet() of the per-process payloads it scraped —
+            # byte-identical in rendered form
+            expect = merge_fleet(view.processes)
+            if render_snapshot_text(view.merged) \
+                    != render_snapshot_text(expect):
+                failures.append("merged view != merge_fleet() of the "
+                                "scraped per-process snapshots")
+
+            # conservation: the label-tagged per-replica serve.ok
+            # counters must sum to the routed request count in the
+            # SAME merged snapshot (no double count, no loss)
+            per_replica, total = {}, 0.0
+            for m in view.merged["metrics"]:
+                if m["name"] == "serve.ok" and m["type"] == "counter":
+                    inst = m["labels"].get("instance", "?")
+                    per_replica[inst] = per_replica.get(inst, 0.0) \
+                        + m["value"]
+                    total += m["value"]
+            if total != float(ok):
+                failures.append(
+                    f"merged serve.ok total {total} != {ok} routed "
+                    f"requests (per replica: {per_replica})")
+            if len(per_replica) != 2:
+                failures.append(
+                    f"expected 2 labelled replica instances, got "
+                    f"{sorted(per_replica)}")
+            if not args.quiet:
+                print(render_fleet_text(view))
+        finally:
+            if router is not None:
+                router.close()
+            for srv in replicas:
+                srv.close()
+    if failures:
+        for f in failures:
+            print(f"FLEET-METRICS SMOKE FAILED: {f}")
+        return 1
+    print(f"fleet-metrics smoke ok: 2 replicas + router merged "
+          f"exactly; serve.ok conserved at {n_req} across "
+          f"{sorted(per_replica)}")
+    return 0
+
+
+def _cmd_fleet_metrics(args) -> int:
+    """One merged, (role, instance)-labelled metrics view of a fleet."""
+    import json as _json
+
+    from tpusvm.obs.fleet import fleet_json, render_fleet_text
+
+    if args.smoke:
+        return _fleet_metrics_smoke(args)
+    coll = _fleet_collector(args)
+    view = coll.scrape_once()
+    if args.format == "json":
+        print(_json.dumps(fleet_json(view), sort_keys=True))
+    else:
+        print(render_fleet_text(view), end="")
+    # partial scrapes still print (ops reality: half a fleet view beats
+    # none), but a fleet that is ENTIRELY unreachable is an error
+    return 1 if view.errors and not view.processes else 0
+
+
+def _cmd_top(args) -> int:
+    """Live fleet table over the fleet-metrics sources."""
+    import time
+
+    from tpusvm.obs.fleet import format_top, top_rows
+
+    coll = _fleet_collector(args)
+    if args.once:
+        view = coll.scrape_once()
+        print(format_top(top_rows(view, coll.rates()),
+                         errors=view.errors), end="")
+        return 1 if view.errors and not view.processes else 0
+    t0 = time.monotonic()
+    i = 0
+    with coll:  # starts the scrape thread; stop() joins it on the way out
+        coll.start(interval_s=args.interval_s)
+        try:
+            while True:
+                view = coll.view()
+                out = format_top(top_rows(view, coll.rates()),
+                                 errors=view.errors,
+                                 clock_s=time.monotonic() - t0)
+                if not args.no_clear:
+                    print("\x1b[2J\x1b[H", end="")
+                print(out, end="", flush=True)
+                i += 1
+                if args.iterations and i >= args.iterations:
+                    break
+                time.sleep(args.interval_s)
+        except KeyboardInterrupt:
+            pass
     return 0
 
 
@@ -3565,6 +3891,7 @@ def main(argv=None) -> int:
             "tenants": _cmd_tenants, "router": _cmd_router,
             "tune": _cmd_tune, "info": _cmd_info,
             "report": _cmd_report,
+            "fleet-metrics": _cmd_fleet_metrics, "top": _cmd_top,
             "benchdiff": _cmd_benchdiff}[args.command](args)
 
 

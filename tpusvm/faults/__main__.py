@@ -278,6 +278,58 @@ def _pod_chaos_smoke() -> int:
             r2 = pod_fit(ds, cfg, cc, checkpoint_path=ck, resume=True)
             check("coordinator-resume arm", r2)
 
+        # arm 3: the worker-kill arm again, TRACED — chaos must not
+        # break the distributed trace fabric. The killed worker's file
+        # truncates at its last request (SIGKILL leaves no torn span
+        # line), the REVIVED worker opens a fresh per-pid file whose
+        # root spans re-parent under the coordinator via the propagated
+        # context, and the fit stays bit-identical to control
+        from tpusvm.obs import Tracer
+        from tpusvm.obs.report import merge_trace_files, render_report, \
+            reparent_stats
+
+        tdir = os.path.join(td, "trace")
+        os.makedirs(tdir)
+        tracer = Tracer(os.path.join(tdir, "coordinator.jsonl"),
+                        role="pod-coordinator", argv=["pod-chaos"])
+        faults.set_event_sink(tracer.event)
+        try:
+            r3 = pod_fit(ds, cfg, cc, worker_faults={1: plan},
+                         tracer=tracer, trace_dir=tdir)
+        finally:
+            faults.set_event_sink(None)
+            tracer.close()
+        if r3.revives < 1:
+            failures.append("traced arm: the kill never fired "
+                            "(zero revives)")
+        check("traced arm", r3)
+        tfiles = sorted(
+            os.path.join(tdir, f) for f in os.listdir(tdir)
+            if f.endswith(".jsonl"))
+        # 1 coordinator + 4 workers + >=1 revived worker (fresh pid,
+        # fresh file): the kill must be VISIBLE in the file census
+        if len(tfiles) < 6:
+            failures.append(
+                f"traced arm: expected >=6 trace files (coordinator + "
+                f"4 workers + revived worker), found {len(tfiles)}")
+        try:
+            recs = merge_trace_files(tfiles)
+            stats = reparent_stats(recs)
+            if "pod-worker" not in stats["roles"]:
+                failures.append("traced arm: no pod-worker spans in "
+                                "the merged timeline")
+            if stats["unresolved"]:
+                failures.append(
+                    f"traced arm: {stats['unresolved']} root span(s) "
+                    "failed to re-parent (revived worker's context "
+                    "broken?)")
+            if not stats["reparented"]:
+                failures.append("traced arm: zero spans re-parented "
+                                "across processes")
+            render_report(recs)  # the merged timeline must render
+        except (ValueError, KeyError) as e:
+            failures.append(f"traced arm: merged trace unusable: {e}")
+
     if failures:
         for f in failures:
             print(f"POD CHAOS SMOKE FAILED: {f}")
@@ -285,7 +337,10 @@ def _pod_chaos_smoke() -> int:
     print(f"pod chaos smoke ok: {ctrl.rounds} rounds, "
           f"{len(ctrl_ids)} SVs, worker SIGKILL revived "
           f"({r1.revives} revive) and coordinator kill resumed — both "
-          "bit-identical to the uninterrupted control, zero rows lost")
+          "bit-identical to the uninterrupted control, zero rows lost; "
+          f"traced re-run stitched {stats['files']} files "
+          f"({stats['reparented']} spans re-parented, 0 unresolved) "
+          "while staying bit-identical")
     return 0
 
 

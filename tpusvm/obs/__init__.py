@@ -9,8 +9,12 @@ Three pillars (see each module's docstring):
                    (the span adapter preserving the reference's
                    three-line timing contract);
   convergence.py — host half of the solver's carry-resident convergence
-                   ring (device half: solver/blocked.py telemetry=T).
-report.py renders all of it (`tpusvm report <trace.jsonl>`).
+                   ring (device half: solver/blocked.py telemetry=T);
+  fleet.py       — cross-process aggregation: per-process snapshot
+                   payloads merged into one (role, instance)-labelled
+                   fleet view (`tpusvm fleet-metrics` / `tpusvm top`).
+report.py renders all of it (`tpusvm report <trace.jsonl>`), including
+the cross-process timeline stitched from propagated trace contexts.
 """
 
 from tpusvm.obs.registry import (
@@ -20,7 +24,21 @@ from tpusvm.obs.registry import (
     render_snapshot_text,
     reset_default_registry,
 )
-from tpusvm.obs.trace import PhaseTimer, Tracer, read_trace
+from tpusvm.obs.trace import (
+    TRACE_HEADER,
+    PhaseTimer,
+    TraceContext,
+    Tracer,
+    read_trace,
+)
+from tpusvm.obs.fleet import (
+    FleetCollector,
+    format_top,
+    merge_fleet,
+    render_fleet_text,
+    snapshot_payload,
+    top_rows,
+)
 from tpusvm.obs.convergence import (
     ConvergenceTelemetry,
     format_gap_table,
@@ -30,15 +48,22 @@ from tpusvm.obs.convergence import (
 
 __all__ = [
     "ConvergenceTelemetry",
+    "FleetCollector",
     "MetricsRegistry",
     "PhaseTimer",
+    "TRACE_HEADER",
+    "TraceContext",
     "Tracer",
     "default_registry",
     "format_gap_table",
+    "format_top",
     "materialize",
+    "merge_fleet",
     "merge_snapshots",
     "read_trace",
+    "render_fleet_text",
     "render_snapshot_text",
     "reset_default_registry",
+    "snapshot_payload",
     "to_trace_events",
 ]

@@ -237,6 +237,28 @@ SCHEMA_RULES: Dict[str, Tuple[Rule, ...]] = {
         Rule("rows_per_s", ">=", rel_tol=0.35, timing=True),
         Rule("pod_overhead_x", "<=", rel_tol=0.5, timing=True),
     ),
+    # distributed observability fabric (benchmarks/obs_fabric.py): rows
+    # pair on (bench, topology, P, n, smoke). The tracing capability
+    # must stay FREE of model consequence — bit_identical (traced fit ==
+    # untraced control: SV-ID set, alpha bytes, b) is the DEFAULT_RULES
+    # exact gate — and the trace itself must stay USABLE: reparented_ok
+    # (every cross-process root found its propagated parent, none
+    # unresolved) and report_ok (the merged dir renders as one timeline)
+    # are the fabric's own verdicts, exact. The wall-clock price of
+    # tracing (overhead_frac, absolute band like telemetry_overhead's)
+    # is gated at full level only so the committed smoke baseline stays
+    # machine-portable.
+    "obs_fabric": (
+        Rule("converged", "=="),
+        Rule("reparented_ok", "=="),
+        Rule("report_ok", "=="),
+        Rule("sv_count", "=="),
+        Rule("rounds", "=="),
+        Rule("unresolved_spans", "=="),
+        Rule("overhead_frac", "<=", abs_tol=0.03, timing=True),
+        Rule("t_on_s", "<=", rel_tol=0.5, timing=True),
+        Rule("t_off_s", "<=", rel_tol=0.5, timing=True),
+    ),
 }
 
 

@@ -133,6 +133,200 @@ def merge_trace_files(paths: List[str]) -> List[dict]:
     return out
 
 
+def cross_process_spans(records: Iterable[dict]
+                        ) -> Tuple[List[dict], List[str]]:
+    """Resolve cross-process parentage over merged trace records.
+
+    Two propagation mechanisms re-parent spans across files:
+
+      * file-level: a process spawned WITH a context (pod worker,
+        `ctx=` at Tracer construction) carries it in its meta record —
+        the file's root spans parent under the originating span;
+      * span-level: a span whose attrs carry a ``ctx`` dict (a worker's
+        per-request train span, a replica's serve.request) parents
+        under exactly the originating span named there.
+
+    A context resolves when a merged file's meta matches its
+    (trace_id, role, pid) — the origin identity a role-ful Tracer
+    writes. Unresolvable contexts (origin file not merged in, junk)
+    degrade to the span's local parentage.
+
+    Returns (spans, roles): each span is its record plus
+      _gid      globally-unique id "<file#>:<id>"
+      _gparent  resolved parent gid (local parent, or the propagated
+                target for cross-process roots); None for true roots
+      _role     the file's role (meta), "main" when the file has none
+      _pid      the file's pid (meta), None when absent
+    in merged (_wall) order, and roles is the sorted distinct role set.
+    """
+    from tpusvm.obs.trace import TraceContext
+
+    files: Dict[str, dict] = {}
+    order: List[str] = []
+    for r in records:
+        f = r.get("_file", "")
+        if f not in files:
+            files[f] = {"meta": None, "spans": []}
+            order.append(f)
+        if r["kind"] == "meta" and files[f]["meta"] is None:
+            files[f]["meta"] = r
+        elif r["kind"] == "span":
+            files[f]["spans"].append(r)
+    fidx = {f: i for i, f in enumerate(order)}
+    origin: Dict[Tuple[str, str, int], str] = {}
+    for f in order:
+        m = files[f]["meta"] or {}
+        if m.get("trace_id") and m.get("role") and m.get("pid") is not None:
+            origin[(m["trace_id"], m["role"], m["pid"])] = f
+
+    def resolve(ctx_dict):
+        ctx = TraceContext.from_dict(ctx_dict)
+        if ctx is None or ctx.span_id is None:
+            return None
+        f = origin.get((ctx.trace_id, ctx.role, ctx.pid))
+        if f is None:
+            return None
+        return f"{fidx[f]}:{ctx.span_id}"
+
+    spans: List[dict] = []
+    roles = set()
+    for f in order:
+        m = files[f]["meta"] or {}
+        role = m.get("role") or "main"
+        roles.add(role)
+        file_parent = resolve(m.get("ctx")) if m.get("ctx") else None
+        for r in files[f]["spans"]:
+            attrs = r.get("attrs") or {}
+            gparent = None
+            if attrs.get("ctx"):
+                gparent = resolve(attrs["ctx"])
+            if gparent is None and r.get("parent") is not None:
+                gparent = f"{fidx[f]}:{r['parent']}"
+            if gparent is None and r.get("parent") is None:
+                gparent = file_parent
+            spans.append({**r, "_gid": f"{fidx[f]}:{r['id']}",
+                          "_gparent": gparent, "_role": role,
+                          "_pid": m.get("pid")})
+    spans.sort(key=lambda s: s.get("_wall", s.get("t0", 0.0)))
+    return spans, sorted(roles)
+
+
+def reparent_stats(records: Iterable[dict]) -> dict:
+    """Machine-checkable re-parenting summary for a merged trace dir.
+
+    `unresolved` counts root spans of ctx-carrying files that FAILED to
+    re-parent (their origin span should be in the merged set — the
+    chaos gate and `report --smoke` assert this stays 0)."""
+    from tpusvm.obs.trace import TraceContext
+
+    recs = list(records)
+    spans, roles = cross_process_spans(recs)
+    ctx_files = set()
+    for r in recs:
+        if r["kind"] == "meta" and TraceContext.from_dict(
+                r.get("ctx")) is not None:
+            ctx_files.add(r.get("_file", ""))
+    unresolved = sum(
+        1 for s in spans
+        if s.get("_file", "") in ctx_files and s.get("parent") is None
+        and s["_gparent"] is None)
+    reparented = sum(
+        1 for s in spans
+        if s["_gparent"] is not None
+        and s["_gparent"].split(":")[0] != s["_gid"].split(":")[0])
+    return {"files": len({s.get("_file", "") for s in spans}),
+            "roles": roles, "spans": len(spans),
+            "reparented": reparented, "unresolved": unresolved}
+
+
+def _span_attr_brief(attrs: dict, limit: int = 40) -> str:
+    parts = []
+    for k in ("round", "req", "leaf", "shard", "model", "topology",
+              "rows", "n_leaves"):
+        if k in attrs:
+            parts.append(f"{k}={attrs[k]}")
+    s = " ".join(parts)
+    return s if len(s) <= limit else s[:limit - 3] + "..."
+
+
+def format_timeline(records: Iterable[dict], max_rows: int = 60) -> str:
+    """The cross-process timeline: one line per span in wall order,
+    per-role lanes, indentation by RESOLVED depth (a worker's train span
+    indents under the coordinator's round span it was re-parented to).
+    Long traces elide the middle like the convergence table."""
+    spans, roles = cross_process_spans(records)
+    if not spans:
+        return "no spans in this trace"
+    by_gid = {s["_gid"]: s for s in spans}
+
+    def depth(s):
+        d, cur, seen = 0, s, set()
+        while cur["_gparent"] is not None and cur["_gparent"] in by_gid:
+            if cur["_gid"] in seen:  # defensive: never loop on bad data
+                break
+            seen.add(cur["_gid"])
+            cur = by_gid[cur["_gparent"]]
+            d += 1
+        return d
+
+    base = min(s.get("_wall", s.get("t0", 0.0)) for s in spans)
+    role_w = max(len(r) for r in roles)
+    out = [f"{'start_ms':>10}  {'dur_ms':>9}  {'role':<{role_w}}  span",
+           f"{'--------':>10}  {'------':>9}  {'----':<{role_w}}  ----"]
+    idx = list(range(len(spans)))
+    if len(idx) > max_rows:
+        k = max_rows // 2
+        idx = idx[:k] + [None] + idx[-k:]
+    for i in idx:
+        if i is None:
+            out.append(f"  ... {len(spans) - 2 * (max_rows // 2)} "
+                       "spans elided ...")
+            continue
+        s = spans[i]
+        t = s.get("_wall", s.get("t0", 0.0)) - base
+        brief = _span_attr_brief(s.get("attrs") or {})
+        name = "  " * min(depth(s), 8) + s["name"]
+        line = (f"{t * 1e3:>10.1f}  {s['dur_s'] * 1e3:>9.1f}  "
+                f"{s['_role']:<{role_w}}  {name}")
+        if brief:
+            line += f"  [{brief}]"
+        out.append(line)
+    return "\n".join(out)
+
+
+def format_round_gantt(records: Iterable[dict], width: int = 32) -> str:
+    """Round-level gantt over the pod fit's wall window: one bar per
+    coordinator pod.round span, with the worker spans that landed
+    inside each round's window counted per role."""
+    spans, _ = cross_process_spans(records)
+    rounds = [s for s in spans if s["name"] == "pod.round"]
+    if not rounds:
+        return ""
+    lo = min(s.get("_wall", s.get("t0", 0.0)) for s in spans)
+    hi = max(s.get("_wall", s.get("t0", 0.0)) + s["dur_s"] for s in spans)
+    total = max(hi - lo, 1e-9)
+    out = [f"{'round':>5}  {'start_ms':>9}  {'dur_ms':>9}  "
+           f"{'window':<{width}}  worker spans"]
+    for s in rounds:
+        t0 = s.get("_wall", s.get("t0", 0.0))
+        t1 = t0 + s["dur_s"]
+        a = int((t0 - lo) / total * width)
+        b = max(a + 1, int((t1 - lo) / total * width))
+        bar = "." * a + "#" * (b - a) + "." * (width - b)
+        inside: Dict[str, int] = {}
+        for w in spans:
+            if w["_role"] == s["_role"] or w["kind"] != "span":
+                continue
+            wt = w.get("_wall", w.get("t0", 0.0))
+            if t0 <= wt <= t1:
+                inside[w["_role"]] = inside.get(w["_role"], 0) + 1
+        counts = " ".join(f"{r}:{n}" for r, n in sorted(inside.items()))
+        rnd = (s.get("attrs") or {}).get("round", "?")
+        out.append(f"{rnd:>5}  {(t0 - lo) * 1e3:>9.1f}  "
+                   f"{s['dur_s'] * 1e3:>9.1f}  {bar}  {counts}")
+    return "\n".join(out)
+
+
 def compile_rows(records: Iterable[dict]) -> List[dict]:
     """The prof.compile events (tpusvm.obs.prof), in record order."""
     return [r["attrs"] for r in records
@@ -269,6 +463,20 @@ def render_report(records: List[dict]) -> str:
     if auto:
         parts += ["autopilot (drift decisions per tick):",
                   format_autopilot_table(auto), ""]
+    _, roles = cross_process_spans(records)
+    if len(roles) > 1:
+        # a merged multi-process trace: stitch ONE timeline across the
+        # fleet (propagated contexts re-parent worker/replica spans)
+        stats = reparent_stats(records)
+        parts += [f"cross-process timeline ({stats['files']} files, "
+                  f"roles: {', '.join(roles)}; "
+                  f"{stats['reparented']} spans re-parented, "
+                  f"{stats['unresolved']} unresolved):",
+                  format_timeline(records), ""]
+        gantt = format_round_gantt(records)
+        if gantt:
+            parts += ["pod rounds (gantt over the fit wall window):",
+                      gantt, ""]
     counters = nonzero_counters(records)
     if counters:
         parts += ["counters:"] + ["  " + line for line in counters] + [""]

@@ -16,6 +16,16 @@ and a crashed run still holds every completed span. Timestamps come from
 an injectable monotonic clock — tests pass a counter and get a
 bit-stable file; production uses time.perf_counter.
 
+Cross-process propagation: a Tracer constructed with `role=` can mint a
+compact TraceContext (`Tracer.ctx()`) naming (trace_id, innermost open
+span id, role, pid). The context travels in pod protocol frames (a free
+``ctx`` meta key) or as the ``X-Tpusvm-Trace`` HTTP header, and the
+receiving process opens its OWN Tracer with `ctx=` — its meta record
+then carries the propagated context, and `tpusvm report` over the merged
+files re-parents each file's root spans under the originating span
+(obs.report.cross_process_spans). Tracers without a role write exactly
+the meta record they always did, byte-for-byte.
+
 `tpusvm report <trace.jsonl>` renders these files (tpusvm.obs.report);
 `read_trace` is the version-gated parser everything shares.
 
@@ -31,12 +41,85 @@ batches all come out in one trace file.
 from __future__ import annotations
 
 import contextlib
+import dataclasses
 import json
+import os
 import threading
 import time
+import uuid
 from typing import Any, Dict, Iterator, List, Optional
 
 TRACE_SCHEMA_VERSION = 1
+
+# HTTP header carrying a serialized TraceContext (router → replica).
+TRACE_HEADER = "X-Tpusvm-Trace"
+
+# Version prefix of the header wire format; bump on incompatible change.
+_CTX_WIRE_VERSION = "1"
+
+
+@dataclasses.dataclass(frozen=True)
+class TraceContext:
+    """Compact cross-process trace context.
+
+    Names the span a remote process should parent its own root spans
+    under: the originating run's trace_id, the id of the span open at
+    mint time (None when minted outside any span — the receiver then
+    parents under the origin file's root), and the origin's role/pid so
+    the merged report can find the originating trace file.
+    """
+
+    trace_id: str
+    span_id: Optional[int]
+    role: str
+    pid: int
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"trace_id": self.trace_id, "span_id": self.span_id,
+                "role": self.role, "pid": self.pid}
+
+    @classmethod
+    def from_dict(cls, d: Any) -> Optional["TraceContext"]:
+        """Parse a ctx dict; returns None on anything malformed (a peer
+        speaking a newer/older dialect must degrade to 'no context',
+        never to a crash)."""
+        if not isinstance(d, dict):
+            return None
+        trace_id, role, pid = d.get("trace_id"), d.get("role"), d.get("pid")
+        span_id = d.get("span_id")
+        if not isinstance(trace_id, str) or not isinstance(role, str):
+            return None
+        if not isinstance(pid, int) or isinstance(pid, bool):
+            return None
+        if span_id is not None and (
+                not isinstance(span_id, int) or isinstance(span_id, bool)):
+            return None
+        return cls(trace_id=trace_id, span_id=span_id, role=role, pid=pid)
+
+    def to_header(self) -> str:
+        """Serialize for the X-Tpusvm-Trace header:
+        ``1;<trace_id>;<span_id|->;<role>;<pid>``."""
+        sid = "-" if self.span_id is None else str(self.span_id)
+        return ";".join([_CTX_WIRE_VERSION, self.trace_id, sid,
+                         self.role, str(self.pid)])
+
+    @classmethod
+    def from_header(cls, value: Optional[str]) -> Optional["TraceContext"]:
+        """Parse a header value; None on absent/junk/unknown version."""
+        if not value or not isinstance(value, str):
+            return None
+        parts = value.strip().split(";")
+        if len(parts) != 5 or parts[0] != _CTX_WIRE_VERSION:
+            return None
+        _, trace_id, sid, role, pid = parts
+        if not trace_id or not role:
+            return None
+        try:
+            span_id = None if sid == "-" else int(sid)
+            return cls(trace_id=trace_id, span_id=span_id, role=role,
+                       pid=int(pid))
+        except ValueError:
+            return None
 
 
 def _jsonable(x: Any) -> Any:
@@ -58,13 +141,29 @@ class Tracer:
       clock: monotonic float clock — injectable so tests are
         deterministic (default time.perf_counter).
       wall: wall-clock for the meta record only (default time.time).
+      role: fleet role name ("pod-coordinator", "pod-worker", "router",
+        "serve", ...). Setting it marks this tracer as a cross-process
+        participant: the meta record gains role/pid/trace_id and
+        `ctx()` becomes mintable. Without it the meta record is
+        byte-identical to what older builds wrote.
+      ctx: the propagated TraceContext this process was SPAWNED with —
+        recorded in the meta so the merged report re-parents this
+        file's root spans under the originating span. Implies the
+        origin's trace_id unless one is given explicitly.
+      trace_id: explicit correlation id (tests inject a fixed one;
+        default a fresh random id when role is set).
     """
 
     def __init__(self, path: str, clock=None, wall=None,
                  argv: Optional[List[str]] = None,
-                 max_bytes: Optional[int] = None):
+                 max_bytes: Optional[int] = None,
+                 role: Optional[str] = None,
+                 ctx: Optional[TraceContext] = None,
+                 trace_id: Optional[str] = None):
         if max_bytes is not None and max_bytes < 1:
             raise ValueError(f"max_bytes must be >= 1, got {max_bytes}")
+        if role is not None and ";" in role:
+            raise ValueError(f"role must not contain ';': {role!r}")
         self._clock = clock or time.perf_counter
         self._wall = wall or time.time
         self._lock = threading.Lock()
@@ -72,6 +171,12 @@ class Tracer:
         self._next_id = 0
         self._f = open(path, "a")
         self.path = path
+        self.role = role
+        self.pid = os.getpid()
+        if trace_id is None and (role is not None or ctx is not None):
+            trace_id = ctx.trace_id if ctx is not None else uuid.uuid4().hex[:16]
+        self.trace_id = trace_id
+        self.parent_ctx = ctx
         # size-capped rotation (serve --trace runs for days; an unbounded
         # append-only file is a disk-filler): when the current file would
         # exceed max_bytes it becomes `path.1` (overwriting — the records
@@ -88,6 +193,16 @@ class Tracer:
         self._t0 = self._meta["t0"]
         if argv is not None:
             self._meta["argv"] = list(argv)
+        # cross-process identity keys are OPT-IN: a role-less, ctx-less
+        # tracer keeps writing the exact meta record older builds wrote
+        # (deterministic-file tests diff these bytes).
+        if self.trace_id is not None:
+            self._meta["trace_id"] = self.trace_id
+        if role is not None:
+            self._meta["role"] = role
+            self._meta["pid"] = self.pid
+        if ctx is not None:
+            self._meta["ctx"] = ctx.to_dict()
         self._write(self._meta)
 
     # ------------------------------------------------------------ plumbing
@@ -168,6 +283,19 @@ class Tracer:
             "parent": stack[-1] if stack else None,
             "name": name, "ts": self._clock(), "attrs": attrs,
         })
+
+    def ctx(self) -> TraceContext:
+        """Mint a TraceContext naming the calling thread's innermost open
+        span (None outside any span) as the remote parent. Requires a
+        role — anonymous tracers have no fleet identity to propagate."""
+        if self.role is None:
+            raise ValueError(
+                "Tracer.ctx() needs a role= at construction; an anonymous "
+                "tracer has no cross-process identity to propagate")
+        stack = self._stack()
+        return TraceContext(trace_id=self.trace_id,
+                            span_id=stack[-1] if stack else None,
+                            role=self.role, pid=self.pid)
 
     def metrics_snapshot(self, snapshot: dict) -> None:
         """Embed a registry snapshot (obs.registry) as an event, so one

@@ -49,7 +49,7 @@ import numpy as np
 
 from tpusvm import faults
 from tpusvm.config import CascadeConfig, SVMConfig, resolve_accum_dtype
-from tpusvm.pod.protocol import recv_msg, send_msg
+from tpusvm.pod.protocol import attach_ctx, recv_msg, send_msg
 from tpusvm.pod.state import (
     check_pod_round_state_config,
     load_pod_round_state,
@@ -115,12 +115,14 @@ class _Pod:
 
     def __init__(self, data: str, n_leaves: int, init_meta: dict,
                  prefetch_depth: int,
-                 worker_faults: Optional[Dict[int, str]] = None):
+                 worker_faults: Optional[Dict[int, str]] = None,
+                 tracer=None):
         self.data = data
         self.n_leaves = n_leaves
         self.init_meta = init_meta
         self.prefetch_depth = prefetch_depth
         self.worker_faults = dict(worker_faults or {})
+        self.tracer = tracer
         self.workers = [_Worker(r) for r in range(n_leaves)]
         self.revives = 0
         self._req = 0
@@ -211,12 +213,17 @@ class _Pod:
         arrays = _buf_to_arrays(recv_buf, "recv_")
         if own_buf is not None:
             arrays.update(_buf_to_arrays(own_buf, "own_"))
+        meta = {
+            "op": "train",
+            "req": req,
+            "use_partition": own_buf is None,
+        }
+        if self.tracer is not None and self.tracer.role is not None:
+            # per-request context: the worker's train span re-parents
+            # under the coordinator's CURRENT open span (pod.round)
+            meta = attach_ctx(meta, self.tracer.ctx())
         try:
-            send_msg(self.workers[r].sock, {
-                "op": "train",
-                "req": req,
-                "use_partition": own_buf is None,
-            }, arrays)
+            send_msg(self.workers[r].sock, meta, arrays)
         except (OSError, ConnectionError) as e:
             raise _WorkerDied(r, repr(e)) from e
         return req
@@ -234,6 +241,34 @@ class _Pod:
             if meta.get("op") != "result" or meta.get("req") != req:
                 continue
             return meta, _buf_from_arrays(arrays, "sv_")
+
+    def snapshots(self, timeout_s: float = 10.0) -> List[dict]:
+        """Fetch every live worker's registry snapshot over the socket
+        (the SNAPSHOT op). Dead/unresponsive workers are skipped — this
+        is telemetry, not training; it must never fail a fit."""
+        out: List[dict] = []
+        for w in self.workers:
+            if w.sock is None:
+                continue
+            self._req += 1
+            req = self._req
+            try:
+                w.sock.settimeout(timeout_s)
+                send_msg(w.sock, {"op": "snapshot", "req": req})
+                while True:
+                    meta, _ = recv_msg(w.sock)
+                    if meta.get("op") == "snapshot_reply" \
+                            and meta.get("req") == req:
+                        out.append({"worker_id": w.worker_id,
+                                    "pid": meta.get("pid"),
+                                    "snapshot": meta["snapshot"]})
+                        break
+            except (OSError, ConnectionError, KeyError, ValueError):
+                continue
+            finally:
+                with contextlib.suppress(OSError):
+                    w.sock.settimeout(None)
+        return out
 
     def shutdown(self) -> None:
         for w in self.workers:
@@ -359,6 +394,8 @@ def pod_fit(
     worker_faults: Optional[Dict[int, str]] = None,
     max_revives: int = 8,
     tracer=None,
+    trace_dir: Optional[str] = None,
+    trace_max_bytes: Optional[int] = None,
 ) -> PodResult:
     """Train a binary SVM with the pod (multi-process) cascade.
 
@@ -384,6 +421,16 @@ def pod_fit(
     max_revives: total worker revivals tolerated before the fit gives
     up (a worker that dies deterministically on every respawn would
     otherwise re-run the round forever).
+
+    trace_dir: cross-process tracing — requires a `tracer` constructed
+    with a role (it minted identity propagates). Every worker opens its
+    own Tracer in this directory (one file per worker PID — a revived
+    worker starts a fresh file) with the coordinator's TraceContext
+    from the INIT frame, and each TRAIN frame carries the current
+    pod.round span's context, so `tpusvm report <trace_dir>` stitches
+    the whole fit into one timeline. Tracing is observation only: the
+    traced fit is bit-identical to an untraced control
+    (benchmarks/obs_fabric.py gates this).
     """
     from tpusvm.parallel.svbuffer import SVBuffer, empty
     from tpusvm.stream.assign import assign_rows
@@ -468,8 +515,28 @@ def pod_fit(
         "train_cap": int(train_cap),
         "sv_cap": int(sv_cap),
     }
+    fit_span = None
+    if trace_dir is not None:
+        if tracer is None or tracer.role is None:
+            raise ValueError(
+                "trace_dir needs a tracer constructed with role= (the "
+                "workers parent their spans under its minted context)")
+        os.makedirs(trace_dir, exist_ok=True)
+    if tracer is not None:
+        # opened manually (closed in the outer finally) so the whole
+        # fit — spawn, rounds, revivals, shutdown — is one span the
+        # workers' propagated contexts parent under
+        fit_span = tracer.span("pod.fit", phase=True,
+                               topology=cc.topology, n_leaves=n_leaves)
+        fit_span.__enter__()
+    if trace_dir is not None:
+        init_meta["trace"] = {
+            "dir": os.path.abspath(trace_dir),
+            "max_bytes": trace_max_bytes,
+            "ctx": tracer.ctx().to_dict(),
+        }
     pod = _Pod(data, n_leaves, init_meta, prefetch_depth,
-               worker_faults=worker_faults)
+               worker_faults=worker_faults, tracer=tracer)
 
     new_global = jax.tree.map(np.asarray, global_sv)
     round_retry = faults.Retry(faults.DEFAULT_IO_POLICY, op="pod.round")
@@ -568,6 +635,21 @@ def pod_fit(
                     iters=diag["iters"].tolist(),
                     status=diag["status"].tolist(),
                 )
+                # the report's shared convergence surface (the same
+                # record cascade_fit emits), so `tpusvm report` renders
+                # a pod trace's round table without a special case
+                # worst status over the leaves that solved this round
+                # (-1 marks a leaf with no diagnostic — skip it)
+                sts = [int(s)
+                       for s in np.asarray(diag["status"]).ravel()
+                       if int(s) >= 0]
+                tracer.event(
+                    "convergence.round",
+                    round=rnd,
+                    updates=int(np.asarray(diag["iters"]).sum()),
+                    active=len(ids_now),
+                    status=Status(max(sts)).name if sts else "n/a",
+                )
             bad = diag["status"][
                 diag["status"] >= int(Status.INFEASIBLE_UV)]
             if bad.size:
@@ -609,7 +691,24 @@ def pod_fit(
                 *(jnp.asarray(getattr(new_global, f))
                   for f in SVBuffer._fields))
     finally:
+        if tracer is not None:
+            # fleet telemetry, best-effort: every live worker's registry
+            # snapshot (label-tagged, merged with the coordinator's own)
+            # lands in the trace before the fleet is torn down
+            with contextlib.suppress(Exception):
+                from tpusvm.obs.fleet import merge_fleet, snapshot_payload
+                from tpusvm.obs.registry import default_registry
+
+                parts = [snapshot_payload(
+                    "pod-worker", f"w{s['worker_id']}", s["snapshot"],
+                    pid=s.get("pid")) for s in pod.snapshots()]
+                parts.append(snapshot_payload(
+                    "pod-coordinator", "coordinator",
+                    default_registry().snapshot()))
+                tracer.metrics_snapshot(merge_fleet(parts))
         pod.shutdown()
+        if fit_span is not None:
+            fit_span.__exit__(None, None, None)
 
     mask = np.asarray(new_global.valid)
     return PodResult(

@@ -15,6 +15,11 @@ cascade. Meta is a small JSON object (op names, counts, scalars).
 Framing is explicit-length on purpose: a worker SIGKILLed mid-write
 leaves a SHORT frame, which the reader surfaces as ConnectionError
 (peer death), never as a truncated-but-parsed message.
+
+Trace context rides in the meta object under an optional ``ctx`` key
+(`attach_ctx`/`extract_ctx`) — meta is free-form JSON, so old peers
+that predate the key simply ignore it and old frames (no key) parse
+unchanged; `extract_ctx` degrades junk to None rather than raising.
 """
 
 from __future__ import annotations
@@ -56,6 +61,25 @@ def send_msg(sock: socket.socket, meta: dict,
         ab = b""
     frame = struct.pack(">I", len(mb)) + mb + ab
     sock.sendall(struct.pack(">I", len(frame)) + frame)
+
+
+def attach_ctx(meta: dict, ctx) -> dict:
+    """Return a copy of meta carrying a TraceContext under ``ctx``.
+
+    No-op passthrough when ctx is None, so call sites don't branch."""
+    if ctx is None:
+        return meta
+    out = dict(meta)
+    out["ctx"] = ctx.to_dict()
+    return out
+
+
+def extract_ctx(meta: dict):
+    """The TraceContext carried in a frame's meta, or None (absent key,
+    pre-ctx peer, or malformed payload — never an exception)."""
+    from tpusvm.obs.trace import TraceContext
+
+    return TraceContext.from_dict(meta.get("ctx"))
 
 
 def recv_msg(sock: socket.socket

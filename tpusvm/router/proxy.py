@@ -38,9 +38,11 @@ plus the poller's router.replica_state / router.replicas_up gauges.
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 import http.client
 import json
+import os
 import threading
 import urllib.error
 import urllib.request
@@ -73,16 +75,20 @@ class RouterConfig:
                 f"replication must be >= 1, got {self.replication}")
 
 
-def _http_post(url: str, body: bytes, timeout_s: float
+def _http_post(url: str, body: bytes, timeout_s: float,
+               headers: Optional[Dict[str, str]] = None
                ) -> Tuple[int, bytes, Optional[str]]:
     """One real forward attempt: (code, body, Retry-After header).
 
     HTTP error codes come back AS codes (a 429/503 carries a payload the
     client should see); connection-level failures — refused, reset,
     timeout, DNS — are raised as the retryable TransientIOError class so
-    the shared retry policy classifies them exactly like a flaky disk."""
+    the shared retry policy classifies them exactly like a flaky disk.
+
+    `headers` (trace-context injection) merge over the JSON default."""
     req = urllib.request.Request(
-        url, data=body, headers={"Content-Type": "application/json"},
+        url, data=body,
+        headers={"Content-Type": "application/json", **(headers or {})},
         method="POST")
     try:
         with urllib.request.urlopen(req, timeout=timeout_s) as resp:
@@ -113,7 +119,8 @@ class Router:
     def __init__(self, config: RouterConfig = RouterConfig(),
                  transport: Callable = _http_post,
                  fetch=None, registry=None,
-                 log_fn: Optional[Callable[[str], None]] = print):
+                 log_fn: Optional[Callable[[str], None]] = print,
+                 tracer=None):
         if registry is None:
             from tpusvm.obs.registry import default_registry
 
@@ -122,6 +129,8 @@ class Router:
         self.log = log_fn or (lambda msg: None)
         self._transport = transport
         self._registry = registry
+        self._tracer = tracer
+        self.instance = f"router-{os.getpid()}"
         self.replica_set = ReplicaSet(config.replicas,
                                       k=config.replication,
                                       seed=config.seed)
@@ -152,13 +161,39 @@ class Router:
 
     # -------------------------------------------------------- forwarding
     def forward(self, model: str, body: bytes,
-                suffix: str = ":predict"
+                suffix: str = ":predict", ctx=None
                 ) -> Tuple[int, bytes, Optional[str]]:
         """Forward a predict-class request; (code, body, Retry-After).
 
         Retries the next placement on connection failure or replica 503
         (one attempt per candidate, DEFAULT_IO_POLICY backoff between
-        attempts); 429 returns immediately — see the module doc."""
+        attempts); 429 returns immediately — see the module doc.
+
+        ctx: the inbound TraceContext (the client's X-Tpusvm-Trace
+        header). With a tracer attached the forward becomes a
+        ``router.forward`` span carrying the inbound ctx in its attrs,
+        and the OUTBOUND request carries a context minted under that
+        span — the replica's serve.request span then parents into this
+        router's timeline. Without a tracer the inbound context passes
+        through unchanged (the router is transparent to tracing)."""
+        span = contextlib.nullcontext()
+        if self._tracer is not None:
+            attrs = {"model": model}
+            if ctx is not None:
+                attrs["ctx"] = ctx.to_dict()
+            span = self._tracer.span("router.forward", **attrs)
+        with span:
+            return self._forward(model, body, suffix, ctx)
+
+    def _forward(self, model: str, body: bytes, suffix: str, ctx
+                 ) -> Tuple[int, bytes, Optional[str]]:
+        from tpusvm.obs.trace import TRACE_HEADER
+
+        out_ctx = ctx
+        if self._tracer is not None and self._tracer.role is not None:
+            out_ctx = self._tracer.ctx()  # inside the router.forward span
+        headers = ({TRACE_HEADER: out_ctx.to_header()}
+                   if out_ctx is not None else None)
         self._c_requests.inc()
         cands = self.candidates(model)
         if not cands:
@@ -178,9 +213,15 @@ class Router:
                 self._c_failovers.inc()
             tried.append(url)
             faults.point("router.forward", replica=url, model=model)
-            code, data, retry_after = self._transport(
-                url.rstrip("/") + f"/v1/models/{model}{suffix}",
-                body, self.config.forward_timeout_s)
+            target = url.rstrip("/") + f"/v1/models/{model}{suffix}"
+            if headers is not None:
+                code, data, retry_after = self._transport(
+                    target, body, self.config.forward_timeout_s, headers)
+            else:
+                # 3-arg form kept for injected transports that predate
+                # trace propagation (tests stub this signature)
+                code, data, retry_after = self._transport(
+                    target, body, self.config.forward_timeout_s)
             if code == 503:
                 # breaker open / draining / scoring error there: the
                 # next placement may well serve it — retryable
@@ -285,6 +326,38 @@ class Router:
     def metrics_text(self) -> str:
         return self._registry.render_text()
 
+    # -------------------------------------------------------------- fleet
+    def fleet_payload(self) -> dict:
+        """This router process's own fleet snapshot payload (the same
+        shape every serve replica exports at /metrics.json)."""
+        from tpusvm.obs.fleet import snapshot_payload
+
+        return snapshot_payload(
+            "router", self.instance, self._registry.snapshot(),
+            status={"router": self.status_code().name,
+                    "replicas": self.poller.states()})
+
+    def fleet_view(self):
+        """One synchronous scrape over the CURRENT replica membership
+        plus this router itself — the GET /fleet/metrics backend."""
+        from tpusvm.obs.fleet import FleetCollector
+
+        c = FleetCollector(timeout_s=self.config.health_timeout_s)
+        for url in self.replica_set.replicas():
+            c.add_replica(url)
+        c.add_callable(self.fleet_payload, name="router")
+        return c.scrape_once()
+
+    def fleet_metrics_text(self) -> str:
+        from tpusvm.obs.fleet import render_fleet_text
+
+        return render_fleet_text(self.fleet_view())
+
+    def fleet_metrics_json(self) -> dict:
+        from tpusvm.obs.fleet import fleet_json
+
+        return fleet_json(self.fleet_view())
+
     # --------------------------------------------------------- lifecycle
     def start(self) -> "Router":
         self.poller.start()
@@ -349,6 +422,13 @@ class _RouterHandler(BaseHTTPRequestHandler):
         elif self.path == "/metrics":
             self._send(200, self._router.metrics_text().encode(),
                        "text/plain; version=0.0.4")
+        elif self.path == "/metrics.json":
+            self._send_json(self._router.fleet_payload())
+        elif self.path == "/fleet/metrics":
+            self._send(200, self._router.fleet_metrics_text().encode(),
+                       "text/plain; version=0.0.4")
+        elif self.path == "/fleet/metrics.json":
+            self._send_json(self._router.fleet_metrics_json())
         elif self.path == "/v1/replicas":
             self._send_json(self._router.replica_detail())
         else:
@@ -389,9 +469,13 @@ class _RouterHandler(BaseHTTPRequestHandler):
             return
         if self.path.startswith("/v1/models/") and (
                 self.path.endswith(":predict")):
+            from tpusvm.obs.trace import TRACE_HEADER, TraceContext
+
             name = self.path[len("/v1/models/"):-len(":predict")]
             code, data, retry_after = self._router.forward(
-                name, self._read_body())
+                name, self._read_body(),
+                ctx=TraceContext.from_header(
+                    self.headers.get(TRACE_HEADER)))
             self._send(code, data, "application/json",
                        retry_after=retry_after)
             return

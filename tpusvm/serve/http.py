@@ -30,6 +30,10 @@ Routes:
   GET  /v1/models                 hosted-model summaries (Server.status())
   GET  /v1/models/<name>/metrics  one model's metrics JSON
   GET  /metrics                   plaintext metrics for every model
+  GET  /metrics.json              this replica's fleet snapshot payload
+                                  (obs.fleet: role/instance-attributed,
+                                  mergeable registry snapshot — what
+                                  `tpusvm fleet-metrics` scrapes)
   POST /v1/models/<name>:predict  {"instances": [[...], ...]}
                                   -> {"predictions": [...], "scores": [...],
                                       "statuses": [...]}
@@ -50,6 +54,7 @@ Degraded-mode response codes (per-request detail always in `statuses`):
 
 from __future__ import annotations
 
+import contextlib
 import json
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -100,6 +105,10 @@ class _Handler(BaseHTTPRequestHandler):
         elif self.path == "/metrics":
             self._send(200, self._srv.metrics_text().encode(),
                        "text/plain; version=0.0.4")
+        elif self.path == "/metrics.json":
+            # the fleet collector's scrape target: one mergeable
+            # (role, instance)-attributed registry snapshot payload
+            self._send_json(self._srv.fleet_snapshot())
         elif self.path == "/v1/models":
             self._send_json(self._srv.status())
         elif self.path.startswith("/v1/models/") and self.path.endswith("/metrics"):
@@ -156,9 +165,25 @@ class _Handler(BaseHTTPRequestHandler):
         except (ValueError, KeyError, TypeError) as e:
             self._send_json({"error": f"bad request body: {e}"}, code=400)
             return
+        # honor a propagated trace context: with a tracer attached
+        # (serve --trace) the scoring lands as a serve.request span whose
+        # attrs carry the caller's ctx, so the merged report re-parents
+        # it under the router's forward span; without one the header is
+        # accepted and ignored
+        tracer = getattr(self.server, "tpusvm_tracer", None)
+        span = contextlib.nullcontext()
+        if tracer is not None:
+            from tpusvm.obs.trace import TRACE_HEADER, TraceContext
+
+            attrs = {"model": name, "rows": int(X.shape[0])}
+            ctx = TraceContext.from_header(self.headers.get(TRACE_HEADER))
+            if ctx is not None:
+                attrs["ctx"] = ctx.to_dict()
+            span = tracer.span("serve.request", **attrs)
         try:
-            results = self._srv.submit_many(
-                name, X, timeout_s=payload.get("timeout_s"))
+            with span:
+                results = self._srv.submit_many(
+                    name, X, timeout_s=payload.get("timeout_s"))
         except KeyError as e:
             self._send_json({"error": str(e)}, code=404)
             return
@@ -211,6 +236,9 @@ def make_http_server(server, host: str = "127.0.0.1", port: int = 8471,
     """
     httpd = ThreadingHTTPServer((host, port), _Handler)
     httpd.tpusvm_server = server
+    # set by the CLI when serve runs with --trace: per-request
+    # serve.request spans (honoring propagated X-Tpusvm-Trace contexts)
+    httpd.tpusvm_tracer = None
     httpd.verbose = verbose
     # handler threads must not block interpreter exit
     httpd.daemon_threads = True

@@ -647,6 +647,39 @@ class Server:
             )
         return "".join(chunks)
 
+    def fleet_snapshot(self) -> dict:
+        """This replica's fleet payload (obs.fleet): every model
+        worker's registry snapshot merged into ONE mergeable snapshot
+        (each worker owns its own registry, so the per-model series are
+        label-disjoint and the merge is exact), plus the status block
+        `tpusvm top` renders (generation / breaker / p99 / burn per
+        model). GET /metrics.json serves this verbatim."""
+        from tpusvm.obs.fleet import snapshot_payload
+        from tpusvm.obs.registry import merge_snapshots
+
+        with self._lock:
+            workers = dict(self._workers)
+        snaps = [w.metrics.registry_snapshot() for w in workers.values()]
+        merged = (merge_snapshots(*snaps) if snaps
+                  else {"v": 1, "metrics": []})
+        models = {}
+        for n, w in workers.items():
+            m = w.metrics.snapshot()
+            slo = m.get("slo")
+            models[n] = {
+                "generation": w.generation,
+                "breaker": w.breaker.state,
+                "queue_depth": w.batcher.depth,
+                "p99_s": m["latency_s"]["p99"],
+                "burning": bool(slo["burning"]) if slo else False,
+            }
+        return snapshot_payload(
+            "serve", self.replica_id, merged,
+            status={"models": models,
+                    "draining": self._draining,
+                    "uptime_s": round(time.monotonic() - self._start_t,
+                                      3)})
+
     def status(self) -> dict:
         """JSON-able server summary (models, buckets, compiles, queues)."""
         models = {}
